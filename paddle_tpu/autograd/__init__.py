@@ -1,0 +1,93 @@
+"""Autograd surface (parity: python/paddle/autograd/).
+
+The reference records GradNodes eagerly per op and runs a C++ tape walk on
+``loss.backward()`` (paddle/fluid/eager/backward.cc). On TPU reverse-mode
+is a program transform: ``jax.grad`` over the functional form of the
+model. This module provides the bridge with Paddle-shaped ergonomics:
+
+    loss, grads = backward(model, loss_fn, *inputs)
+    optimizer.set_gradients(grads); optimizer.step()
+
+plus ``no_grad`` and a ``PyLayer`` equivalent via jax.custom_vjp.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict
+
+import jax
+
+from ..core.functional import extract_params, functional_call
+from ..core.module import Layer
+
+
+def value_and_grad(model: Layer, loss_fn: Callable = None):
+    """Build ``f(params, *inputs) -> (loss, grads)``.
+
+    ``loss_fn(output, *extra)`` maps model output to a scalar; if None the
+    model's own output must be scalar.
+    """
+
+    def fwd(params, *args, rngs=None):
+        if loss_fn is None:
+            return functional_call(model, params, *args, rngs=rngs)
+        out = functional_call(model, params, args[0], rngs=rngs)
+        return loss_fn(out, *args[1:])
+
+    return jax.value_and_grad(fwd)
+
+
+def backward(model: Layer, loss_fn: Callable, *inputs, rngs=None):
+    """Eager one-shot: compute loss and grads w.r.t. trainable params."""
+    params = extract_params(model, trainable_only=True)
+    loss, grads = value_and_grad(model, loss_fn)(params, *inputs, rngs=rngs)
+    return loss, grads
+
+
+@contextlib.contextmanager
+def no_grad():
+    yield
+
+
+class PyLayer:
+    """Custom autograd op (parity: paddle.autograd.PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx,
+    *grads)``; ``apply`` builds a jax.custom_vjp under the hood. ctx is a
+    plain namespace whose ``saved`` list is threaded as vjp residuals.
+    """
+
+    @classmethod
+    def apply(cls, *args):
+        import types
+
+        @jax.custom_vjp
+        def f(*xs):
+            ctx = types.SimpleNamespace(saved=None)
+            return cls.forward(ctx, *xs)
+
+        def f_fwd(*xs):
+            ctx = types.SimpleNamespace(saved=None)
+            out = cls.forward(ctx, *xs)
+            return out, ctx.saved
+
+        def f_bwd(saved, g):
+            import types as _t
+
+            ctx = _t.SimpleNamespace(saved=saved)
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return grads
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(*args)
+
+    @staticmethod
+    def forward(ctx, *args):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

@@ -1,0 +1,17 @@
+from . import dtype, functional, initializer, random
+from .functional import (
+    bind_params,
+    extract_buffers,
+    extract_param_objs,
+    extract_params,
+    functional_call,
+    module_fn,
+)
+from .module import Layer
+from .parameter import Parameter
+
+__all__ = [
+    "Layer", "Parameter", "dtype", "random", "initializer", "functional",
+    "functional_call", "extract_params", "extract_param_objs",
+    "extract_buffers", "bind_params", "module_fn",
+]

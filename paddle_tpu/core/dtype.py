@@ -1,0 +1,69 @@
+"""Dtype registry and default-dtype management.
+
+Parity: paddle's ``paddle.set_default_dtype`` / ``paddle.get_default_dtype``
+(upstream: python/paddle/framework/framework.py) and the DataType enum in
+paddle/phi/common/data_type.h. On TPU the canonical compute dtype is
+bfloat16; fp32 remains the default parameter dtype so that master-weight
+semantics match the reference's ``multi_precision`` behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype aliases (paddle.float32 etc. re-exported at package root).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    """Set the default floating dtype used for new parameters/tensors."""
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalize a string / numpy / jax dtype spec to a jnp dtype."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        if d not in _STR_TO_DTYPE:
+            raise ValueError(f"unknown dtype string: {d!r}")
+        return _STR_TO_DTYPE[d]
+    return jnp.dtype(d).type if isinstance(d, np.dtype) else d
+
+
+def is_floating_dtype(d) -> bool:
+    return jnp.issubdtype(jnp.dtype(d), jnp.floating)

@@ -1,0 +1,104 @@
+"""Functional bridge: run a stateful Layer tree as a pure function.
+
+This is the architectural pivot away from the reference: paddle executes
+ops eagerly through a C++ dispatcher (pybind → *_ad_func → phi kernel,
+upstream paddle/fluid/eager/), while on TPU the entire train/eval step must
+be one XLA program. ``functional_call(layer, params, *args)`` temporarily
+binds a flat ``{qualified_name: array}`` dict into the layer tree and calls
+``layer(*args)`` — under ``jax.jit`` the bound values are tracers, so the
+trace captures a pure function of the parameter pytree while user code
+keeps its stateful Paddle-style ``self.weight`` reads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+
+from . import random as random_mod
+from .module import Layer
+
+
+def extract_params(layer: Layer, trainable_only: bool = False) -> Dict[str, jax.Array]:
+    """Flat pytree of parameter values keyed by qualified name."""
+    return {
+        name: p.value
+        for name, p in layer.named_parameters()
+        if (p.trainable or not trainable_only)
+    }
+
+
+def extract_param_objs(layer: Layer, trainable_only: bool = False):
+    return {
+        name: p
+        for name, p in layer.named_parameters()
+        if (p.trainable or not trainable_only)
+    }
+
+
+def extract_buffers(layer: Layer) -> Dict[str, jax.Array]:
+    return dict(layer.named_buffers())
+
+
+@contextlib.contextmanager
+def bind_params(layer: Layer, params: Dict[str, Any], buffers=None):
+    """Temporarily swap parameter (and buffer) values in the layer tree."""
+    objs = dict(layer.named_parameters())
+    saved = {}
+    for name, value in params.items():
+        p = objs.get(name)
+        if p is None:
+            raise KeyError(f"unknown parameter {name!r}")
+        saved[name] = p.value
+        p.value = value
+    saved_bufs = []
+    if buffers:
+        owners = {}
+        for layer_name, sub in layer.named_sublayers(include_self=True):
+            for bname in sub._buffers:
+                full = f"{layer_name}.{bname}" if layer_name else bname
+                owners[full] = (sub, bname)
+        for name, value in buffers.items():
+            if name in owners:
+                sub, bname = owners[name]
+                saved_bufs.append((sub, bname, sub._buffers[bname]))
+                sub._buffers[bname] = value
+    try:
+        yield
+    finally:
+        for name, value in saved.items():
+            objs[name].value = value
+        for sub, bname, value in saved_bufs:
+            sub._buffers[bname] = value
+
+
+def functional_call(
+    layer: Layer,
+    params: Dict[str, Any],
+    *args,
+    rngs=None,
+    buffers=None,
+    **kwargs,
+):
+    """Pure-functional forward: ``out = f(params, inputs)``.
+
+    ``rngs`` — a PRNG key or dict of keys threaded to Dropout & friends via
+    ``core.random.rng_context``; required for stochastic layers under jit.
+    """
+    with bind_params(layer, params, buffers=buffers):
+        with random_mod.rng_context(rngs):
+            return layer(*args, **kwargs)
+
+
+def module_fn(layer: Layer, method: Optional[str] = None):
+    """Return a pure ``fn(params, *args, rngs=None, **kw)`` for jitting."""
+
+    def fn(params, *args, rngs=None, **kwargs):
+        with bind_params(layer, params):
+            with random_mod.rng_context(rngs):
+                target = getattr(layer, method) if method else layer
+                return target(*args, **kwargs)
+
+    return fn

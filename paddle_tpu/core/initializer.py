@@ -1,0 +1,134 @@
+"""Parameter initializers.
+
+Parity: paddle.nn.initializer (upstream: python/paddle/nn/initializer/) —
+Constant, Normal, TruncatedNormal, Uniform, XavierNormal/Uniform,
+KaimingNormal/Uniform. Each initializer is a callable
+``(key, shape, dtype) -> jax.Array`` so it can be used both eagerly at
+parameter-creation time and functionally under jit (e.g. for re-init).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype):
+        # Sample in fp32 then cast: bf16 sampling loses too much entropy.
+        x = self.mean + self.std * jax.random.normal(key, shape, jnp.float32)
+        return x.astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, self.a, self.b, shape, jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype):
+        x = jax.random.uniform(key, shape, jnp.float32, self.low, self.high)
+        return x.astype(dtype)
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weights are [in_features, out_features]
+        return shape[0], shape[1]
+    receptive = math.prod(shape[2:])
+    # conv weight [out_c, in_c, *k] (paddle layout)
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return Normal(0.0, std)(key, shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return Uniform(-limit, limit)(key, shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope**2))
+        return math.sqrt(2.0)
+
+    def __call__(self, key, shape, dtype):
+        fan_in = self.fan_in or _fan_in_out(shape)[0]
+        std = self._gain() / math.sqrt(fan_in)
+        return Normal(0.0, std)(key, shape, dtype)
+
+
+class KaimingUniform(KaimingNormal):
+    def __call__(self, key, shape, dtype):
+        fan_in = self.fan_in or _fan_in_out(shape)[0]
+        limit = self._gain() * math.sqrt(3.0 / fan_in)
+        return Uniform(-limit, limit)(key, shape, dtype)
+
+
+def _linear_default_weight_init():
+    # paddle's default for Linear: XavierNormal-like (upstream uses
+    # XavierNormal for most layers via default_initializer on create_parameter)
+    return XavierNormal()
+
+
+def resolve(init, default=None) -> Initializer:
+    if init is None:
+        return default or XavierNormal()
+    if isinstance(init, Initializer):
+        return init
+    if callable(init):
+
+        class _Wrap(Initializer):
+            def __call__(self, key, shape, dtype):
+                return init(key, shape, dtype)
+
+        return _Wrap()
+    raise TypeError(f"cannot interpret initializer: {init!r}")

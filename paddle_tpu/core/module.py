@@ -1,0 +1,340 @@
+"""Layer: the module system.
+
+Parity: ``paddle.nn.Layer`` (upstream: python/paddle/nn/layer/layers.py) —
+sublayers, named_parameters, buffers, forward pre/post hooks, train/eval
+mode, state_dict/set_state_dict, apply, to(dtype).
+
+TPU-native design: Layers are eager containers of ``Parameter`` cells and
+plain-python config. They are **not** pytrees; jitted execution goes
+through ``core.functional.functional_call`` which temporarily binds a flat
+``{qualified_name: array}`` pytree into the layer tree. This keeps the
+user-facing API stateful/Paddle-flavored while every hot path remains a
+pure function of (params, buffers, inputs) that XLA can compile once.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from . import initializer as init_mod
+from . import random as random_mod
+from .parameter import Parameter
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None):
+        d = object.__setattr__
+        d(self, "_parameters", collections.OrderedDict())
+        d(self, "_buffers", collections.OrderedDict())
+        d(self, "_non_persistable_buffer_names", set())
+        d(self, "_sub_layers", collections.OrderedDict())
+        d(self, "_forward_pre_hooks", collections.OrderedDict())
+        d(self, "_forward_post_hooks", collections.OrderedDict())
+        d(self, "_hook_id", 0)
+        d(self, "training", True)
+        d(self, "_name_scope", name_scope or type(self).__name__.lower())
+        d(self, "_dtype", dtype_mod.get_default_dtype())
+
+    # ------------------------------------------------------------------
+    # attribute routing
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.pop(name, None)
+            self._sub_layers.pop(name, None)
+            self._parameters[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.pop(name, None)
+            self._parameters.pop(name, None)
+            self._sub_layers[name] = value
+        else:
+            self._parameters.pop(name, None)
+            self._sub_layers.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in (self._parameters, self._buffers, self._sub_layers):
+            if name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        dtype=None,
+        default_initializer=None,
+        is_bias: bool = False,
+        spec=None,
+        name: Optional[str] = None,
+    ) -> Parameter:
+        """Create (and eagerly initialize) a Parameter.
+
+        Parity: Layer.create_parameter in upstream layers.py; bias defaults
+        to zeros, weights to Xavier-normal.
+        """
+        dt = dtype_mod.convert_dtype(dtype or self._dtype)
+        default = init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal()
+        init = init_mod.resolve(default_initializer, default)
+        key = random_mod.next_rng_key("params")
+        value = init(key, tuple(shape), dt)
+        return Parameter(value, name=name, spec=spec, init_fn=init)
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        if tensor is not None:
+            tensor = jnp.asarray(tensor)
+        self.__dict__.pop(name, None)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def named_sublayers(
+        self, prefix: str = "", include_self: bool = False, layers_set=None
+    ) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(
+                prefix=p, include_self=True, layers_set=layers_set
+            )
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self._sub_layers.items():
+            if sub is not None:
+                yield sub
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def named_parameters(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for layer_name, layer in self.named_sublayers(
+            prefix=prefix, include_self=True
+        ):
+            for pname, param in layer._parameters.items():
+                if param is None or id(param) in seen:
+                    continue
+                seen.add(id(param))
+                full = f"{layer_name}.{pname}" if layer_name else pname
+                if param.name.startswith("param_"):
+                    # adopt the qualified name so eager grads (keyed by
+                    # traversal name) line up with Parameter.name
+                    param.name = full
+                yield full, param
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, jax.Array]]:
+        for layer_name, layer in self.named_sublayers(
+            prefix=prefix, include_self=True
+        ):
+            for bname, buf in layer._buffers.items():
+                if buf is None:
+                    continue
+                full = f"{layer_name}.{bname}" if layer_name else bname
+                yield full, buf
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # ------------------------------------------------------------------
+    # hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------------
+    # mode / functional application
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def to(self, dtype=None):
+        """Cast all floating parameters/buffers (parity: Layer.to / amp
+        decorate's cast)."""
+        if dtype is None:
+            return self
+        dt = dtype_mod.convert_dtype(dtype)
+        for _, p in self.named_parameters():
+            if dtype_mod.is_floating_dtype(p.value.dtype):
+                p.value = p.value.astype(dt)
+        for layer in self.sublayers(include_self=True):
+            for bname, buf in list(layer._buffers.items()):
+                if buf is not None and dtype_mod.is_floating_dtype(buf.dtype):
+                    layer._buffers[bname] = buf.astype(dt)
+            layer._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype)
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(
+        self, include_sublayers: bool = True, structured_name_prefix: str = ""
+    ) -> Dict[str, jax.Array]:
+        out = collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p.value
+        for layer_name, layer in self.named_sublayers(
+            prefix=structured_name_prefix, include_self=True
+        ):
+            for bname, buf in layer._buffers.items():
+                if buf is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                full = f"{layer_name}.{bname}" if layer_name else bname
+                out[full] = buf
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load values by structured name; shapes must match."""
+        params = dict(self.named_parameters())
+        missing, unexpected = [], []
+        buf_owners = {}
+        for layer_name, layer in self.named_sublayers(include_self=True):
+            for bname in layer._buffers:
+                full = f"{layer_name}.{bname}" if layer_name else bname
+                buf_owners[full] = (layer, bname)
+        for name, value in state_dict.items():
+            if name in params:
+                p = params[name]
+                value = jnp.asarray(value)
+                if tuple(value.shape) != tuple(p.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: got {tuple(value.shape)}, "
+                        f"expected {tuple(p.shape)}"
+                    )
+                p.value = value.astype(p.dtype)
+            elif name in buf_owners:
+                layer, bname = buf_owners[name]
+                layer._buffers[bname] = jnp.asarray(value)
+            else:
+                unexpected.append(name)
+        for name in params:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()"
+        )
+
+    def __call__(self, *args, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    # ------------------------------------------------------------------
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        body = ""
+        if extra:
+            body += extra
+        if lines:
+            if extra:
+                body += "\n  "
+            body += "\n  ".join(lines)
+        if body:
+            return f"{type(self).__name__}(\n  {body}\n)"
+        return f"{type(self).__name__}()"

@@ -1,0 +1,178 @@
+"""Parameter: a named, trainable array slot with sharding metadata.
+
+Parity: paddle's ``EagerParamBase`` (python/paddle/base/framework.py) —
+a tensor that knows its name, trainability and distribution attributes.
+
+TPU-native design: a ``Parameter`` is a thin mutable cell around a
+``jax.Array``. Layers hold Parameters as attributes (eager ergonomics,
+``layer.weight`` works in math expressions via ``__jax_array__`` and
+operator overloads); the functional bridge (``core.functional``) swaps the
+``.value`` fields for tracers when building jitted train steps, so a
+Parameter never needs to be a pytree leaf itself.
+
+Sharding metadata: ``spec`` is a logical partition hint — a tuple with one
+entry per dim, each entry a mesh-axis name (e.g. "tp"), a tuple of axis
+names, or None. The sharding engine (distributed/sharding.py) combines it
+with the active strategy (e.g. adds the fsdp axis for ZeRO-3) to produce
+the final ``PartitionSpec``.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_param_counter = [0]
+
+
+def _auto_name(prefix="param"):
+    _param_counter[0] += 1
+    return f"{prefix}_{_param_counter[0]}"
+
+
+class Parameter:
+    __slots__ = (
+        "value",
+        "name",
+        "trainable",
+        "spec",
+        "is_distributed",
+        "no_sync",
+        "init_fn",
+        "optimize_attr",
+    )
+
+    def __init__(
+        self,
+        value: jax.Array,
+        name: Optional[str] = None,
+        trainable: bool = True,
+        spec: Optional[Tuple] = None,
+        is_distributed: bool = False,
+        init_fn=None,
+    ):
+        self.value = value
+        self.name = name or _auto_name()
+        self.trainable = trainable
+        # logical per-dim sharding hint; resolved by the sharding engine
+        self.spec = spec
+        # parity: fleet marks TP-partitioned params is_distributed=True so DP
+        # allreduce / broadcast skips them
+        self.is_distributed = is_distributed
+        self.no_sync = False
+        self.init_fn = init_fn
+        self.optimize_attr = {"learning_rate": 1.0}
+
+    # ---- array protocol -------------------------------------------------
+    def __jax_array__(self):
+        return self.value
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return self.value.size
+
+    @property
+    def T(self):
+        return self.value.T
+
+    def astype(self, dtype):
+        return self.value.astype(dtype)
+
+    def numpy(self):
+        return jax.device_get(self.value)
+
+    def item(self):
+        return self.value.item()
+
+    def __len__(self):
+        return len(self.value)
+
+    def __getitem__(self, idx):
+        return self.value[idx]
+
+    def __iter__(self):
+        return iter(self.value)
+
+    def __repr__(self):
+        return (
+            f"Parameter(name={self.name!r}, shape={tuple(self.value.shape)}, "
+            f"dtype={self.value.dtype}, trainable={self.trainable}, "
+            f"spec={self.spec})"
+        )
+
+    # ---- mutation -------------------------------------------------------
+    def set_value(self, v):
+        self.value = jnp.asarray(v, dtype=self.value.dtype)
+
+    def stop_gradient_(self, flag: bool = True):
+        self.trainable = not flag
+
+    @property
+    def stop_gradient(self):
+        return not self.trainable
+
+    @stop_gradient.setter
+    def stop_gradient(self, flag):
+        self.trainable = not flag
+
+
+def _binop(op, reflected=False):
+    if reflected:
+
+        def fn(self, other):
+            return op(_unwrap(other), self.value)
+
+    else:
+
+        def fn(self, other):
+            return op(self.value, _unwrap(other))
+
+    return fn
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+for _name, _op in [
+    ("add", operator.add),
+    ("sub", operator.sub),
+    ("mul", operator.mul),
+    ("truediv", operator.truediv),
+    ("floordiv", operator.floordiv),
+    ("mod", operator.mod),
+    ("pow", operator.pow),
+    ("matmul", operator.matmul),
+]:
+    setattr(Parameter, f"__{_name}__", _binop(_op))
+    setattr(Parameter, f"__r{_name}__", _binop(_op, reflected=True))
+
+for _name, _op in [
+    ("neg", operator.neg),
+    ("pos", operator.pos),
+    ("abs", operator.abs),
+]:
+    setattr(Parameter, f"__{_name}__", lambda self, _op=_op: _op(self.value))
+
+for _name, _op in [
+    ("lt", operator.lt),
+    ("le", operator.le),
+    ("gt", operator.gt),
+    ("ge", operator.ge),
+]:
+    setattr(Parameter, f"__{_name}__", _binop(_op))

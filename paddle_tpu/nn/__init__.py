@@ -1,0 +1,67 @@
+"""paddle_tpu.nn — layers and functional ops (parity: paddle.nn)."""
+
+from ..core.module import Layer
+from ..core.parameter import Parameter
+from . import functional
+from .layer.activation import (
+    ELU,
+    GELU,
+    GLU,
+    Hardsigmoid,
+    Hardswish,
+    LeakyReLU,
+    LogSoftmax,
+    Mish,
+    ReLU,
+    ReLU6,
+    Sigmoid,
+    SiLU,
+    Softmax,
+    Softplus,
+    Swish,
+    Tanh,
+)
+from .layer.common import (
+    Dropout,
+    Embedding,
+    Flatten,
+    Identity,
+    LayerList,
+    Linear,
+    ParameterList,
+    Sequential,
+    Upsample,
+)
+from .layer.conv import AdaptiveAvgPool2D, AvgPool2D, Conv2D, MaxPool2D
+from .layer.loss import (
+    BCEWithLogitsLoss,
+    CrossEntropyLoss,
+    L1Loss,
+    MSELoss,
+    NLLLoss,
+)
+from .layer.norm import (
+    BatchNorm,
+    BatchNorm2D,
+    GroupNorm,
+    LayerNorm,
+    RMSNorm,
+)
+from .layer.transformer import (
+    MultiHeadAttention,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+
+__all__ = [
+    "Layer", "Parameter", "functional",
+    "Linear", "Embedding", "Dropout", "Identity", "Sequential", "LayerList",
+    "ParameterList", "Flatten", "Upsample",
+    "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh", "LeakyReLU",
+    "ELU", "Softmax", "LogSoftmax", "Hardswish", "Hardsigmoid", "Mish",
+    "Softplus", "GLU",
+    "LayerNorm", "RMSNorm", "GroupNorm", "BatchNorm", "BatchNorm2D",
+    "Conv2D", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D",
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
+    "MultiHeadAttention", "TransformerEncoder", "TransformerEncoderLayer",
+]

@@ -1,0 +1,463 @@
+"""Functional ops (parity: paddle.nn.functional).
+
+Thin, jit-friendly wrappers over jax.numpy/lax. Where the reference routes
+through hand-written CUDA kernels (paddle/phi/kernels/gpu/,
+paddle/phi/kernels/fusion/), XLA fusion covers the same ground on TPU; the
+genuinely hot fused paths (flash attention, rope/rmsnorm at long seq,
+paged decode) live in paddle_tpu.kernels as Pallas implementations and are
+dispatched from here when available.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core import random as random_mod
+from ...core.parameter import Parameter
+
+
+def _v(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). Weight layout [in_features, out_features] (paddle
+    convention, phi kernel matmul_kernel)."""
+    x, weight = _v(x), _v(weight)
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + _v(bias)
+    return y
+
+
+def embedding(x, weight, padding_idx=None):
+    x, weight = _v(x), _v(weight)
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def relu(x):
+    return jax.nn.relu(_v(x))
+
+
+def relu6(x):
+    return jax.nn.relu6(_v(x))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(_v(x), approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(_v(x))
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(_v(x))
+
+
+def tanh(x):
+    return jnp.tanh(_v(x))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(_v(x), negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(_v(x), alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jax.nn.softplus(_v(x) * beta) / beta
+
+
+def hardswish(x):
+    return jax.nn.hard_swish(_v(x))
+
+
+def hardsigmoid(x):
+    x = _v(x)
+    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def mish(x):
+    return jax.nn.mish(_v(x))
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(_v(x), axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(_v(x), axis=axis)
+
+
+def glu(x, axis=-1):
+    return jax.nn.glu(_v(x), axis=axis)
+
+
+def swiglu(x, y=None):
+    """Parity: phi fusion swiglu — silu(x) * y (split x in half if y None)."""
+    x = _v(x)
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * _v(y)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    x = _v(x)
+    # compute statistics in fp32 for bf16 inputs (parity: phi layer_norm
+    # kernel accumulates in float)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight)
+    if bias is not None:
+        y = y + _v(bias)
+    return y
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Parity: phi fusion rms_norm kernel."""
+    x = _v(x)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight)
+    return y
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    x = _v(x)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = num_groups
+    xf = x.astype(jnp.float32).reshape(n, g, c // g, *spatial)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, *spatial).astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight).reshape(1, c, *([1] * len(spatial)))
+    if bias is not None:
+        y = y + _v(bias).reshape(1, c, *([1] * len(spatial)))
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
+    x = _v(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    key = rng_key if rng_key is not None else random_mod.next_rng_key("dropout")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def cross_entropy(
+    logits,
+    label,
+    soft_label: bool = False,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    axis: int = -1,
+    label_smoothing: float = 0.0,
+):
+    """Parity: F.cross_entropy (softmax_with_cross_entropy phi kernel).
+
+    Computes in fp32 regardless of input dtype (matching the fused kernel's
+    accumulation behavior).
+    """
+    logits = _v(logits).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        target = _v(label).astype(jnp.float32)
+        loss = -jnp.sum(target * logp, axis=axis)
+        valid = jnp.ones(loss.shape, jnp.float32)
+    else:
+        label = _v(label)
+        num_classes = logits.shape[axis]
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(label, num_classes, dtype=jnp.float32)
+            smooth = (
+                onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+            )
+            loss = -jnp.sum(smooth * logp, axis=axis)
+        else:
+            safe_label = jnp.where(label == ignore_index, 0, label)
+            loss = -jnp.take_along_axis(
+                logp, safe_label[..., None], axis=axis
+            ).squeeze(axis)
+        valid = (label != ignore_index).astype(jnp.float32)
+        loss = loss * valid
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(loss) / denom
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    d = (_v(input) - _v(label)) ** 2
+    if reduction == "none":
+        return d
+    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    d = jnp.abs(_v(input) - _v(label))
+    if reduction == "none":
+        return d
+    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
+
+
+def nll_loss(log_probs, label, reduction="mean", ignore_index=-100):
+    logp = _v(log_probs)
+    label = _v(label)
+    safe = jnp.where(label == ignore_index, 0, label)
+    loss = -jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
+    valid = (label != ignore_index).astype(loss.dtype)
+    loss = loss * valid
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def binary_cross_entropy_with_logits(logits, label, reduction="mean"):
+    logits, label = _v(logits).astype(jnp.float32), _v(label).astype(jnp.float32)
+    loss = jnp.maximum(logits, 0) - logits * label + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    training: bool = True,
+):
+    """Reference attention in pure XLA. Layout: [batch, seq, heads, dim]
+    (paddle flash_attention layout, phi flash_attn kernel).
+
+    The Pallas flash-attention kernel (paddle_tpu.kernels.flash_attention)
+    is preferred on TPU for long sequences; this is the numerics reference
+    and the general fallback (arbitrary masks, GQA).
+    """
+    q, k, v = _v(query), _v(key), _v(value)
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hq != hk:  # grouped-query attention: repeat kv heads
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    # [b, h, sq, sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        sk = k.shape[1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, jnp.float32(-1e30))
+    if attn_mask is not None:
+        m = _v(attn_mask)
+        if m.dtype == jnp.bool_:
+            logits = jnp.where(m, logits, jnp.float32(-1e30))
+        else:
+            logits = logits + m.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(
+    query, key, value, dropout=0.0, causal=False, *, training=True, **kw
+):
+    """Parity: paddle.nn.functional.flash_attention.flash_attention.
+
+    Dispatches to the Pallas TPU kernel when running on TPU with supported
+    shapes, else the XLA reference path.
+    """
+    from ...kernels import flash_attention as fa
+
+    return fa.flash_attention(
+        _v(query), _v(key), _v(value), causal=causal,
+        dropout_p=dropout, training=training,
+    )
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Weight layout [out_c, in_c/groups, kh, kw] (paddle convention)."""
+    x, weight = _v(x), _v(weight)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 and all(
+        isinstance(p, int) for p in padding
+    ):
+        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"),
+    )
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    y = y.astype(x.dtype)
+    if bias is not None:
+        b = _v(bias)
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        y = y + b.reshape(shape)
+    return y
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if data_format == "NCHW":
+        window = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + list(padding)
+    else:
+        window = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + list(padding) + [(0, 0)]
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, window, strides, pads,
+    )
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if data_format == "NCHW":
+        window = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + list(padding)
+    else:
+        window = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + list(padding) + [(0, 0)]
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, window, strides, pads
+    )
+    return summed / counts
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    assert h % output_size[0] == 0 and w % output_size[1] == 0, (
+        "adaptive pool requires divisible sizes in this implementation"
+    )
+    k = (h // output_size[0], w // output_size[1])
+    return avg_pool2d(x, k, k, 0, data_format)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def one_hot(x, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(_v(x), num_classes, dtype=dtype)
+
+
+def pad(x, pad_width, mode="constant", value=0.0):
+    x = _v(x)
+    if isinstance(pad_width, (list, tuple)) and pad_width and isinstance(
+        pad_width[0], int
+    ):
+        # paddle flat [before_last, after_last, ...] style → per-dim, last dims
+        pairs = list(zip(pad_width[0::2], pad_width[1::2]))
+        full = [(0, 0)] * (x.ndim - len(pairs)) + pairs
+    else:
+        full = pad_width
+    if mode == "constant":
+        return jnp.pad(x, full, constant_values=value)
+    return jnp.pad(x, full, mode=mode)
+
+
+def normalize(x, p=2, axis=-1, epsilon=1e-12):
+    x = _v(x)
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)

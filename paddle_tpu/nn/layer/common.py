@@ -1,0 +1,224 @@
+"""Common layers (parity: python/paddle/nn/layer/common.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core import initializer as I
+from ...core.module import Layer
+from .. import functional as F
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Linear(Layer):
+    """y = xW + b with weight [in_features, out_features] (paddle layout,
+    upstream python/paddle/nn/layer/common.py::Linear)."""
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        bias_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), default_initializer=weight_attr
+        )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True, default_initializer=None
+                if bias_attr in (None, True) else bias_attr
+            )
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    """Parity: paddle.nn.Embedding; weight [num_embeddings, embedding_dim]."""
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        padding_idx: Optional[int] = None,
+        sparse: bool = False,
+        weight_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            default_initializer=weight_attr or I.Normal(0.0, 1.0),
+        )
+        if padding_idx is not None:
+            self.weight.value = self.weight.value.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and layers[0] and isinstance(layers[0][0], tuple):
+            # paddle style: Sequential(('name', layer), ...)
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for layer in layers:
+            self.append(layer)
+        return self
+
+    def insert(self, index, layer):
+        existing = list(self._sub_layers.values())
+        existing.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(existing):
+            self._sub_layers[str(i)] = l
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        if idx < 0:
+            idx += len(self._sub_layers)
+        return self._sub_layers[str(idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        shape = x.shape
+        stop = self.stop_axis if self.stop_axis >= 0 else len(shape) + self.stop_axis
+        new_shape = (
+            shape[: self.start_axis]
+            + (int(jnp.prod(jnp.array(shape[self.start_axis : stop + 1]))),)
+            + shape[stop + 1 :]
+        )
+        return x.reshape(new_shape)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        import jax.image
+
+        if self.data_format == "NCHW":
+            n, c, h, w = x.shape
+            if self.size is not None:
+                oh, ow = self.size
+            else:
+                oh, ow = int(h * self.scale_factor), int(w * self.scale_factor)
+            method = {"nearest": "nearest", "bilinear": "linear"}[self.mode]
+            return jax.image.resize(x, (n, c, oh, ow), method=method)
+        n, h, w, c = x.shape
+        if self.size is not None:
+            oh, ow = self.size
+        else:
+            oh, ow = int(h * self.scale_factor), int(w * self.scale_factor)
+        method = {"nearest": "nearest", "bilinear": "linear"}[self.mode]
+        return jax.image.resize(x, (n, oh, ow, c), method=method)

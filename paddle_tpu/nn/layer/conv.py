@@ -1,0 +1,95 @@
+"""Convolution and pooling layers (parity: python/paddle/nn/layer/conv.py,
+pooling.py)."""
+
+from __future__ import annotations
+
+from ...core import initializer as I
+from ...core.module import Layer
+from .. import functional as F
+
+
+class Conv2D(Layer):
+    """Weight layout [out_channels, in_channels/groups, kh, kw]."""
+
+    def __init__(
+        self,
+        in_channels,
+        out_channels,
+        kernel_size,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups=1,
+        weight_attr=None,
+        bias_attr=None,
+        data_format="NCHW",
+    ):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *kernel_size),
+            default_initializer=weight_attr or I.KaimingUniform(),
+        )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.dilation, self.groups, self.data_format,
+        )
+
+    def extra_repr(self):
+        return (
+            f"{self.in_channels}, {self.out_channels}, "
+            f"kernel_size={self.kernel_size}, stride={self.stride}"
+        )
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool2d(
+            x, self.kernel_size, self.stride, self.padding, self.data_format
+        )
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool2d(
+            x, self.kernel_size, self.stride, self.padding, self.data_format
+        )
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)

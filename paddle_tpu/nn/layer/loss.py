@@ -1,0 +1,62 @@
+"""Loss layers (parity: python/paddle/nn/layer/loss.py)."""
+
+from ...core.module import Layer
+from .. import functional as F
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, label_smoothing=0.0, axis=-1):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.label_smoothing = label_smoothing
+        self.axis = axis
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(
+            input, label,
+            soft_label=self.soft_label,
+            ignore_index=self.ignore_index,
+            reduction=self.reduction,
+            axis=self.axis,
+            label_smoothing=self.label_smoothing,
+        )
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction="mean", ignore_index=-100):
+        super().__init__()
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.nll_loss(input, label, self.reduction, self.ignore_index)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.reduction)

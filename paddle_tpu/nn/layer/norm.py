@@ -1,0 +1,138 @@
+"""Normalization layers (parity: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import initializer as I
+from ...core.module import Layer
+from .. import functional as F
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self.normalized_shape, default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self.normalized_shape, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(
+            x, self.normalized_shape, self.weight, self.bias, self.epsilon
+        )
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """Parity: phi fusion rms_norm / PaddleNLP LlamaRMSNorm."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=I.Constant(1.0)
+        )
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+    def extra_repr(self):
+        return f"hidden_size={self.hidden_size}, epsilon={self.epsilon}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_channels,), default_initializer=I.Constant(1.0)
+            )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(
+            x, self.num_groups, self.weight, self.bias, self.epsilon,
+            self.data_format,
+        )
+
+
+class BatchNorm2D(Layer):
+    """Batch normalization with running statistics buffers.
+
+    Training-mode batch statistics are computed in fp32; running stats are
+    updated eagerly when called outside jit, and treated as frozen inside a
+    functional/jitted call (for jit training loops, prefer GroupNorm or
+    sync-free norms — the reference's distributed vision configs do the
+    same with frozen BN).
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_features,), is_bias=True)
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        c_axis = 1 if self.data_format == "NCHW" else -1
+        axes = tuple(i for i in range(x.ndim) if i != (c_axis % x.ndim))
+        if self.training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            try:
+                # eager: update running stats
+                self._buffers["_mean"] = (
+                    self.momentum * self._buffers["_mean"] + (1 - self.momentum) * mean
+                )
+                self._buffers["_variance"] = (
+                    self.momentum * self._buffers["_variance"]
+                    + (1 - self.momentum) * var
+                )
+            except Exception:
+                pass
+        else:
+            mean = self._buffers["_mean"]
+            var = self._buffers["_variance"]
+        shape = [1] * x.ndim
+        shape[c_axis % x.ndim] = self.num_features
+        xf = x.astype(jnp.float32)
+        y = (xf - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.epsilon)
+        y = y.astype(x.dtype)
+        return y * self.weight.value.astype(x.dtype).reshape(shape) + \
+            self.bias.value.astype(x.dtype).reshape(shape)
+
+
+BatchNorm = BatchNorm2D

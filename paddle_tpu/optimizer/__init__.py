@@ -1,0 +1,22 @@
+"""paddle_tpu.optimizer (parity: paddle.optimizer)."""
+
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adagrad,
+    Adam,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Lamb",
+    "lr", "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+]

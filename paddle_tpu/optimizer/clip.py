@@ -1,0 +1,69 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm / ClipGradByNorm / ClipGradByValue).
+
+Functional: each clip is ``clip(grads_pytree) -> grads_pytree`` and is pure
+jnp, so it runs inside the jitted train step. Under GSPMD the global-norm
+reduction compiles to the same cross-mesh allreduce the reference performs
+explicitly across mp/pp/sharding groups
+(HybridParallelClipGrad, fleet/meta_parallel/hybrid_parallel_optimizer.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float = 1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return grads
+        gnorm_sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves
+        )
+        gnorm = jnp.sqrt(gnorm_sq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
+        )
+
+    def global_norm(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        )
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def _one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(self._one, grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads
+        )

@@ -1,0 +1,159 @@
+"""Learning-rate schedulers (parity: python/paddle/optimizer/lr.py).
+
+Each scheduler is both:
+  - a Paddle-style stateful object (``.step()``, ``.get_lr()``,
+    ``.state_dict()``), and
+  - a pure function of the step count (``sched(step) -> lr`` with jnp ops),
+    so the jitted train step computes the LR on device with no host sync.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.last_lr = None
+        self.step()
+
+    # ---- pure functional form (jittable) ----
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    # ---- stateful paddle API ----
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        self.last_lr = float(self.lr_at(jnp.asarray(self.last_epoch)))
+
+    def get_lr(self):
+        return self.last_lr
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, d):
+        self.last_epoch = d["last_epoch"]
+        self.last_lr = d["last_lr"]
+
+
+class ConstantLR(LRScheduler):
+    def lr_at(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class LinearWarmup(LRScheduler):
+    """Warm up from start_lr to end_lr over warmup_steps, then follow the
+    wrapped schedule (or stay at end_lr if wrapping a float)."""
+
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1):
+        self.inner = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        base = end_lr if isinstance(learning_rate, (int, float)) else learning_rate.base_lr
+        super().__init__(base, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step / max(self.warmup_steps, 1), 1.0
+        )
+        if isinstance(self.inner, (int, float)):
+            after = jnp.asarray(self.inner, jnp.float32)
+        else:
+            after = self.inner.lr_at(jnp.maximum(step - self.warmup_steps, 0))
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0.0, last_epoch=-1):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / self.T_max, 0.0, 1.0)
+        return self.eta_min + 0.5 * (self.base_lr - self.eta_min) * (
+            1.0 + jnp.cos(jnp.pi * frac)
+        )
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.power(
+            self.gamma, jnp.asarray(step, jnp.float32)
+        )
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / self.step_size)
+        return self.base_lr * jnp.power(self.gamma, k)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / self.decay_steps, 0.0, 1.0)
+        return (self.base_lr - self.end_lr) * jnp.power(1 - frac, self.power) + self.end_lr
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        lr = jnp.asarray(self.values[-1], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            lr = jnp.where(step < b, v, lr)
+        return lr
+
+
+def resolve_lr(learning_rate):
+    """Return (base_lr_float, schedule_fn|None)."""
+    if isinstance(learning_rate, LRScheduler):
+        return learning_rate.base_lr, learning_rate.lr_at
+    return float(learning_rate), None

@@ -1,0 +1,330 @@
+"""Optimizers: functional core + Paddle-parity stateful wrapper.
+
+Parity: python/paddle/optimizer/ (SGD/Momentum/Adam/AdamW with
+``multi_precision`` master weights, grad_clip, weight decay,
+apply_decay_param_fun) and the fused multi-tensor kernels
+(phi fused_adamw / multi_tensor_adam) — on TPU the "fusion" is XLA's: the
+whole-pytree update is one compiled program, so per-tensor kernel-launch
+overhead (the thing multi-tensor kernels exist to kill) does not exist.
+
+Design: an optimizer owns no tensors. ``init(params)`` returns a state
+pytree; ``update(grads, state, params)`` returns (new_params, new_state).
+Both run under jit with params/grads/state sharded by the ZeRO engine —
+optimizer-state sharding (stage 1/2) falls out of giving state the same
+PartitionSpec as its parameter. The stateful ``.step()`` path mutates
+Parameter cells eagerly for small-scale/naive use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.parameter import Parameter
+from .clip import ClipGradBase
+from .lr import LRScheduler, resolve_lr
+
+
+def _to_f32(x):
+    return x.astype(jnp.float32)
+
+
+class Optimizer:
+    """Base. Subclasses implement ``_init_slot(param)`` and
+    ``_apply(update_ctx, name, param_f32, grad_f32, slots)``."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay: float = 0.0,
+        grad_clip: Optional[ClipGradBase] = None,
+        multi_precision: bool = True,
+        apply_decay_param_fun: Optional[Callable[[str], bool]] = None,
+        name: Optional[str] = None,
+    ):
+        self.base_lr, self.lr_schedule = resolve_lr(learning_rate)
+        self._lr_scheduler = (
+            learning_rate if isinstance(learning_rate, LRScheduler) else None
+        )
+        self.weight_decay = float(weight_decay or 0.0)
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._eager_state = None
+        self._accumulated_grads = None
+
+    # ------------------------------------------------------------------
+    # functional core
+    # ------------------------------------------------------------------
+    def init(self, params: Dict[str, jax.Array]):
+        state = {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {
+                name: self._init_slot(p) for name, p in params.items()
+            },
+        }
+        if self.multi_precision:
+            state["master"] = {
+                name: _to_f32(p)
+                for name, p in params.items()
+                if p.dtype in (jnp.bfloat16, jnp.float16)
+            }
+        return state
+
+    def _lr_value(self, step):
+        if self.lr_schedule is not None:
+            return self.lr_schedule(step)
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+    def update(self, grads, state, params, scale=None):
+        """One optimizer step. All-jnp; call inside jit.
+
+        ``scale``: optional gradient scale divisor (AMP GradScaler parity —
+        on TPU bf16 needs no loss scaling, but the hook exists).
+        """
+        step = state["step"] + 1
+        lr = self._lr_value(step)
+        if scale is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / scale, grads
+            )
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+
+        master = state.get("master", {})
+        new_params, new_slots, new_master = {}, {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                if name in master:
+                    new_master[name] = master[name]
+                continue
+            # fp32 math on the master copy (or the param itself if fp32)
+            pf = master.get(name, p).astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            decay = self.weight_decay
+            if decay and self.apply_decay_param_fun is not None:
+                if not self.apply_decay_param_fun(name):
+                    decay = 0.0
+            pf_new, slots_new = self._apply(
+                lr, step, name, pf, gf, state["slots"][name], decay
+            )
+            new_params[name] = pf_new.astype(p.dtype)
+            new_slots[name] = slots_new
+            if name in master:
+                new_master[name] = pf_new
+        new_state = {"step": step, "slots": new_slots}
+        if self.multi_precision:
+            new_state["master"] = new_master
+        return new_params, new_state
+
+    # subclass API ------------------------------------------------------
+    def _init_slot(self, p):
+        raise NotImplementedError
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # eager paddle-style API
+    # ------------------------------------------------------------------
+    def _eager_params(self) -> Dict[str, Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters=")
+        return {p.name: p for p in self._parameter_list if p.trainable}
+
+    def apply_gradients(self, grads: Dict[str, jax.Array]):
+        """Eagerly apply a {param_name: grad} dict to the held parameters."""
+        objs = self._eager_params()
+        params = {n: p.value for n, p in objs.items()}
+        if self._eager_state is None:
+            self._eager_state = self.init(params)
+        new_params, self._eager_state = self.update(
+            grads, self._eager_state, params
+        )
+        for n, p in objs.items():
+            p.value = new_params[n]
+
+    def step(self):
+        """Apply grads accumulated via ``set_gradients`` (or raise)."""
+        if self._accumulated_grads is None:
+            raise RuntimeError(
+                "no gradients: call opt.set_gradients(grads) first (grads "
+                "come from paddle_tpu.autograd.backward)"
+            )
+        self.apply_gradients(self._accumulated_grads)
+        self._accumulated_grads = None
+
+    def set_gradients(self, grads: Dict[str, jax.Array]):
+        self._accumulated_grads = grads
+
+    def clear_grad(self):
+        self._accumulated_grads = None
+
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler.get_lr()
+        return self.base_lr
+
+    def set_lr(self, lr: float):
+        self.base_lr = float(lr)
+        self.lr_schedule = None
+
+    def state_dict(self):
+        out = {"base_lr": self.base_lr}
+        if self._eager_state is not None:
+            out["state"] = self._eager_state
+        if self._lr_scheduler is not None:
+            out["lr_scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, d):
+        self.base_lr = d.get("base_lr", self.base_lr)
+        if "state" in d:
+            self._eager_state = d["state"]
+        if "lr_scheduler" in d and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(d["lr_scheduler"])
+
+
+class SGD(Optimizer):
+    def _init_slot(self, p):
+        return {}
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        return pf - lr * gf, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=0.0, grad_clip=None,
+                 multi_precision=True, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        v = self.momentum * slots["velocity"] + gf
+        if self.use_nesterov:
+            upd = gf + self.momentum * v
+        else:
+            upd = v
+        return pf - lr * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, lazy_mode=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {
+            "moment1": jnp.zeros(p.shape, jnp.float32),
+            "moment2": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _decoupled(self):
+        return False
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay and not self._decoupled():
+            gf = gf + decay * pf  # L2-style (Adam)
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * gf
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(self.beta1, stepf))
+        vhat = v / (1 - jnp.power(self.beta2, stepf))
+        upd = mhat / (jnp.sqrt(vhat) + self.epsilon)
+        if decay and self._decoupled():
+            upd = upd + decay * pf  # decoupled (AdamW)
+        return pf - lr * upd, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: paddle.optimizer.AdamW; phi
+    fused_adamw kernel semantics: decay applied decoupled, master weights
+    when multi_precision)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, multi_precision=True,
+                 apply_decay_param_fun=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision,
+                         apply_decay_param_fun=apply_decay_param_fun, **kw)
+
+    def _decoupled(self):
+        return True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _init_slot(self, p):
+        return {
+            "moment": jnp.full(p.shape, self.initial_accumulator_value, jnp.float32)
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        acc = slots["moment"] + jnp.square(gf)
+        return pf - lr * gf / (jnp.sqrt(acc) + self.epsilon), {"moment": acc}
+
+
+class Lamb(Optimizer):
+    """Parity: paddle.optimizer.Lamb (used by LARS/LAMB meta-optimizers)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, lamb_weight_decay=0.01,
+                 grad_clip=None, multi_precision=True,
+                 exclude_from_weight_decay_fn=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_from_weight_decay_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        return {
+            "moment1": jnp.zeros(p.shape, jnp.float32),
+            "moment2": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if self.exclude_from_weight_decay_fn is not None and \
+                self.exclude_from_weight_decay_fn(name):
+            decay = 0.0
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * gf
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(self.beta1, stepf))
+        vhat = v / (1 - jnp.power(self.beta2, stepf))
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + decay * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0
+        )
+        return pf - lr * trust * r, {"moment1": m, "moment2": v}

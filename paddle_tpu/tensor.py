@@ -1,0 +1,158 @@
+"""Tensor creation & math API (parity: python/paddle/tensor/).
+
+On TPU the tensor type IS ``jax.Array``; this module provides the
+paddle-flavored creation/math surface over jax.numpy. No wrapper class: a
+wrapper would break jax transforms and buy nothing — XLA is the dispatch
+layer that paddle's pybind/phi stack (paddle/fluid/pybind/,
+paddle/phi/api/) hand-builds on GPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core import dtype as dtype_mod
+from .core.parameter import Parameter
+
+
+def _v(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return jnp.asarray(_v(data), dtype=dt)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype_mod.convert_dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype_mod.convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype_mod.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(_v(x), dtype=dtype and dtype_mod.convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(_v(x), dtype=dtype and dtype_mod.convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(
+        _v(x), fill_value, dtype=dtype and dtype_mod.convert_dtype(dtype)
+    )
+
+
+def arange(start, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype and dtype_mod.convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=dtype and dtype_mod.convert_dtype(dtype))
+
+
+def eye(n, m=None, dtype=None):
+    return jnp.eye(n, m, dtype=dtype_mod.convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, dtype_mod.convert_dtype(dtype))
+
+
+# math — re-export the jnp surface with paddle names
+def _alias(fn):
+    def wrapped(*args, **kwargs):
+        args = tuple(_v(a) for a in args)
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+matmul = _alias(jnp.matmul)
+add = _alias(jnp.add)
+subtract = _alias(jnp.subtract)
+multiply = _alias(jnp.multiply)
+divide = _alias(jnp.divide)
+pow = _alias(jnp.power)  # noqa: A001
+sqrt = _alias(jnp.sqrt)
+rsqrt = _alias(jax.lax.rsqrt)
+exp = _alias(jnp.exp)
+log = _alias(jnp.log)
+abs = _alias(jnp.abs)  # noqa: A001
+mean = _alias(jnp.mean)
+sum = _alias(jnp.sum)  # noqa: A001
+max = _alias(jnp.max)  # noqa: A001
+min = _alias(jnp.min)  # noqa: A001
+argmax = _alias(jnp.argmax)
+argmin = _alias(jnp.argmin)
+maximum = _alias(jnp.maximum)
+minimum = _alias(jnp.minimum)
+clip = _alias(jnp.clip)
+reshape = _alias(jnp.reshape)
+transpose = _alias(jnp.transpose)
+squeeze = _alias(jnp.squeeze)
+unsqueeze = _alias(jnp.expand_dims)
+concat = _alias(jnp.concatenate)
+stack = _alias(jnp.stack)
+split = _alias(jnp.split)
+where = _alias(jnp.where)
+cast = _alias(lambda x, dtype: x.astype(dtype_mod.convert_dtype(dtype)))
+tanh = _alias(jnp.tanh)
+sin = _alias(jnp.sin)
+cos = _alias(jnp.cos)
+floor = _alias(jnp.floor)
+ceil = _alias(jnp.ceil)
+round = _alias(jnp.round)  # noqa: A001
+sign = _alias(jnp.sign)
+cumsum = _alias(jnp.cumsum)
+cumprod = _alias(jnp.cumprod)
+sort = _alias(jnp.sort)
+argsort = _alias(jnp.argsort)
+topk = _alias(jax.lax.top_k)
+gather = _alias(jnp.take)
+einsum = _alias(jnp.einsum)
+tril = _alias(jnp.tril)
+triu = _alias(jnp.triu)
+flatten = _alias(jnp.ravel)
+isnan = _alias(jnp.isnan)
+isinf = _alias(jnp.isinf)
+isfinite = _alias(jnp.isfinite)
+equal = _alias(jnp.equal)
+not_equal = _alias(jnp.not_equal)
+greater_than = _alias(jnp.greater)
+less_than = _alias(jnp.less)
+logical_and = _alias(jnp.logical_and)
+logical_or = _alias(jnp.logical_or)
+logical_not = _alias(jnp.logical_not)
+all = _alias(jnp.all)  # noqa: A001
+any = _alias(jnp.any)  # noqa: A001
+square = _alias(jnp.square)
+log_softmax = _alias(jax.nn.log_softmax)
+softmax = _alias(jax.nn.softmax)
+var = _alias(jnp.var)
+std = _alias(jnp.std)
+norm = _alias(jnp.linalg.norm)
+dot = _alias(jnp.dot)
+outer = _alias(jnp.outer)
+roll = _alias(jnp.roll)
+flip = _alias(jnp.flip)
+tile = _alias(jnp.tile)
+repeat_interleave = _alias(jnp.repeat)
+broadcast_to = _alias(jnp.broadcast_to)
+expand = _alias(jnp.broadcast_to)
+take_along_axis = _alias(jnp.take_along_axis)
+index_select = _alias(lambda x, index, axis=0: jnp.take(x, index, axis=axis))
+masked_select = _alias(lambda x, mask: x[mask])
+numel = _alias(jnp.size)
+diag = _alias(jnp.diag)

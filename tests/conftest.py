@@ -1,0 +1,28 @@
+"""Test env: force CPU backend with 8 virtual devices so every multi-chip
+sharding path runs on CI hardware (parity with the reference's
+Gloo-on-CPU + fake-mesh test strategy, SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# numerics tests compare against float64/float32 numpy references; pin
+# matmul precision (prod default stays bf16-on-MXU, the TPU analog of the
+# reference's TF32-on-A100 default)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+
+    pt.seed(2024)
+    yield

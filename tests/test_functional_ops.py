@@ -1,0 +1,138 @@
+"""Functional op numeric tests vs numpy references (parity model:
+upstream OpTest in test/legacy_test/op_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_layer_norm_vs_numpy():
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    w = np.random.randn(8).astype(np.float32)
+    b = np.random.randn(8).astype(np.float32)
+    y = F.layer_norm(jnp.asarray(x), (8,), jnp.asarray(w), jnp.asarray(b))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_vs_numpy():
+    x = np.random.randn(2, 4, 8).astype(np.float32)
+    w = np.random.randn(8).astype(np.float32)
+    y = F.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-6)
+    ref = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_vs_numpy():
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (6,))
+    loss = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([1, -100, 3, -100])
+    loss = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -(np.log(p[0, 1]) + np.log(p[2, 3])) / 2
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+def test_attention_causal():
+    q = np.random.randn(2, 6, 4, 8).astype(np.float32)
+    k = np.random.randn(2, 6, 4, 8).astype(np.float32)
+    v = np.random.randn(2, 6, 4, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), is_causal=True
+    )
+    # numpy reference
+    scale = 8**-0.5
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = np.tril(np.ones((6, 6), bool))
+    logits = np.where(mask, logits, -1e30)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_gqa():
+    """grouped-query attention: 8 q heads, 2 kv heads."""
+    q = np.random.randn(1, 4, 8, 16).astype(np.float32)
+    k = np.random.randn(1, 4, 2, 16).astype(np.float32)
+    v = np.random.randn(1, 4, 2, 16).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    )
+    assert out.shape == (1, 4, 8, 16)
+    # head 0..3 use kv head 0
+    k_rep = np.repeat(k, 4, axis=2)
+    v_rep = np.repeat(v, 4, axis=2)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k_rep) * 16**-0.5
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", probs, v_rep)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_vs_torch_style():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    y = F.conv2d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=1)
+    assert y.shape == (2, 4, 8, 8)
+    # center pixel check vs naive conv
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref00 = np.sum(xp[0, :, 3:6, 3:6] * w[1])
+    np.testing.assert_allclose(float(y[0, 1, 3, 3]), ref00, rtol=1e-4)
+
+
+def test_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    y = F.max_pool2d(jnp.asarray(x), 2)
+    np.testing.assert_allclose(
+        np.asarray(y)[0, 0], np.array([[5.0, 7.0], [13.0, 15.0]])
+    )
+    y = F.avg_pool2d(jnp.asarray(x), 2)
+    np.testing.assert_allclose(
+        np.asarray(y)[0, 0], np.array([[2.5, 4.5], [10.5, 12.5]])
+    )
+
+
+def test_activations_finite():
+    x = jnp.linspace(-5, 5, 11)
+    for name in ["relu", "gelu", "silu", "sigmoid", "tanh", "mish",
+                 "hardswish", "hardsigmoid", "softplus", "relu6"]:
+        y = getattr(F, name)(x)
+        assert bool(jnp.all(jnp.isfinite(y))), name
+
+
+def test_mha_layer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = jnp.ones((2, 5, 16))
+    y = mha(x)
+    assert y.shape == (2, 5, 16)
+
+
+def test_transformer_encoder_layer():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    layer.eval()
+    x = jnp.ones((2, 5, 16))
+    y = layer(x)
+    assert y.shape == (2, 5, 16)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    y = emb(jnp.asarray([[0, 1]]))
+    np.testing.assert_allclose(np.asarray(y[0, 0]), np.zeros(4))

@@ -1,0 +1,130 @@
+"""Layer system tests (parity model: upstream test/legacy_test layer
+tests + OpTest-style numpy cross-checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.core.functional import extract_params, functional_call
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_parameter_registration():
+    m = MLP()
+    names = dict(m.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    assert names["fc1.weight"].shape == (8, 16)
+    assert len(m.parameters()) == 4
+    assert len(m.sublayers()) == 3
+
+
+def test_forward_matches_numpy():
+    m = MLP()
+    x = np.random.randn(3, 8).astype(np.float32)
+    y = m(jnp.asarray(x))
+    w1 = np.asarray(m.fc1.weight.value)
+    b1 = np.asarray(m.fc1.bias.value)
+    w2 = np.asarray(m.fc2.weight.value)
+    b2 = np.asarray(m.fc2.bias.value)
+    ref = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    m1, m2 = MLP(), MLP()
+    sd = m1.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    missing, unexpected = m2.set_state_dict(sd)
+    assert not missing and not unexpected
+    x = jnp.ones((2, 8))
+    np.testing.assert_allclose(
+        np.asarray(m1(x)), np.asarray(m2(x)), rtol=1e-6
+    )
+
+
+def test_functional_call_pure():
+    m = MLP()
+    params = extract_params(m)
+    x = jnp.ones((2, 8))
+    eager = m(x)
+    fn = jax.jit(lambda p, x: functional_call(m, p, x))
+    jitted = fn(params, x)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), rtol=1e-5, atol=1e-6)
+    # grads flow
+    g = jax.grad(lambda p: functional_call(m, p, x).sum())(params)
+    assert set(g) == set(params)
+    assert g["fc1.weight"].shape == (8, 16)
+
+
+def test_hooks():
+    m = MLP()
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda layer, args: calls.append("pre"))
+    h2 = m.register_forward_post_hook(
+        lambda layer, args, out: calls.append("post")
+    )
+    m(jnp.ones((1, 8)))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    m(jnp.ones((1, 8)))
+    assert calls == []
+
+
+def test_train_eval_mode_dropout():
+    drop = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    pt.seed(0)
+    y = drop(x)
+    assert float(jnp.mean((np.asarray(y) == 0))) > 0.3
+    drop.eval()
+    y2 = drop(x)
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+
+
+def test_to_dtype_cast():
+    m = MLP()
+    m.to(pt.bfloat16)
+    assert m.fc1.weight.dtype == jnp.bfloat16
+    y = m(jnp.ones((2, 8), jnp.bfloat16))
+    assert y.dtype == jnp.bfloat16
+
+
+def test_buffers():
+    class WithBuf(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("running", jnp.zeros((3,)))
+            self.register_buffer("tmp", jnp.ones((2,)), persistable=False)
+
+        def forward(self, x):
+            return x + self.running[0]
+
+    m = WithBuf()
+    sd = m.state_dict()
+    assert "running" in sd and "tmp" not in sd
+
+
+def test_layerlist_sequential():
+    seq = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert len(seq) == 3
+    y = seq(jnp.ones((1, 4)))
+    assert y.shape == (1, 2)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
