@@ -1,0 +1,127 @@
+"""Benchmark: Llama pretraining step on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: tokens/sec/chip for a causal-LM train step (fwd+bwd+AdamW update,
+bf16 compute / fp32 master, ZeRO-3-equivalent sharding when >1 chip).
+vs_baseline: BASELINE.json has "published": {} (no reference numbers), so
+this reports the ratio against our own recorded first measurement when
+BENCH_BASELINE.json exists, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import amp, distributed as dist, optimizer as opt
+    from paddle_tpu.distributed.strategy import (
+        DistributedStrategy,
+        HybridConfig,
+    )
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.trainer import TrainStep
+
+    devices = jax.devices()
+    n = len(devices)
+    platform = devices[0].platform
+
+    # a ~350M-param Llama: big enough to be MXU-bound, small enough to
+    # fit one v5e chip with batch tokens that saturate it
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=1024,
+        intermediate_size=2816,
+        num_hidden_layers=16,
+        num_attention_heads=8,  # head_dim 128 → Pallas flash kernel
+        num_key_value_heads=8,
+        max_position_embeddings=2048,
+        use_flash_attention=True,
+        use_recompute=True,
+        dtype="bfloat16",
+    )
+    batch, seq = 4, 2048
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(pt.bfloat16)
+
+    optimizer = opt.AdamW(
+        learning_rate=3e-4, weight_decay=0.01, multi_precision=True,
+        grad_clip=opt.ClipGradByGlobalNorm(1.0),
+    )
+    strategy = DistributedStrategy()
+    if n > 1:
+        strategy.hybrid_configs = HybridConfig(sharding_degree=n)
+        strategy.sharding = True
+        strategy.sharding_configs.stage = 3
+        mesh = dist.build_mesh(fsdp=n, devices=devices)
+    else:
+        mesh = dist.build_mesh(devices=devices)
+
+    ts = TrainStep(model, optimizer, mesh, strategy)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    data = {"input_ids": ids, "labels": ids}
+
+    # warmup / compile
+    ts.run(data).block_until_ready()
+    ts.run(data).block_until_ready()
+
+    iters = 10
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = ts.run(data)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    tokens_per_sec_chip = tokens_per_sec / n
+
+    # MFU: 6*N_params*tokens/sec vs peak flops (v5e bf16 ~197 TF/s/chip)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in model.parameters()
+    )
+    model_flops = 6 * n_params * tokens_per_sec_chip
+    peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 197e12)
+    mfu = model_flops / peak
+
+    vs = 1.0
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as f:
+                vs = tokens_per_sec_chip / float(json.load(f)["value"])
+        except Exception:
+            vs = 1.0
+
+    result = {
+        "metric": "llama350m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+        "extra": {
+            "n_chips": n,
+            "platform": platform,
+            "params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "step_ms": round(1000 * dt / iters, 2),
+            "mfu_est": round(mfu, 4),
+            "loss": float(loss),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -86,8 +86,7 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     single_opt = not isinstance(optimizers, (list, tuple))
     opt_list = [optimizers] if single_opt else list(optimizers)
     for o in opt_list:
-        if master_weight is not False:
-            o.multi_precision = True
+        o.multi_precision = master_weight is not False
     return (
         models if single_model else model_list,
         optimizers if single_opt else opt_list,

@@ -61,6 +61,10 @@ class Layer:
             self.__dict__.pop(name, None)
             self._parameters.pop(name, None)
             self._sub_layers[name] = value
+        elif name in self.__dict__.get("_buffers", ()):
+            # assignment to a registered buffer updates the buffer store so
+            # state_dict/functional binding keep seeing the live value
+            self._buffers[name] = None if value is None else jnp.asarray(value)
         else:
             self._parameters.pop(name, None)
             self._sub_layers.pop(name, None)

@@ -67,11 +67,20 @@ class _RngFrame:
     def next_key(self, tag: str) -> jax.Array:
         if tag in self.keys:
             base = self.keys[tag]
-        elif "default" in self.keys:
-            base = self.keys["default"]
         else:
-            # fall back to any stream deterministically
-            base = next(iter(self.keys.values()))
+            if "default" in self.keys:
+                base = self.keys["default"]
+            else:
+                # fall back to any stream deterministically
+                base = next(iter(self.keys.values()))
+            # decorrelate tags sharing a fallback base: fold a stable tag
+            # hash in before the per-tag counter (zlib.crc32 — str hash()
+            # is salted per process)
+            import zlib
+
+            base = jax.random.fold_in(
+                base, zlib.crc32(tag.encode()) & 0x7FFFFFFF
+            )
         c = self.counters.get(tag, 0)
         self.counters[tag] = c + 1
         return jax.random.fold_in(base, c)

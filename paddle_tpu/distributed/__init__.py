@@ -1,0 +1,70 @@
+"""paddle_tpu.distributed — the hybrid-parallel engine.
+
+Parity: python/paddle/distributed/ (fleet, collective API, auto_parallel,
+launch) re-expressed as mesh + GSPMD shardings (see SURVEY.md §5
+"Distributed communication backend" for the mapping rationale).
+"""
+
+from . import parallel_layers  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_placements,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    reduce_scatter,
+)
+from . import checkpoint  # noqa: F401
+from .env import (  # noqa: F401
+    device_count,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    local_device_count,
+)
+from .moe import MoELayer  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .sharding import (  # noqa: F401
+    batch_spec,
+    model_shardings,
+    opt_state_shardings,
+    param_partition_spec,
+    place_params_on_mesh,
+    sequence_parallel_constraint,
+    shard_activation,
+)
+from .strategy import (  # noqa: F401
+    AmpConfig,
+    DistributedStrategy,
+    HybridConfig,
+    MoEConfig,
+    PipelineConfig,
+    RecomputeConfig,
+    ShardingConfig,
+)
+from .topology import (  # noqa: F401
+    HybridCommunicateGroup,
+    build_mesh,
+    fleet_init,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)

@@ -1,0 +1,195 @@
+"""Distributed sharded checkpoint with cross-topology reshard-on-load.
+
+Parity: python/paddle/distributed/checkpoint/save_state_dict.py /
+load_state_dict.py — each rank writes its local shards plus a global
+metadata file recording distribution info; load reassembles slices for a
+*different* topology (SURVEY.md §5 "Checkpoint / resume").
+
+TPU-native layout: one directory per checkpoint;
+  metadata.json                 — {name: {shape, dtype, chunks:[{offset,
+                                   shape, file}]}}
+  chunk files (.npy)            — unique shard payloads (replicas deduped
+                                   by offset key)
+Load path: ``jax.make_array_from_callback`` asks for exactly the slice
+each target device needs; the reader assembles it from overlapping saved
+chunks — resharding from any source topology to any target topology
+without ever materializing full tensors on one host (chunks are read via
+np.load mmap).
+
+Multi-host: each process writes only shards it owns (addressable) whose
+first-replica device belongs to it; rank 0 merges metadata (single-host
+dev boxes write everything directly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _chunk_filename(name: str, offset) -> str:
+    off = "_".join(str(o) for o in offset) if offset else "scalar"
+    safe = name.replace("/", "__").replace(".", "_")
+    return f"{safe}__{off}.npy"
+
+
+def save_state_dict(state_dict: Dict[str, jax.Array], path: str) -> None:
+    """Save a flat {name: jax.Array} dict (values may be sharded global
+    arrays)."""
+    os.makedirs(path, exist_ok=True)
+    meta = {}
+    pid = jax.process_index()
+    for name, arr in state_dict.items():
+        arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
+        entry = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "chunks": [],
+        }
+        seen_offsets = set()
+        for shard in arr.addressable_shards:
+            idx = shard.index  # tuple of slices into the global shape
+            offset = tuple(
+                (s.start or 0) for s in idx
+            ) if arr.ndim else ()
+            if offset in seen_offsets:
+                continue  # replica of a chunk we already wrote
+            seen_offsets.add(offset)
+            # in multi-host, only the process owning the first replica of
+            # this chunk writes it
+            if shard.replica_id != 0:
+                continue
+            fname = _chunk_filename(name, offset)
+            data = np.asarray(shard.data)
+            if str(data.dtype) == "bfloat16":
+                # numpy can't serialize ml_dtypes natively; store raw bits
+                data = data.view(np.uint16)
+            np.save(os.path.join(path, fname), data)
+            entry["chunks"].append({
+                "offset": list(offset),
+                "shape": list(shard.data.shape),
+                "file": fname,
+            })
+        meta[name] = entry
+    meta_file = os.path.join(path, f"metadata_{pid}.json")
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+    # merge per-process metadata (rank 0; trivially itself single-host)
+    if pid == 0:
+        merged: Dict = {}
+        for fn in sorted(os.listdir(path)):
+            if fn.startswith("metadata_") and fn.endswith(".json"):
+                with open(os.path.join(path, fn)) as f:
+                    part = json.load(f)
+                for k, v in part.items():
+                    if k not in merged:
+                        merged[k] = v
+                    else:
+                        have = {tuple(c["offset"]) for c in merged[k]["chunks"]}
+                        for c in v["chunks"]:
+                            if tuple(c["offset"]) not in have:
+                                merged[k]["chunks"].append(c)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(merged, f, indent=1)
+
+
+class _ChunkReader:
+    def __init__(self, path: str, entry: dict):
+        self.path = path
+        self.entry = entry
+
+    def read_slice(self, index) -> np.ndarray:
+        """Assemble global[index] from saved chunks (mmap'd reads)."""
+        shape = self.entry["shape"]
+        is_bf16 = self.entry["dtype"] == "bfloat16"
+        if is_bf16:
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = np.dtype(self.entry["dtype"])
+        starts = [(s.start or 0) for s in index] if shape else []
+        stops = [
+            (s.stop if s.stop is not None else dim)
+            for s, dim in zip(index, shape)
+        ]
+        out_shape = [b - a for a, b in zip(starts, stops)]
+        out = np.zeros(out_shape, dtype)
+        for c in self.entry["chunks"]:
+            coff, cshape = c["offset"], c["shape"]
+            # overlap of [starts, stops) with [coff, coff+cshape)
+            lo = [max(a, o) for a, o in zip(starts, coff)]
+            hi = [min(b, o + s) for b, o, s in zip(stops, coff, cshape)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            data = np.load(os.path.join(self.path, c["file"]),
+                           mmap_mode="r", allow_pickle=False)
+            src = tuple(
+                slice(l - o, h - o) for l, o, h in zip(lo, coff, hi)
+            )
+            dst = tuple(
+                slice(l - a, h - a) for l, a, h in zip(lo, starts, hi)
+            )
+            piece = np.asarray(data[src])
+            if is_bf16:
+                piece = piece.view(dtype)
+            out[dst] = piece
+        return out
+
+
+def load_state_dict(
+    path: str,
+    target: Optional[Dict[str, jax.Array]] = None,
+    shardings: Optional[Dict] = None,
+) -> Dict[str, jax.Array]:
+    """Load a checkpoint, resharding to the requested layout.
+
+    ``target``: {name: existing array} — layouts (shardings) are taken
+    from it. Or pass ``shardings`` {name: Sharding} directly. With
+    neither, arrays load replicated on the default device.
+    """
+    import jax.numpy as jnp
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    out = {}
+    for name, entry in meta.items():
+        reader = _ChunkReader(path, entry)
+        shape = tuple(entry["shape"])
+        dtype = jnp.dtype(entry["dtype"])
+        sharding = None
+        if shardings and name in shardings:
+            sharding = shardings[name]
+        elif target is not None and name in target:
+            sharding = target[name].sharding
+        if sharding is None:
+            full = reader.read_slice(
+                tuple(slice(0, s) for s in shape)
+            )
+            out[name] = jnp.asarray(full).astype(dtype)
+        else:
+            arr = jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, r=reader, dt=dtype: r.read_slice(idx).astype(dt),
+            )
+            out[name] = arr
+    return out
+
+
+def save_model(model, path: str):
+    save_state_dict(dict(model.state_dict()), path)
+
+
+def load_model(model, path: str):
+    params = dict(model.named_parameters())
+    shardings = {
+        n: p.value.sharding for n, p in params.items()
+        if isinstance(p.value, jax.Array)
+    }
+    loaded = load_state_dict(path, shardings=shardings)
+    model.set_state_dict(loaded)
+    return model

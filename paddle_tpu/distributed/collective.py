@@ -1,0 +1,214 @@
+"""Collective communication API.
+
+Parity: python/paddle/distributed/communication/ (all_reduce, all_gather,
+reduce_scatter, alltoall, broadcast, send/recv, barrier) over
+ProcessGroupNCCL (paddle/fluid/distributed/collective/).
+
+TPU-native: there is no userspace NCCL to wrap. Tensor-traffic
+collectives are XLA HLO ops emitted *inside* compiled programs — either
+implicitly by GSPMD or explicitly via ``jax.lax.p*`` under ``shard_map``.
+This module provides:
+  1. in-jit functions (psum/all_gather/...) usable inside shard_map'ed
+     code, matching paddle.distributed call signatures; and
+  2. eager wrappers that shard_map a single collective over the active
+     mesh — the moral equivalent of a one-op NCCL launch, used by tests
+     and host-side logic (and by checkpoint barriers).
+Host-level coordination (the reference's TCPStore) is
+``jax.distributed``'s builtin store; see env.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .topology import get_hybrid_communicate_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+# ---------------------------------------------------------------------------
+# in-jit collectives (call inside shard_map with a named axis)
+# ---------------------------------------------------------------------------
+def all_reduce_in(x, op: str = ReduceOp.SUM, axis: str = "dp"):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+    raise ValueError(op)
+
+
+def all_gather_in(x, axis: str = "dp", tiled_dim: int = 0):
+    return jax.lax.all_gather(x, axis, axis=tiled_dim, tiled=True)
+
+
+def reduce_scatter_in(x, axis: str = "dp", scatter_dim: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+
+def all_to_all_in(x, axis: str = "sep", split_dim: int = 0, concat_dim: int = 0):
+    return jax.lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+
+def ppermute_in(x, axis: str, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# eager wrappers over the active mesh
+# ---------------------------------------------------------------------------
+def _active_mesh() -> Mesh:
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError(
+            "no active mesh: call distributed.init_parallel_env() / "
+            "fleet_init first"
+        )
+    return hcg.mesh
+
+
+def _group_axis(group) -> str:
+    if group is None:
+        return "dp"
+    if isinstance(group, str):
+        return group
+    return group.axis
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, mesh: Optional[Mesh] = None):
+    """Eager allreduce over one mesh axis. The input is interpreted as
+    *already sharded* along that axis (dim 0 carries the per-rank data in
+    the reference's SPMD model)."""
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    other = tuple(a for a in mesh.axis_names if a != axis)
+    spec = P(axis)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+        check_vma=False,
+    )
+    def f(x):
+        return all_reduce_in(x, op, axis)
+
+    return f(tensor)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, mesh=None):
+    """paddle signature: all_gather(out_list, tensor). Returns the list of
+    per-rank pieces; also supports functional use all_gather(tensor)."""
+    if isinstance(tensor_or_list, list):
+        out_list, x = tensor_or_list, tensor
+    else:
+        out_list, x = None, tensor_or_list
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    n = mesh.shape[axis]
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(xs):
+        return all_gather_in(xs, axis, 0)
+
+    stacked = f(x)
+    if out_list is not None:
+        per = stacked.shape[0] // n
+        chunks = [stacked[i * per:(i + 1) * per] for i in range(n)]
+        out_list.extend(chunks)
+        return out_list
+    return stacked
+
+
+def reduce_scatter(tensor, group=None, op=ReduceOp.SUM, mesh=None):
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(x):
+        return reduce_scatter_in(x, axis, 0)
+
+    return f(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, mesh=None):
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    x = (
+        jnp.concatenate(in_tensor_list, axis=0)
+        if isinstance(in_tensor_list, (list, tuple))
+        else in_tensor_list
+    )
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(x):
+        return all_to_all_in(x, axis, 0, 0)
+
+    out = f(x)
+    if out_tensor_list is not None:
+        n = mesh.shape[axis]
+        per = out.shape[0] // n
+        out_tensor_list.extend(
+            out[i * per:(i + 1) * per] for i in range(n)
+        )
+        return out_tensor_list
+    return out
+
+
+def broadcast(tensor, src: int = 0, group=None, mesh=None):
+    """Replicate src rank's shard to all ranks along the axis."""
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    n = mesh.shape[axis]
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(x):
+        full = all_gather_in(x, axis, 0)
+        per = full.shape[0] // n
+        piece = jax.lax.dynamic_slice_in_dim(full, src * per, per, 0)
+        return piece
+
+    return f(tensor)
+
+
+def barrier(group=None):
+    """Host barrier: a trivial device allreduce forces synchronization."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return
+    x = jnp.ones((hcg.mesh.devices.size,), jnp.int32)
+    all_reduce(x, mesh=hcg.mesh, group="dp") if "dp" in hcg.mesh.axis_names \
+        else None

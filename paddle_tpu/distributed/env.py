@@ -1,0 +1,73 @@
+"""Process-level distributed environment.
+
+Parity: paddle.distributed.init_parallel_env / get_rank / get_world_size
+(python/paddle/distributed/parallel.py) and the C++ TCPStore rendezvous
+(paddle/phi/core/distributed/store/tcp_store.cc).
+
+TPU-native: ``jax.distributed.initialize`` provides the coordination
+service (its coordinator IS the TCP store) and device visibility across
+hosts; per-tensor traffic never touches it. Single-process multi-device
+(one host, 4–8 TPU chips, or a CPU mesh in tests) needs no init at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX. Env parity: PADDLE_MASTER /
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID (set by the launch CLI) are
+    honored alongside the standard JAX coordinator variables."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_MASTER"
+    ) or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = num_processes or int(
+        os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("NPROC", "1"))
+    )
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PROC_ID", "0"))
+    )
+    if num_processes > 1 and coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _initialized = True
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

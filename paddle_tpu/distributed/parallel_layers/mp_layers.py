@@ -1,0 +1,162 @@
+"""Tensor-parallel layers.
+
+Parity: fleet/meta_parallel/parallel_layers/mp_layers.py —
+VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+ParallelCrossEntropy — and mp_ops.py's identity/allreduce autograd ops.
+
+TPU-native inversion: the reference materializes *local* shards
+([in, out/tp] weights) and calls collectives by hand (allreduce in row
+forward, identity/allreduce pairs for backward). Here every layer keeps
+the *global* logical shape and only annotates ``Parameter.spec``; GSPMD
+partitions the matmul and inserts the exact same collectives (it derives
+the allreduce a row-parallel matmul needs from the contracted-dim
+sharding). ``gather_output`` / ``input_is_parallel`` become activation
+sharding constraints.
+
+This is why there is no mp_ops.py here: `_c_identity`/`_c_allreduce`
+pairs are compiler output, not user code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core import initializer as I
+from ...core.module import Layer
+from ...nn import functional as F
+from ..sharding import shard_activation
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] with the out dim sharded over "tp".
+
+    gather_output=False leaves activations sharded over tp (feeding a
+    RowParallelLinear); True constrains the output replicated.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        gather_output: bool = False,
+        fuse_matmul_bias: bool = False,
+        name=None,
+    ):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features),
+            default_initializer=weight_attr,
+            spec=(None, "tp"),
+        )
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True, spec=("tp",)
+            )
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = shard_activation(y, ("dp", "fsdp"), *([None] * (y.ndim - 1)))
+        else:
+            y = shard_activation(
+                y, ("dp", "fsdp"), *([None] * (y.ndim - 2)), "tp"
+            )
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] with the in (contracted) dim sharded over "tp" —
+    GSPMD emits the partial-sum allreduce the reference codes by hand."""
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        input_is_parallel: bool = True,
+        fuse_matmul_bias: bool = False,
+        name=None,
+    ):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features),
+            default_initializer=weight_attr,
+            spec=("tp", None),
+        )
+        self.weight.is_distributed = True
+        if has_bias:
+            # bias is applied after the reduce → replicated (parity: row
+            # linear adds bias on the full output)
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_activation(
+                x, ("dp", "fsdp"), *([None] * (x.ndim - 2)), "tp"
+            )
+        y = F.linear(x, self.weight, None)
+        y = shard_activation(y, ("dp", "fsdp"), *([None] * (y.ndim - 1)))
+        if self.bias is not None:
+            y = y + self.bias.value
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over "tp"."""
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        weight_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            default_initializer=weight_attr or I.Normal(0.0, 0.02),
+            spec=("tp", None),
+        )
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        y = F.embedding(x, self.weight)
+        return shard_activation(y, ("dp", "fsdp"), *([None] * (y.ndim - 2)), None)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over tp-sharded logits (parity:
+    mp_ops._c_softmax_with_cross_entropy): constrain the vocab dim sharded
+    so the softmax reductions become tp-axis collectives instead of a
+    logits all-gather."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = shard_activation(
+            logits, ("dp", "fsdp"), *([None] * (logits.ndim - 2)), "tp"
+        )
+        return F.cross_entropy(
+            logits, label, ignore_index=self.ignore_index, reduction="none"
+        )

@@ -1,0 +1,156 @@
+"""DistributedStrategy (parity: paddle.distributed.fleet.DistributedStrategy,
+backed upstream by paddle/fluid/framework/distributed_strategy.proto).
+
+A serializable dataclass holding every distributed knob. The axis order of
+the hybrid mesh follows Fleet's HybridCommunicateGroup convention
+[dp, pp, sharding, sep, mp] (fleet/base/topology.py) — outermost axes get
+the slowest-varying device stride, which on TPU maps dp/pp across hosts
+(DCN) and sharding/sep/mp within a slice (ICI), the layout that keeps
+high-traffic collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1  # tensor parallel
+    pp_degree: int = 1  # pipeline parallel
+    sharding_degree: int = 1  # ZeRO/FSDP axis
+    sep_degree: int = 1  # Ulysses-style sequence/segment parallel
+    ep_degree: int = 1  # expert parallel (MoE); reuses sharding×sep devices
+    cp_degree: int = 1  # ring-attention context parallel (alias onto sep axis
+    # when both requested is unsupported)
+
+    def total(self) -> int:
+        return (
+            self.dp_degree
+            * self.mp_degree
+            * self.pp_degree
+            * self.sharding_degree
+            * self.sep_degree
+            * self.cp_degree
+        )
+
+
+@dataclasses.dataclass
+class ShardingConfig:
+    """Parity: DistributedStrategy.sharding_configs."""
+
+    stage: int = 1  # 1: opt states, 2: +grads, 3: +params
+    degree: int = 8
+    offload: bool = False
+    comm_overlap: bool = True
+
+
+@dataclasses.dataclass
+class RecomputeConfig:
+    enable: bool = False
+    # jax.checkpoint policy name: "full", "dots_saveable",
+    # "nothing_saveable", "dots_with_no_batch_dims_saveable"
+    policy: str = "dots_with_no_batch_dims_saveable"
+    checkpoint_layers: Optional[list] = None
+
+
+@dataclasses.dataclass
+class AmpConfig:
+    enable: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O2"
+    init_loss_scaling: float = 32768.0
+    use_dynamic_loss_scaling: bool = False  # bf16: off
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # or "F-then-B", "VPP"
+    vpp_degree: int = 1
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    gate: str = "gshard"  # gshard | switch | naive
+    aux_loss_weight: float = 0.01
+
+
+@dataclasses.dataclass
+class DistributedStrategy:
+    hybrid_configs: HybridConfig = dataclasses.field(default_factory=HybridConfig)
+    sharding_configs: ShardingConfig = dataclasses.field(default_factory=ShardingConfig)
+    recompute_configs: RecomputeConfig = dataclasses.field(default_factory=RecomputeConfig)
+    amp_configs: AmpConfig = dataclasses.field(default_factory=AmpConfig)
+    pipeline_configs: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    moe_configs: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    sharding: bool = False
+    recompute: bool = False
+    amp: bool = False
+    pipeline: bool = False
+    gradient_merge: bool = False
+    gradient_merge_k_steps: int = 1
+    find_unused_parameters: bool = False
+    fuse_grad_size_in_MB: int = 32  # parity knob; XLA fuses regardless
+
+    # ------------------------------------------------------------------
+    def serialize(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def deserialize(cls, text: str) -> "DistributedStrategy":
+        raw = json.loads(text)
+
+        def build(klass, d):
+            fields = {f.name: f for f in dataclasses.fields(klass)}
+            kwargs = {}
+            for k, val in d.items():
+                if k not in fields:
+                    continue
+                ft = fields[k].type
+                sub = {
+                    "HybridConfig": HybridConfig,
+                    "ShardingConfig": ShardingConfig,
+                    "RecomputeConfig": RecomputeConfig,
+                    "AmpConfig": AmpConfig,
+                    "PipelineConfig": PipelineConfig,
+                    "MoEConfig": MoEConfig,
+                }.get(ft if isinstance(ft, str) else getattr(ft, "__name__", ""))
+                kwargs[k] = build(sub, val) if sub and isinstance(val, dict) else val
+            return klass(**kwargs)
+
+        return build(cls, raw)
+
+    # convenience used throughout the sharding engine
+    @property
+    def tp(self) -> int:
+        return self.hybrid_configs.mp_degree
+
+    @property
+    def dp(self) -> int:
+        return self.hybrid_configs.dp_degree
+
+    @property
+    def pp(self) -> int:
+        return self.hybrid_configs.pp_degree
+
+    @property
+    def fsdp(self) -> int:
+        return self.hybrid_configs.sharding_degree
+
+    @property
+    def sep(self) -> int:
+        return self.hybrid_configs.sep_degree
+
+    @property
+    def sharding_stage(self) -> int:
+        return self.sharding_configs.stage if (
+            self.sharding or self.hybrid_configs.sharding_degree > 1
+        ) else 0

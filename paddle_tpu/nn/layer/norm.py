@@ -109,20 +109,22 @@ class BatchNorm2D(Layer):
         c_axis = 1 if self.data_format == "NCHW" else -1
         axes = tuple(i for i in range(x.ndim) if i != (c_axis % x.ndim))
         if self.training:
+            import jax.core
+
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
             var = jnp.var(xf, axis=axes)
-            try:
-                # eager: update running stats
+            if not isinstance(mean, jax.core.Tracer):
+                # eager only: under jit the running stats stay frozen so no
+                # tracer leaks into the buffers
                 self._buffers["_mean"] = (
-                    self.momentum * self._buffers["_mean"] + (1 - self.momentum) * mean
+                    self.momentum * self._buffers["_mean"]
+                    + (1 - self.momentum) * mean
                 )
                 self._buffers["_variance"] = (
                     self.momentum * self._buffers["_variance"]
                     + (1 - self.momentum) * var
                 )
-            except Exception:
-                pass
         else:
             mean = self._buffers["_mean"]
             var = self._buffers["_variance"]

@@ -43,18 +43,27 @@ class MultiHeadAttention(Layer):
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        """cache: optional (k_prev, v_prev) with layout [b, s, h, d]
+        (parity: paddle MHA Cache for incremental decoding) — current k/v
+        are appended and the updated cache returned alongside the output."""
         key = query if key is None else key
         value = query if value is None else value
         b, sq, _ = query.shape
         q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
         k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
         v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+        if cache is not None:
+            k_prev, v_prev = cache
+            k = jnp.concatenate([k_prev, k], axis=1)
+            v = jnp.concatenate([v_prev, v], axis=1)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
             training=self.training,
         )
-        out = out.reshape(b, sq, self.embed_dim)
-        return self.out_proj(out)
+        out = self.out_proj(out.reshape(b, sq, self.embed_dim))
+        if cache is not None:
+            return out, (k, v)
+        return out
 
 
 class TransformerEncoderLayer(Layer):

@@ -120,7 +120,7 @@ cumprod = _alias(jnp.cumprod)
 sort = _alias(jnp.sort)
 argsort = _alias(jnp.argsort)
 topk = _alias(jax.lax.top_k)
-gather = _alias(jnp.take)
+gather = _alias(lambda x, index, axis=0: jnp.take(x, index, axis=axis))
 einsum = _alias(jnp.einsum)
 tril = _alias(jnp.tril)
 triu = _alias(jnp.triu)

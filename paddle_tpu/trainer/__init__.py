@@ -1,0 +1,1 @@
+from .train_step import TrainStep, build_train_step  # noqa: F401

@@ -1,23 +1,30 @@
 """Test env: force CPU backend with 8 virtual devices so every multi-chip
 sharding path runs on CI hardware (parity with the reference's
-Gloo-on-CPU + fake-mesh test strategy, SURVEY.md §4)."""
+Gloo-on-CPU + fake-mesh test strategy, SURVEY.md §4).
+
+Note: this sandbox pre-imports jax via sitecustomize with
+JAX_PLATFORMS=axon (the real TPU tunnel), so the platform must be
+overridden through jax.config *before first backend use*, not via env.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
-import pytest  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 # numerics tests compare against float64/float32 numpy references; pin
 # matmul precision (prod default stays bf16-on-MXU, the TPU analog of the
 # reference's TF32-on-A100 default)
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
@@ -26,3 +33,7 @@ def _seed():
 
     pt.seed(2024)
     yield
+    # don't leak the global mesh/HCG between tests
+    from paddle_tpu.distributed import topology
+
+    topology._global_hcg = None
