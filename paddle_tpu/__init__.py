@@ -11,6 +11,12 @@ and the host-side runtime (trainer, data, checkpoint, launch, profiler).
 """
 
 from . import amp  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import flags  # noqa: F401
+from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
+from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .core import dtype as _dtype_mod

@@ -1,0 +1,165 @@
+"""Launch CLI (parity: python -m paddle.distributed.launch —
+python/paddle/distributed/launch/: Context arg/env parsing,
+CollectiveController building a Job of Pod/Containers, per-rank process
+supervision with log capture, master rendezvous).
+
+TPU-native: on TPU pods there is one process per host (not per chip), and
+``jax.distributed`` handles rendezvous via the coordinator address. The
+controller therefore launches ``nproc_per_node`` worker processes (>1
+only for CPU/debug meshes), wires the PADDLE_* env contract the rest of
+the framework reads (env.py), captures per-rank logs to
+``log/workerlog.N``, supervises exits, and — with ``--elastic`` — re-spawns
+failed workers so training resumes from the latest checkpoint
+(checkpoint-resume recovery, the reference's elastic semantics with etcd
+replaced by the coordinator; SURVEY.md §5 "Failure detection").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+class Container:
+    def __init__(self, rank: int, cmd: List[str], env: dict, log_dir: str):
+        self.rank = rank
+        self.cmd = cmd
+        self.env = env
+        self.log_dir = log_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_file = None
+
+    def start(self):
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, f"workerlog.{self.rank}")
+        self.log_file = open(path, "ab")
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=self.log_file,
+            stderr=subprocess.STDOUT,
+        )
+        return self.proc
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.log_file:
+            self.log_file.close()
+
+
+class CollectiveController:
+    def __init__(self, args, extra: List[str]):
+        self.args = args
+        self.extra = extra
+        self.containers: List[Container] = []
+
+    def build(self):
+        nproc = self.args.nproc_per_node
+        master = self.args.master or "127.0.0.1:49175"
+        node_rank = self.args.node_rank
+        nnodes = self.args.nnodes
+        for local_rank in range(nproc):
+            rank = node_rank * nproc + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nnodes * nproc),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_MASTER": master,
+                "COORDINATOR_ADDRESS": master,
+            })
+            if self.args.devices:
+                env["CUDA_VISIBLE_DEVICES"] = self.args.devices
+            cmd = [sys.executable] + self.extra
+            self.containers.append(
+                Container(rank, cmd, env, self.args.log_dir)
+            )
+        return self
+
+    def run(self) -> int:
+        for c in self.containers:
+            c.start()
+        print(
+            f"launched {len(self.containers)} worker(s); logs in "
+            f"{self.args.log_dir}/workerlog.N"
+        )
+        restarts = 0
+        try:
+            while True:
+                statuses = [c.poll() for c in self.containers]
+                if all(s == 0 for s in statuses):
+                    return 0
+                failed = [
+                    (i, s) for i, s in enumerate(statuses)
+                    if s not in (None, 0)
+                ]
+                if failed:
+                    if (self.args.elastic
+                            and restarts < self.args.max_restarts):
+                        restarts += 1
+                        print(
+                            f"worker(s) {[i for i, _ in failed]} failed; "
+                            f"elastic restart {restarts}/"
+                            f"{self.args.max_restarts}"
+                        )
+                        for c in self.containers:
+                            c.terminate()
+                        for c in self.containers:
+                            c.start()
+                    else:
+                        print(
+                            f"worker(s) failed with {failed}; tearing down"
+                        )
+                        for c in self.containers:
+                            c.terminate()
+                        return 1
+                time.sleep(self.args.poll_interval)
+        except KeyboardInterrupt:
+            for c in self.containers:
+                c.terminate()
+            return 130
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="multi-process / multi-host job launcher",
+    )
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"))
+    p.add_argument("--devices", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart failed workers (checkpoint-resume)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--poll_interval", type=float, default=1.0)
+    p.add_argument("training_script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    extra = [args.training_script] + list(args.script_args)
+    controller = CollectiveController(args, extra).build()
+    return controller.run()

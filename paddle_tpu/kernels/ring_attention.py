@@ -1,0 +1,131 @@
+"""Ring attention — context parallelism over the sequence dim.
+
+Parity: PaddleNLP's RingFlashAttention (context_parallel_degree): KV
+blocks rotate around the ring of sequence-parallel ranks via p2p while
+queries stay resident, with online-softmax merging of per-block results
+(SURVEY.md §5 "Long-context").
+
+TPU-native: the ring is a ``shard_map`` over the "sep" axis with
+``jax.lax.ppermute`` KV rotation — which XLA lowers to collective-permute
+over ICI, overlapped with the per-block attention compute. Per-block
+attention + the (m, l, acc) merge are the same online-softmax algebra as
+the Pallas flash kernel; block results are merged with logsumexp
+renormalization. Causal load-balancing: block (src > my) contributes
+nothing and is skipped via masking, src == my is locally causal, src < my
+is unmasked. Backward is jax autodiff through the scan+ppermute (the
+reverse ring). A fully fused Pallas ring kernel (RDMA inside the kernel,
+pallas_guide.md "Ring Collectives") is the planned upgrade; this
+formulation is already communication-optimal in volume.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, is_diag):
+    """Attention of local q against one rotating kv block, returning
+    (numerator [.., d], running max m, denom l) pieces in fp32.
+
+    ``is_diag`` is a traced bool: on the diagonal block the local causal
+    mask applies (one score einsum either way — the mask is selected, not
+    the computation). q: [b, sq, h, d]; k,v: [b, sk, h, d].
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    causal_ok = (qi >= ki)[None, None]
+    keep = jnp.logical_or(jnp.logical_not(is_diag), causal_ok)
+    s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m, l
+
+
+def ring_attention(
+    q, k, v,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sep",
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """q,k,v: [batch, seq, heads, head_dim] — global shapes with the seq
+    dim sharded over ``axis``. Returns attention output with the same
+    sharding. Chunks are assigned in ring order (rank i holds contiguous
+    chunk i), so causal masking is by chunk index."""
+    from ..distributed.sharding import current_mesh
+
+    mesh = mesh or current_mesh()
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else d ** -0.5
+    n = mesh.shape[axis]
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    def local(qc, kc, vc):
+        my = jax.lax.axis_index(axis)
+
+        def step(carry, i):
+            k_blk, v_blk, m, l, acc = carry
+            src = (my - i) % n  # whose chunk we currently hold
+            if causal:
+                is_diag = src == my
+                o_b, m_b, l_b = _block_attn(qc, k_blk, v_blk, scale_, is_diag)
+                # skip blocks from the future
+                use = src <= my
+                m_b = jnp.where(use, m_b, NEG_INF)
+                l_b = jnp.where(use, l_b, 0.0)
+                o_b = jnp.where(use, o_b, 0.0)
+            else:
+                o_b, m_b, l_b = _block_attn(
+                    qc, k_blk, v_blk, scale_, jnp.bool_(False)
+                )
+            # online-softmax merge
+            m_new = jnp.maximum(m, m_b)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_b - m_new)
+            l_new = l * alpha + l_b * beta
+            acc_new = acc * alpha + o_b * beta
+            # rotate kv to the next rank (ring)
+            perm = [(r, (r + 1) % n) for r in range(n)]
+            k_nxt = jax.lax.ppermute(k_blk, axis, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis, perm)
+            return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+        b, sq, h, _ = qc.shape
+        vary = lambda x: jax.lax.pcast(x, axis, to="varying")  # noqa: E731
+        m0 = vary(jnp.full((b, h, sq, 1), NEG_INF, jnp.float32))
+        l0 = vary(jnp.zeros((b, h, sq, 1), jnp.float32))
+        acc0 = vary(jnp.zeros((b, h, sq, d), jnp.float32))
+        (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+            step, (kc, vc, m0, l0, acc0), jnp.arange(n)
+        )
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc / l).astype(qc.dtype)  # [b,h,q,d]
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, axis_names={axis},
+    )
+    return fn(q, k, v)

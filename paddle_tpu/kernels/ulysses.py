@@ -1,0 +1,63 @@
+"""Ulysses-style (SEP) attention — all-to-all sequence↔heads exchange.
+
+Parity: the "sep" axis of Fleet's HybridCommunicateGroup
+(DeepSpeed-Ulysses-style segment parallelism, SURVEY.md §2.2): outside
+attention the *sequence* dim is sharded across sep ranks; around
+attention an all-to-all re-shards to *head* partitioning so every rank
+sees full sequences for its head subset.
+
+TPU-native: the exchange is purely declarative — a sharding constraint
+moving the sharded dim from seq to heads; GSPMD emits the all-to-all
+(one per direction), which is exactly the manual global_scatter/gather
+pair the reference would issue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..distributed.sharding import current_mesh, shard_activation
+
+
+def _head_entry(n_heads: int, mesh):
+    """Spec entry for the heads dim inside the attention region: fold sep
+    (and tp) onto heads when divisible."""
+    tp = mesh.shape.get("tp", 1)
+    sep = mesh.shape.get("sep", 1)
+    axes = []
+    if tp > 1 and n_heads % tp == 0:
+        axes.append("tp")
+    if sep > 1 and n_heads % (tp * sep) == 0:
+        axes.append("sep")
+    if not axes:
+        return "tp"
+    return tuple(axes) if len(axes) > 1 else axes[0]
+
+
+def ulysses_attention(q, k, v, causal: bool = True, scale=None,
+                      training: bool = True, use_flash: bool = True):
+    """[batch, seq, heads, dim] attention with SEP all-to-all around it.
+
+    use_flash=False forces the XLA reference attention (numerics
+    debugging parity with cfg.use_flash_attention).
+    """
+    from .flash_attention import _reference_attention, flash_attention
+
+    def attend(q, k, v):
+        if use_flash:
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   training=training)
+        return _reference_attention(q, k, v, causal=causal, scale=scale)
+
+    mesh = current_mesh()
+    if mesh is None or mesh.shape.get("sep", 1) == 1:
+        return attend(q, k, v)
+    q_entry = _head_entry(q.shape[2], mesh)
+    kv_entry = _head_entry(k.shape[2], mesh)
+    # seq gathered, heads scattered (the all-to-all happens here)
+    q = shard_activation(q, ("dp", "fsdp"), None, q_entry, None)
+    k = shard_activation(k, ("dp", "fsdp"), None, kv_entry, None)
+    v = shard_activation(v, ("dp", "fsdp"), None, kv_entry, None)
+    out = attend(q, k, v)
+    # back to sequence sharding for the MLP/TP region
+    return shard_activation(out, ("dp", "fsdp"), "sep", "tp", None)

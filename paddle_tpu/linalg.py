@@ -1,0 +1,91 @@
+"""paddle.linalg parity surface over jnp.linalg."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.parameter import Parameter
+
+
+def _v(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    x, y = _v(x), _v(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    return jnp.linalg.norm(_v(x), ord=p if p != "fro" else "fro",
+                           axis=axis, keepdims=keepdim)
+
+
+def inv(x):
+    return jnp.linalg.inv(_v(x))
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(_v(x), rcond)
+
+
+def det(x):
+    return jnp.linalg.det(_v(x))
+
+
+def slogdet(x):
+    return jnp.linalg.slogdet(_v(x))
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(_v(x), full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(_v(x), mode=mode)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(_v(x), UPLO=UPLO)
+
+
+def eig(x):
+    return jnp.linalg.eig(_v(x))
+
+
+def cholesky(x, upper=False):
+    out = jnp.linalg.cholesky(_v(x))
+    return jnp.swapaxes(out, -1, -2) if upper else out
+
+
+def solve(a, b):
+    return jnp.linalg.solve(_v(a), _v(b))
+
+
+def lstsq(a, b, rcond=None):
+    return jnp.linalg.lstsq(_v(a), _v(b), rcond=rcond)
+
+
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(_v(x), tol)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(_v(x), n)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(_v(x), p)
+
+
+def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(
+        _v(a), _v(b), lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular,
+    )

@@ -1,5 +1,10 @@
+from .ernie_moe import ErnieMoEConfig, ErnieMoEForCausalLM  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,
     LlamaModel,
 )
+from .mamba import MambaConfig, MambaForCausalLM  # noqa: F401
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
+from .vit import ViT, ViTConfig  # noqa: F401

@@ -1,0 +1,158 @@
+"""GPT-family causal LM (parity: PaddleNLP GPT / ERNIE dense configs
+running under Fleet hybrid parallel — pre-LN transformer, learned
+positions, GELU MLP; TP via Column/Row parallel projections)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..distributed.parallel_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding import shard_activation
+from ..kernels import flash_attention as fa
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, LayerList
+from ..nn.layer.norm import LayerNorm
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, weight_attr=init)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=init)
+        self.dropout = Dropout(config.attention_probs_dropout_prob)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape(
+            b, s, 3, cfg.num_attention_heads, cfg.head_dim
+        )
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.use_flash_attention and not (
+            self.training and cfg.attention_probs_dropout_prob > 0
+        ):
+            out = fa.flash_attention(q, k, v, causal=True,
+                                     training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_probs_dropout_prob,
+                training=self.training,
+            )
+        return self.out_proj(out.reshape(b, s, cfg.hidden_size))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.ln_1 = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.fc_in = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=init
+        )
+        self.fc_out = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, weight_attr=init
+        )
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        return x + self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init
+        )
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=init,
+        )
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList(
+            [GPTBlock(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.ln_f = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        x = self.embeddings(input_ids) + self.position_embeddings(position_ids)
+        x = shard_activation(x, ("dp", "fsdp"), "sep", None)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            weight_attr=I.Normal(0.0, config.initializer_range),
+            has_bias=False,
+        )
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits[:, :-1, :], labels[:, 1:], ignore_index=-100
+        )

@@ -53,6 +53,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    # sequence-parallel attention mode when mesh sep>1:
+    #   "ulysses" — all-to-all heads↔seq exchange (SEP)
+    #   "ring"    — ring attention with rotating KV (CP)
+    sep_attention: str = "ulysses"
     use_recompute: bool = False
     recompute_policy: str = "dots_with_no_batch_dims_saveable"
     dtype: str = "float32"
@@ -129,14 +133,32 @@ class LlamaAttention(Layer):
             ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, 1)
             cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, 1)
             mask_len = ck.shape[1]
-            pos = cache_index + s
-            kv_mask = (jnp.arange(mask_len) < pos)[None, None, None, :]
+            # causal within the block AND limited to filled cache slots:
+            # query at absolute position cache_index+qi sees kv_idx <= it
+            q_pos = cache_index + jnp.arange(s)  # [s]
+            kv_idx = jnp.arange(mask_len)  # [mask_len]
+            kv_mask = (kv_idx[None, :] <= q_pos[:, None])[None, None, :, :]
             out = F.scaled_dot_product_attention(
                 q, ck, cv, attn_mask=kv_mask, training=False
             )
             new_cache = (ck, cv)
         else:
-            if cfg.use_flash_attention:
+            from ..distributed.sharding import current_mesh
+
+            mesh = current_mesh()
+            sep = mesh.shape.get("sep", 1) if mesh is not None else 1
+            if sep > 1 and cfg.sep_attention == "ring":
+                from ..kernels.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, mesh=mesh, causal=True)
+            elif sep > 1:
+                from ..kernels.ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, causal=True, training=self.training,
+                    use_flash=cfg.use_flash_attention,
+                )
+            elif cfg.use_flash_attention:
                 out = fa.flash_attention(q, k, v, causal=True,
                                          training=self.training)
             else:

@@ -1,0 +1,169 @@
+"""Mamba-style selective state-space LM.
+
+Parity: the "Mamba-2 / RWKV (selective-scan + linear-recurrence Phi op →
+Pallas)" config in BASELINE.json. The reference implements selective scan
+as a custom CUDA kernel; the TPU-native formulation is a **parallel
+associative scan** (`jax.lax.associative_scan`) over the linear
+recurrence h_t = a_t ⊙ h_{t-1} + b_t — the composition (a, b)∘(a', b') =
+(a·a', a'·b + b') is associative, so XLA lowers it to a log-depth scan
+that keeps the MXU/VPU busy instead of a sequential loop. This is the
+standard TPU mapping for S6/linear-attention recurrences; a Pallas
+chunked-scan kernel is the follow-up optimization for very long
+sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..distributed.parallel_layers import VocabParallelEmbedding
+from ..distributed.sharding import shard_activation
+from ..nn import functional as F
+from ..nn.layer.common import LayerList, Linear
+from ..nn.layer.norm import RMSNorm
+
+
+@dataclasses.dataclass
+class MambaConfig:
+    vocab_size: int = 50277
+    hidden_size: int = 768
+    state_size: int = 16
+    num_hidden_layers: int = 24
+    expand: int = 2
+    dt_rank: int = 48  # ceil(hidden/16)
+    conv_kernel: int = 4
+    rms_norm_eps: float = 1e-5
+
+    @property
+    def d_inner(self):
+        return self.expand * self.hidden_size
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("state_size", 8)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("dt_rank", 4)
+        return cls(**kw)
+
+
+def selective_scan(u, delta, A, B, C, D):
+    """S6 selective scan via associative scan.
+
+    u:     [b, s, d]   input
+    delta: [b, s, d]   softplus-activated step sizes
+    A:     [d, n]      state matrix (negative, learned as log)
+    B, C:  [b, s, n]   input/output projections
+    D:     [d]         skip
+    returns y: [b, s, d]
+    """
+    # discretize: a = exp(delta ⊗ A)  [b,s,d,n]; bu = delta*u ⊗ B
+    dA = jnp.exp(delta[..., None] * A[None, None])
+    dBu = (delta * u)[..., None] * B[:, :, None, :]
+
+    def combine(x, y):
+        a1, b1 = x
+        a2, b2 = y
+        return a2 * a1, a2 * b1 + b2
+
+    a_all, h_all = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+    y = jnp.einsum("bsdn,bsn->bsd", h_all, C)
+    return y + u * D[None, None]
+
+
+class MambaMixer(Layer):
+    def __init__(self, config: MambaConfig):
+        super().__init__()
+        cfg = config
+        d_in = cfg.d_inner
+        init = I.Normal(0.0, 0.02)
+        self.in_proj = Linear(cfg.hidden_size, 2 * d_in, weight_attr=init,
+                              bias_attr=False)
+        # depthwise causal conv over the sequence
+        self.conv_weight = self.create_parameter(
+            (d_in, cfg.conv_kernel), default_initializer=I.Uniform(-0.5, 0.5)
+        )
+        self.conv_bias = self.create_parameter((d_in,), is_bias=True)
+        self.x_proj = Linear(d_in, cfg.dt_rank + 2 * cfg.state_size,
+                             weight_attr=init, bias_attr=False)
+        self.dt_proj = Linear(cfg.dt_rank, d_in, weight_attr=init)
+        self.A_log = self.create_parameter(
+            (d_in, cfg.state_size),
+            default_initializer=lambda key, shape, dtype: jnp.log(
+                jnp.broadcast_to(
+                    jnp.arange(1, shape[1] + 1, dtype=jnp.float32), shape
+                )
+            ),
+        )
+        self.D = self.create_parameter(
+            (d_in,), default_initializer=I.Constant(1.0)
+        )
+        self.out_proj = Linear(d_in, cfg.hidden_size, weight_attr=init,
+                               bias_attr=False)
+        self.config = config
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        xz = self.in_proj(x)
+        xs, z = jnp.split(xz, 2, axis=-1)  # [b, s, d_in] each
+        # causal depthwise conv along seq
+        k = cfg.conv_kernel
+        pad = jnp.pad(xs, ((0, 0), (k - 1, 0), (0, 0)))
+        w = self.conv_weight.value  # [d_in, k]
+        xs = sum(
+            pad[:, i:i + s, :] * w[:, i][None, None, :] for i in range(k)
+        ) + self.conv_bias.value
+        xs = F.silu(xs)
+        proj = self.x_proj(xs)
+        dt, B, C = jnp.split(
+            proj, [cfg.dt_rank, cfg.dt_rank + cfg.state_size], axis=-1
+        )
+        delta = jax.nn.softplus(self.dt_proj(dt))
+        A = -jnp.exp(self.A_log.value.astype(jnp.float32))
+        y = selective_scan(
+            xs.astype(jnp.float32), delta.astype(jnp.float32), A,
+            B.astype(jnp.float32), C.astype(jnp.float32),
+            self.D.value.astype(jnp.float32),
+        ).astype(x.dtype)
+        return self.out_proj(y * F.silu(z))
+
+
+class MambaBlock(Layer):
+    def __init__(self, config: MambaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.mixer = MambaMixer(config)
+
+    def forward(self, x):
+        return x + self.mixer(self.norm(x))
+
+
+class MambaForCausalLM(Layer):
+    def __init__(self, config: MambaConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size
+        )
+        self.layers = LayerList(
+            [MambaBlock(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm_f = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, labels=None):
+        x = self.embeddings(input_ids)
+        x = shard_activation(x, ("dp", "fsdp"), "sep", None)
+        for layer in self.layers:
+            x = layer(x)
+        x = self.norm_f(x)
+        logits = x @ self.embeddings.weight.value.T  # tied
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits[:, :-1], labels[:, 1:])

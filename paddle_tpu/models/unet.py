@@ -1,0 +1,264 @@
+"""Diffusion UNet (parity: the ppdiffusers Stable-Diffusion config in
+BASELINE.json — UNet2DConditionModel's structure: ResNet blocks with
+GroupNorm+SiLU, self/cross attention at low resolutions, timestep
+embedding, down/up sampling with skip connections).
+
+TPU-native notes: NCHW at the API (parity), GroupNorm stats in fp32,
+attention through the shared scaled-dot-product path (flash kernel on
+TPU shapes), convs via lax.conv with bf16-friendly accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core.module import Layer
+from ..nn import functional as F
+from ..nn.layer.common import Linear, Upsample
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.norm import GroupNorm
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Sequence[int] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8
+    norm_num_groups: int = 32
+    sample_size: int = 64
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("in_channels", 4)
+        kw.setdefault("out_channels", 4)
+        kw.setdefault("block_out_channels", (32, 64))
+        kw.setdefault("layers_per_block", 1)
+        kw.setdefault("cross_attention_dim", 32)
+        kw.setdefault("attention_head_dim", 4)
+        kw.setdefault("norm_num_groups", 8)
+        kw.setdefault("sample_size", 16)
+        return cls(**kw)
+
+
+def timestep_embedding(timesteps, dim: int, max_period: float = 10000.0):
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = timesteps.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResnetBlock(Layer):
+    def __init__(self, in_c, out_c, temb_c, groups):
+        super().__init__()
+        self.norm1 = GroupNorm(groups, in_c)
+        self.conv1 = Conv2D(in_c, out_c, 3, padding=1)
+        self.time_emb_proj = Linear(temb_c, out_c)
+        self.norm2 = GroupNorm(groups, out_c)
+        self.conv2 = Conv2D(out_c, out_c, 3, padding=1)
+        self.shortcut = Conv2D(in_c, out_c, 1) if in_c != out_c else None
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = x if self.shortcut is None else self.shortcut(x)
+        return skip + h
+
+
+class CrossAttnBlock(Layer):
+    """Self-attn + cross-attn + GEGLU ff over flattened spatial tokens."""
+
+    def __init__(self, channels, ctx_dim, head_dim, groups):
+        super().__init__()
+        self.norm = GroupNorm(groups, channels)
+        self.proj_in = Linear(channels, channels)
+        self.n_heads = max(1, channels // (head_dim * 8)) * 1
+        self.n_heads = max(1, channels // 64)
+        self.head_dim = channels // self.n_heads
+        from ..nn.layer.norm import LayerNorm
+
+        self.norm1 = LayerNorm(channels)
+        self.to_q1 = Linear(channels, channels, bias_attr=False)
+        self.to_k1 = Linear(channels, channels, bias_attr=False)
+        self.to_v1 = Linear(channels, channels, bias_attr=False)
+        self.to_out1 = Linear(channels, channels)
+        self.norm2 = LayerNorm(channels)
+        self.to_q2 = Linear(channels, channels, bias_attr=False)
+        self.to_k2 = Linear(ctx_dim, channels, bias_attr=False)
+        self.to_v2 = Linear(ctx_dim, channels, bias_attr=False)
+        self.to_out2 = Linear(channels, channels)
+        self.norm3 = LayerNorm(channels)
+        self.ff1 = Linear(channels, channels * 8)
+        self.ff2 = Linear(channels * 4, channels)
+        self.proj_out = Linear(channels, channels)
+
+    def _attn(self, q, k, v):
+        b, sq, c = q.shape
+        sk = k.shape[1]
+        qh = q.reshape(b, sq, self.n_heads, self.head_dim)
+        kh = k.reshape(b, sk, self.n_heads, self.head_dim)
+        vh = v.reshape(b, sk, self.n_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(qh, kh, vh, training=self.training)
+        return out.reshape(b, sq, c)
+
+    def forward(self, x, context):
+        b, c, hh, ww = x.shape
+        residual_spatial = x
+        h = self.norm(x).reshape(b, c, hh * ww).transpose(0, 2, 1)
+        h = self.proj_in(h)
+        # self attention
+        hn = self.norm1(h)
+        h = h + self.to_out1(
+            self._attn(self.to_q1(hn), self.to_k1(hn), self.to_v1(hn))
+        )
+        # cross attention
+        hn = self.norm2(h)
+        h = h + self.to_out2(
+            self._attn(self.to_q2(hn), self.to_k2(context),
+                       self.to_v2(context))
+        )
+        # GEGLU feed-forward
+        hn = self.norm3(h)
+        a, gate = jnp.split(self.ff1(hn), 2, axis=-1)
+        h = h + self.ff2(a * F.gelu(gate))
+        h = self.proj_out(h)
+        h = h.transpose(0, 2, 1).reshape(b, c, hh, ww)
+        return residual_spatial + h
+
+
+class Downsample(Layer):
+    def __init__(self, channels):
+        super().__init__()
+        self.conv = Conv2D(channels, channels, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class UpsampleBlock(Layer):
+    def __init__(self, channels):
+        super().__init__()
+        self.up = Upsample(scale_factor=2, mode="nearest")
+        self.conv = Conv2D(channels, channels, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(self.up(x))
+
+
+class UNet2DConditionModel(Layer):
+    def __init__(self, config: UNetConfig):
+        super().__init__()
+        from ..nn.layer.common import LayerList
+
+        self.config = config
+        ch = config.block_out_channels
+        temb_c = ch[0] * 4
+        self.time_proj_dim = ch[0]
+        self.time_embedding1 = Linear(ch[0], temb_c)
+        self.time_embedding2 = Linear(temb_c, temb_c)
+        self.conv_in = Conv2D(config.in_channels, ch[0], 3, padding=1)
+
+        self.down_resnets = LayerList()
+        self.down_attns = LayerList()
+        self.downsamplers = LayerList()
+        skip_channels = [ch[0]]
+        cur = ch[0]
+        for level, out_c in enumerate(ch):
+            for _ in range(config.layers_per_block):
+                self.down_resnets.append(
+                    ResnetBlock(cur, out_c, temb_c, config.norm_num_groups)
+                )
+                use_attn = level >= len(ch) - 2
+                self.down_attns.append(
+                    CrossAttnBlock(out_c, config.cross_attention_dim,
+                                   config.attention_head_dim,
+                                   config.norm_num_groups)
+                    if use_attn else None
+                )
+                cur = out_c
+                skip_channels.append(cur)
+            if level < len(ch) - 1:
+                self.downsamplers.append(Downsample(cur))
+                skip_channels.append(cur)
+
+        self.mid_res1 = ResnetBlock(cur, cur, temb_c, config.norm_num_groups)
+        self.mid_attn = CrossAttnBlock(
+            cur, config.cross_attention_dim, config.attention_head_dim,
+            config.norm_num_groups,
+        )
+        self.mid_res2 = ResnetBlock(cur, cur, temb_c, config.norm_num_groups)
+
+        self.up_resnets = LayerList()
+        self.up_attns = LayerList()
+        self.upsamplers = LayerList()
+        for level, out_c in enumerate(reversed(ch)):
+            for _ in range(config.layers_per_block + 1):
+                skip = skip_channels.pop()
+                self.up_resnets.append(
+                    ResnetBlock(cur + skip, out_c, temb_c,
+                                config.norm_num_groups)
+                )
+                use_attn = level < 2
+                self.up_attns.append(
+                    CrossAttnBlock(out_c, config.cross_attention_dim,
+                                   config.attention_head_dim,
+                                   config.norm_num_groups)
+                    if use_attn else None
+                )
+                cur = out_c
+            if level < len(ch) - 1:
+                self.upsamplers.append(UpsampleBlock(cur))
+
+        self.conv_norm_out = GroupNorm(config.norm_num_groups, cur)
+        self.conv_out = Conv2D(cur, config.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        """sample [b, c, h, w]; timestep [b]; context [b, s, ctx_dim]."""
+        temb = timestep_embedding(timestep, self.time_proj_dim)
+        temb = self.time_embedding2(F.silu(self.time_embedding1(temb)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        cfg = self.config
+        ri, di = 0, 0
+        for level in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block):
+                h = self.down_resnets[ri](h, temb)
+                attn = self.down_attns[ri]
+                if attn is not None:
+                    h = attn(h, encoder_hidden_states)
+                ri += 1
+                skips.append(h)
+            if level < len(cfg.block_out_channels) - 1:
+                h = self.downsamplers[di](h)
+                di += 1
+                skips.append(h)
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_res2(h, temb)
+
+        ri, ui = 0, 0
+        for level in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                h = jnp.concatenate([h, skip], axis=1)
+                h = self.up_resnets[ri](h, temb)
+                attn = self.up_attns[ri]
+                if attn is not None:
+                    h = attn(h, encoder_hidden_states)
+                ri += 1
+            if level < len(cfg.block_out_channels) - 1:
+                h = self.upsamplers[ui](h)
+                ui += 1
+
+        return self.conv_out(F.silu(self.conv_norm_out(h)))

@@ -1,0 +1,131 @@
+"""Vision Transformer (parity: the ViT-L / PaddleClas config in
+BASELINE.json — conv patch-embed + attention path; the reference runs it
+through phi conv + attention kernels, here XLA convs + the shared
+flash-attention path).
+
+Data layout NHWC internally (TPU-native: channels-last feeds the MXU
+without transposes); NCHW accepted at the boundary for parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..core.parameter import Parameter
+from ..distributed.parallel_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from ..distributed.sharding import shard_activation
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.norm import LayerNorm
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1000
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-6
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def vit_l(cls, **kw):
+        return cls(hidden_size=1024, num_hidden_layers=24,
+                   num_attention_heads=16, intermediate_size=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+
+class ViTBlock(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.norm1 = LayerNorm(h, config.layer_norm_epsilon)
+        self.qkv = ColumnParallelLinear(h, 3 * h)
+        self.proj = RowParallelLinear(h, h)
+        self.norm2 = LayerNorm(h, config.layer_norm_epsilon)
+        self.fc1 = ColumnParallelLinear(h, config.intermediate_size)
+        self.fc2 = RowParallelLinear(config.intermediate_size, h)
+        self.drop = Dropout(config.dropout)
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // config.num_attention_heads
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(self.norm1(x)).reshape(
+            b, s, 3, self.num_heads, self.head_dim
+        )
+        out = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], training=self.training
+        )
+        x = x + self.drop(self.proj(out.reshape(b, s, h)))
+        y = self.fc2(F.gelu(self.fc1(self.norm2(x))))
+        return x + self.drop(y)
+
+
+class ViT(Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        self.patch_embed = Conv2D(
+            config.num_channels, config.hidden_size,
+            config.patch_size, stride=config.patch_size,
+        )
+        self.cls_token = self.create_parameter(
+            (1, 1, config.hidden_size),
+            default_initializer=I.TruncatedNormal(std=0.02),
+        )
+        self.pos_embed = self.create_parameter(
+            (1, config.num_patches + 1, config.hidden_size),
+            default_initializer=I.TruncatedNormal(std=0.02),
+        )
+        from ..nn.layer.common import LayerList
+
+        self.blocks = LayerList(
+            [ViTBlock(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.head = Linear(config.hidden_size, config.num_classes)
+
+    def forward(self, pixel_values, labels=None):
+        # accepts NCHW (paddle convention)
+        x = self.patch_embed(pixel_values)  # [b, h, gh, gw]
+        b, c = x.shape[0], x.shape[1]
+        x = x.reshape(b, c, -1).transpose(0, 2, 1)  # [b, patches, h]
+        cls = jnp.broadcast_to(
+            self.cls_token.value, (b, 1, c)
+        ).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + self.pos_embed.value
+        x = shard_activation(x, ("dp", "fsdp"), None, None)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        logits = self.head(x[:, 0])
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels)

@@ -1,0 +1,187 @@
+"""Profiler (parity: python/paddle/profiler/ — ``Profiler`` context
+manager with targets + wait/warmup/active scheduler, chrome-trace export,
+``summary()`` tables; native side: host RecordEvent tracer + CUPTI device
+tracer merged into one timeline).
+
+TPU-native: the device tracer is XLA's — ``jax.profiler`` captures
+XPlane/perfetto traces including every HLO op and ICI collective, which
+covers both of the reference's tracers at once. This module adds the
+paddle-shaped scheduler UX, ``RecordEvent`` host annotations (lowered to
+jax.profiler.TraceAnnotation so they appear on the same timeline), and a
+host-side op summary built from step timings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = "cpu"
+    GPU = "gpu"  # accepted for parity; maps to the device tracer
+    TPU = "tpu"
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Parity: paddle.profiler.make_scheduler(closed, ready, record)."""
+
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+@dataclass
+class _StepRecord:
+    step: int
+    ms: float
+    annotations: List[str] = field(default_factory=list)
+
+
+class RecordEvent:
+    """Parity: paddle.profiler.RecordEvent — host-range annotation that
+    lands on the XLA trace timeline."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+
+    begin = __enter__
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+class Profiler:
+    def __init__(
+        self,
+        targets=None,
+        scheduler=None,
+        on_trace_ready=None,
+        log_dir: str = "./profiler_log",
+        timer_only: bool = False,
+    ):
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        if isinstance(self.scheduler, tuple):
+            lo, hi = self.scheduler
+            self.scheduler = make_scheduler(
+                closed=lo, ready=0, record=hi - lo
+            )
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self._tracing = False
+        self._records: List[_StepRecord] = []
+        self._t0 = None
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._maybe_transition()
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def step(self):
+        if self._t0 is not None:
+            self._records.append(
+                _StepRecord(self.step_num,
+                            (time.perf_counter() - self._t0) * 1e3)
+            )
+        self.step_num += 1
+        self._maybe_transition()
+        self._t0 = time.perf_counter()
+
+    def _maybe_transition(self):
+        state = self.scheduler(self.step_num)
+        want_trace = state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN)
+        if want_trace and not self._tracing and not self.timer_only:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+        elif not want_trace and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------
+    def summary(self, sorted_by: str = "ms") -> str:
+        """Step-time table (device-op tables live in the exported trace,
+        viewable in Perfetto/TensorBoard)."""
+        if not self._records:
+            return "no steps recorded"
+        times = [r.ms for r in self._records]
+        import numpy as np
+
+        lines = [
+            "step time summary (ms)",
+            f"  steps: {len(times)}",
+            f"  mean:  {np.mean(times):.2f}",
+            f"  p50:   {np.percentile(times, 50):.2f}",
+            f"  p90:   {np.percentile(times, 90):.2f}",
+            f"  min:   {np.min(times):.2f}",
+            f"  max:   {np.max(times):.2f}",
+            f"  trace dir: {self.log_dir}",
+        ]
+        return "\n".join(lines)
+
+
+def export_chrome_tracing(dir_name: str):
+    """Parity helper: the XLA trace is already perfetto/chrome-compatible;
+    returns an on_trace_ready callback recording the export dir."""
+
+    def cb(prof: Profiler):
+        prof.log_dir = dir_name
+
+    return cb

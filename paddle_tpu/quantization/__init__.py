@@ -1,0 +1,114 @@
+"""Quantization (parity: python/paddle/quantization/ — PTQ observers,
+QAT fake-quant wrappers — and the phi ``weight_only_linear`` int8/int4
+kernels used for LLM inference).
+
+TPU-native: weight-only int8 keeps weights quantized in HBM (halving
+weight bandwidth, the actual bottleneck of decode) and dequantizes in
+registers fused into the matmul — XLA fuses the scale-multiply into the
+dot; a Pallas blockwise-dequant matmul kernel is the planned upgrade for
+int4 grouped scales.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.module import Layer
+from ..core.parameter import Parameter
+from ..nn import functional as F
+
+
+def quantize_weight_int8(w: jax.Array, axis: int = 0):
+    """Symmetric per-channel int8: returns (q, scale). axis = the
+    *preserved* (output-channel) axis."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def weight_only_linear(x, qweight, scale, bias=None):
+    """y = x @ dequant(qweight) (+ bias). qweight int8 [in, out], scale
+    [1, out] (per-out-channel). Parity: phi weight_only_linear."""
+    w = qweight.astype(x.dtype) * scale.astype(x.dtype)
+    y = jnp.matmul(x, w)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in for nn.Linear with int8 weights (inference)."""
+
+    def __init__(self, linear_or_in, out_features: Optional[int] = None):
+        super().__init__()
+        from ..nn.layer.common import Linear
+
+        if isinstance(linear_or_in, Linear):
+            src = linear_or_in
+            q, s = quantize_weight_int8(src.weight.value, axis=1)
+            self.in_features = src.in_features
+            self.out_features = src.out_features
+            bias = None if src.bias is None else src.bias.value
+        else:
+            self.in_features = linear_or_in
+            self.out_features = out_features
+            q = jnp.zeros((self.in_features, self.out_features), jnp.int8)
+            s = jnp.ones((1, self.out_features), jnp.float32)
+            bias = jnp.zeros((self.out_features,), jnp.float32)
+        self.register_buffer("qweight", q)
+        self.register_buffer("scale", s)
+        if bias is not None:
+            self.bias = Parameter(bias, trainable=False)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return weight_only_linear(
+            x, self._buffers["qweight"], self._buffers["scale"],
+            None if self.bias is None else self.bias.value,
+        )
+
+
+class FakeQuant(Layer):
+    """QAT fake-quant (uniform symmetric, straight-through estimator)."""
+
+    def __init__(self, bits: int = 8, observer_momentum: float = 0.9):
+        super().__init__()
+        self.qmax = 2 ** (bits - 1) - 1
+        self.momentum = observer_momentum
+        self.register_buffer("amax", jnp.ones((), jnp.float32))
+
+    def forward(self, x):
+        import jax.core
+
+        amax_obs = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        if not isinstance(amax_obs, jax.core.Tracer) and self.training:
+            self._buffers["amax"] = (
+                self.momentum * self._buffers["amax"]
+                + (1 - self.momentum) * amax_obs
+            )
+        amax = jnp.where(
+            self.training, jnp.maximum(amax_obs, 1e-8),
+            jnp.maximum(self._buffers["amax"], 1e-8),
+        )
+        scale = amax / self.qmax
+        q = jnp.clip(jnp.round(x / scale), -self.qmax, self.qmax) * scale
+        # straight-through: forward q, backward identity
+        return x + jax.lax.stop_gradient(q - x)
+
+
+def quantize_model_weight_only(model: Layer) -> Layer:
+    """Replace every nn.Linear in the tree with WeightOnlyLinear."""
+    from ..nn.layer.common import Linear
+
+    for parent in model.sublayers(include_self=True):
+        for name, sub in list(parent._sub_layers.items()):
+            if type(sub) is Linear:
+                parent._sub_layers[name] = WeightOnlyLinear(sub)
+    return model

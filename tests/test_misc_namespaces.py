@@ -1,0 +1,103 @@
+"""linalg / fft / distribution / jit / quantization surfaces."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distribution, fft, jit, linalg, nn, quantization as q
+
+
+def test_linalg_basics():
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((4, 4)),
+                    jnp.float32)
+    spd = a @ a.T + 4 * jnp.eye(4)
+    np.testing.assert_allclose(
+        np.asarray(linalg.inv(spd) @ spd), np.eye(4), atol=1e-4
+    )
+    L = linalg.cholesky(spd)
+    np.testing.assert_allclose(np.asarray(L @ L.T), np.asarray(spd),
+                               rtol=1e-4, atol=1e-4)
+    u, s, vt = linalg.svd(a)
+    np.testing.assert_allclose(
+        np.asarray((u * s) @ vt), np.asarray(a), rtol=1e-4, atol=1e-4
+    )
+    x = linalg.solve(spd, jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(spd @ x), 1.0, rtol=1e-4)
+
+
+def test_fft_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(32), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fft.ifft(fft.fft(x)).real), np.asarray(x), atol=1e-5
+    )
+
+
+def test_distributions():
+    pt.seed(3)
+    n = distribution.Normal(0.0, 1.0)
+    s = n.sample((1000,))
+    assert abs(float(s.mean())) < 0.15
+    np.testing.assert_allclose(
+        float(n.log_prob(jnp.asarray(0.0))), -0.9189385, rtol=1e-5
+    )
+    kl = distribution.kl_divergence(
+        distribution.Normal(0.0, 1.0), distribution.Normal(0.0, 1.0)
+    )
+    np.testing.assert_allclose(float(kl), 0.0, atol=1e-6)
+    c = distribution.Categorical(logits=jnp.asarray([0.0, 0.0]))
+    assert float(c.entropy()) == pytest.approx(np.log(2), rel=1e-5)
+    b = distribution.Bernoulli(0.5)
+    assert float(b.entropy()) == pytest.approx(np.log(2), rel=1e-4)
+
+
+def test_jit_to_static_and_save_load(tmp_path):
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    traced = jit.to_static(net)
+    x = jnp.ones((3, 4))
+    ref = net(x)
+    np.testing.assert_allclose(np.asarray(traced(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    path = str(tmp_path / "model")
+    jit.save(traced, path, input_spec=[x])
+    loaded = jit.load(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded(x)), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_weight_only_int8():
+    pt.seed(1)
+    lin = nn.Linear(16, 8)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 16)),
+                    jnp.float32)
+    ref = np.asarray(lin(x))
+    wql = q.WeightOnlyLinear(lin)
+    out = np.asarray(wql(x))
+    # int8 per-channel quantization error stays small
+    denom = np.maximum(np.abs(ref), 1.0)
+    assert np.max(np.abs(out - ref) / denom) < 0.05
+    assert wql._buffers["qweight"].dtype == jnp.int8
+
+
+def test_quantize_model_sweep():
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    q.quantize_model_weight_only(net)
+    from paddle_tpu.quantization import WeightOnlyLinear
+
+    kinds = [type(l).__name__ for l in net._sub_layers.values()]
+    assert kinds.count("WeightOnlyLinear") == 2
+    y = net(jnp.ones((1, 8)))
+    assert y.shape == (1, 4)
+
+
+def test_fake_quant_ste_grad():
+    import jax
+
+    fq = q.FakeQuant(bits=8)
+    fq.eval()
+    x = jnp.linspace(-1, 1, 8)
+    g = jax.grad(lambda x: jnp.sum(fq(x) ** 2))(x)
+    # straight-through: gradient ≈ 2x
+    np.testing.assert_allclose(np.asarray(g), np.asarray(2 * x), atol=0.1)

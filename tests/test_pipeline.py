@@ -1,0 +1,88 @@
+"""Pipeline parallel tests on the virtual 8-device mesh: pipelined
+forward/backward must equal the sequential reference (parity model:
+fleet PP tests comparing pipeline vs single-card runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import distributed as dist, nn
+from paddle_tpu.distributed.pipeline import LayerDesc, PipelineLayer, pipeline_apply
+from paddle_tpu.distributed.sharding import mesh_context
+
+
+def test_pipeline_apply_matches_sequential():
+    mesh = dist.build_mesh(pp=4)
+    pp = 4
+    rng = np.random.default_rng(0)
+    # one linear stage per pp rank: y = tanh(x @ w)
+    ws = jnp.asarray(rng.standard_normal((pp, 8, 8)) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, 2, 8)), jnp.float32)  # 4 micro
+
+    def stage_fn(w, mb):
+        return jnp.tanh(mb @ w)
+
+    ys = pipeline_apply(
+        stage_fn, ws, x, mesh=mesh, n_micro=4,
+    )
+    # sequential reference
+    ref = x
+    for i in range(pp):
+        ref = jnp.tanh(ref @ ws[i])
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_pipeline_apply_grads_match():
+    mesh = dist.build_mesh(pp=4)
+    pp = 4
+    rng = np.random.default_rng(1)
+    ws = jnp.asarray(rng.standard_normal((pp, 8, 8)) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, 2, 8)), jnp.float32)
+
+    def stage_fn(w, mb):
+        return jnp.tanh(mb @ w)
+
+    def loss_pp(ws):
+        y = pipeline_apply(stage_fn, ws, x, mesh=mesh, n_micro=2)
+        return jnp.sum(y**2)
+
+    def loss_seq(ws):
+        ref = x
+        for i in range(pp):
+            ref = jnp.tanh(ref @ ws[i])
+        return jnp.sum(ref**2)
+
+    g_pp = jax.grad(loss_pp)(ws)
+    g_seq = jax.grad(loss_seq)(ws)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_layer_matches_sequential():
+    pt.seed(77)
+    mesh = dist.build_mesh(pp=4)
+    trunk = PipelineLayer(
+        LayerDesc(nn.Linear, 16, 16), num_layers=8, num_stages=4
+    )
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((4, 16)), jnp.float32
+    )
+    seq = trunk(x)  # no mesh → sequential scan
+    with mesh_context(mesh):
+        piped = jax.jit(
+            lambda p, x: __import__(
+                "paddle_tpu.core.functional", fromlist=["functional_call"]
+            ).functional_call(trunk, p, x, n_micro=2, mesh=mesh)
+        )(
+            {n: v for n, v in
+             __import__("paddle_tpu.core.functional",
+                        fromlist=["extract_params"]).extract_params(trunk).items()},
+            x,
+        )
+    np.testing.assert_allclose(
+        np.asarray(piped), np.asarray(seq), rtol=1e-4, atol=1e-5
+    )

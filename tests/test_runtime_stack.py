@@ -1,0 +1,159 @@
+"""Flags, profiler scheduler, metrics, hapi Model, launch CLI (parity
+model: the aux-subsystem tests in SURVEY.md §4/§5)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags, io, metric, nn, optimizer as opt
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+
+def test_flags_roundtrip():
+    assert flags.flag("io_prefetch_depth") == 2
+    flags.set_flags({"FLAGS_io_prefetch_depth": 4})
+    assert flags.get_flags("FLAGS_io_prefetch_depth") == {
+        "FLAGS_io_prefetch_depth": 4
+    }
+    with pytest.raises(KeyError):
+        flags.set_flags({"FLAGS_nope": 1})
+    flags.set_flags({"FLAGS_io_prefetch_depth": 2})
+
+
+def test_profiler_scheduler():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert states[4] == ProfilerState.CLOSED  # repeat exhausted
+
+
+def test_profiler_timer_only():
+    from paddle_tpu.profiler import Profiler
+
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step()
+    p.stop()
+    assert "steps: 3" in p.summary()
+
+
+def test_metrics():
+    acc = metric.Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    label = np.array([1, 2])
+    acc.update(pred, label)
+    top1, top2 = acc.accumulate()
+    assert top1 == 0.5
+    assert top2 == 0.5
+    p = metric.Precision()
+    p.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert p.accumulate() == 0.5
+    r = metric.Recall()
+    r.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert r.accumulate() == 0.5
+    auc = metric.Auc()
+    auc.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+    assert auc.accumulate() > 0.9
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    pt.seed(0)
+    x = np.random.randn(64, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w).astype(np.float32)
+    ds = io.TensorDataset(x, y)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=opt.AdamW(learning_rate=1e-2, multi_precision=False),
+        loss=lambda out, label: ((out - label) ** 2).mean(),
+    )
+    model.fit(ds, batch_size=16, epochs=25, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["loss"] < 0.5
+    preds = model.predict(ds, batch_size=16)
+    assert preds.shape == (64, 1)
+    model.save(str(tmp_path / "m"))
+    model2 = Model(
+        nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    )
+    model2.prepare(loss=lambda o, l: ((o - l) ** 2).mean())
+    model2.load(str(tmp_path / "m"))
+    logs2 = model2.evaluate(ds, batch_size=16, verbose=0)
+    np.testing.assert_allclose(logs2["loss"], logs["loss"], rtol=1e-4)
+
+
+def test_launch_cli_single_node(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        print("rank", os.environ["PADDLE_TRAINER_ID"],
+              "of", os.environ["PADDLE_TRAINERS_NUM"])
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    logs = sorted((tmp_path / "log").glob("workerlog.*"))
+    assert len(logs) == 2
+    content = "".join(p.read_text() for p in logs)
+    assert "rank 0 of 2" in content and "rank 1 of 2" in content
+
+
+def test_launch_cli_elastic_restart(tmp_path):
+    # worker fails on first run (marker file absent), succeeds on restart
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "marker"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = {str(repr(str(marker)))}
+        if not os.path.exists(m):
+            open(m, "w").close()
+            sys.exit(1)
+        print("recovered")
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--elastic", "--max_restarts", "2",
+         "--poll_interval", "0.2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "elastic restart" in r.stdout
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "recovered" in log
+
+
+def test_early_stopping():
+    es = EarlyStopping(monitor="loss", patience=1)
+
+    class FakeModel:
+        stop_training = False
+
+    es.set_model(FakeModel())
+    es.on_eval_end({"loss": 1.0})
+    es.on_eval_end({"loss": 0.9})
+    es.on_eval_end({"loss": 0.95})
+    assert not es.model.stop_training
+    es.on_eval_end({"loss": 0.96})
+    assert es.model.stop_training
