@@ -1,0 +1,21 @@
+"""Vision domain API (parity: python/paddle/vision/ — transforms,
+datasets, model zoo).
+
+Host-side preprocessing stays numpy/PIL (it runs on CPU feeding the
+device prefetch pipeline in ``paddle_tpu.io``); models are ordinary
+``Layer`` trees compiled by XLA, NHWC-internal where it matters for the
+MXU.
+"""
+
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import (  # noqa: F401
+    ResNet,
+    mobilenet_v2,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)

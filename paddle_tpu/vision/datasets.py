@@ -1,0 +1,200 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/ — MNIST,
+Cifar10/100, DatasetFolder/ImageFolder).
+
+This sandbox has zero egress, so datasets load from *local* files only
+(``download=True`` raises with a clear message); ``FakeData`` provides a
+deterministic synthetic stand-in for tests and smoke training runs —
+the same role the reference's unittests fill with fake readers.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image-classification dataset."""
+
+    def __init__(self, num_samples=64, image_shape=(32, 32, 3),
+                 num_classes=10, transform: Optional[Callable] = None):
+        # default is HWC uint8 — the layout every transform expects
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.integers(
+            0, 256, size=self.image_shape, dtype=np.uint8
+        ).astype(np.uint8)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: download is unavailable in this environment (no network); "
+        "pass the path to locally present data files"
+    )
+
+
+class MNIST(Dataset):
+    """MNIST from local idx/idx-gz files (parity: paddle.vision.datasets.MNIST).
+
+    ``image_path``/``label_path`` point at the standard
+    ``*-images-idx3-ubyte(.gz)`` / ``*-labels-idx1-ubyte(.gz)`` files.
+    """
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if image_path is None or label_path is None:
+            _no_download("MNIST")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        assert len(self.images) == len(self.labels)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the local ``cifar-10-python.tar.gz`` (parity:
+    paddle.vision.datasets.Cifar10)."""
+
+    _batches_train = [f"data_batch_{i}" for i in range(1, 6)]
+    _batches_test = ["test_batch"]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False):
+        if data_file is None:
+            _no_download("Cifar10")
+        names = self._batches_train if mode == "train" else self._batches_test
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(
+                        np.asarray(d[b"data"], dtype=np.uint8).reshape(
+                            -1, 3, 32, 32
+                        )
+                    )
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(images, axis=0)
+        self.labels = np.asarray(labels, dtype=np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = np.transpose(self.images[idx], (1, 2, 0))  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory layout (parity: paddle DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMAGE_EXTS,
+                 transform=None):
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise ValueError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    if fname.lower().endswith(tuple(extensions)):
+                        self.samples.append(
+                            (os.path.join(dirpath, fname), self.class_to_idx[c])
+                        )
+        self.loader = loader or self._pil_loader
+        self.transform = transform
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat folder of images (parity: paddle ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMAGE_EXTS,
+                 transform=None):
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(dirpath, fname), -1))
+        self.loader = loader or DatasetFolder._pil_loader
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)

@@ -1,0 +1,11 @@
+"""Vision model zoo (parity: python/paddle/vision/models/)."""
+
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)

@@ -1,0 +1,264 @@
+"""Image transforms (parity: python/paddle/vision/transforms/ —
+Compose/Resize/Crop/Flip/Normalize/ToTensor and the functional forms).
+
+All transforms operate host-side on PIL Images or numpy HWC arrays —
+preprocessing belongs on CPU, overlapped with device compute via the
+DataLoader prefetcher, never inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+try:  # PIL is the image decode path, as in the reference
+    from PIL import Image
+
+    _HAS_PIL = True
+except Exception:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def _is_pil(img):
+    return _HAS_PIL and isinstance(img, Image.Image)
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# ---------------------------------------------------------------- functional
+
+
+def to_tensor(img, data_format="CHW"):
+    """PIL/HWC-uint8 → float32 in [0,1], CHW (paddle default) or HWC."""
+    if _is_pil(img):
+        img = np.asarray(img)
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    # int size = resize the SHORTER edge to `size`, preserving aspect
+    # ratio (paddle semantics); (h, w) = exact target.
+    if isinstance(size, numbers.Number):
+        if _is_pil(img):
+            iw, ih = img.size
+        else:
+            ih, iw = np.asarray(img).shape[:2]
+        s = int(size)
+        if ih <= iw:
+            h, w = s, max(1, int(round(iw * s / ih)))
+        else:
+            h, w = max(1, int(round(ih * s / iw))), s
+    else:
+        h, w = _size_pair(size)
+    if _is_pil(img):
+        modes = {
+            "nearest": Image.NEAREST,
+            "bilinear": Image.BILINEAR,
+            "bicubic": Image.BICUBIC,
+        }
+        return img.resize((w, h), modes.get(interpolation, Image.BILINEAR))
+    # numpy path: nearest / bilinear via index interpolation
+    arr = np.asarray(img)
+    src_h, src_w = arr.shape[:2]
+    if interpolation == "nearest":
+        ys = np.clip(
+            np.round(np.linspace(0, src_h - 1, h)).astype(int), 0, src_h - 1
+        )
+        xs = np.clip(
+            np.round(np.linspace(0, src_w - 1, w)).astype(int), 0, src_w - 1
+        )
+        return arr[ys][:, xs]
+    ys = np.linspace(0, src_h - 1, h)
+    xs = np.linspace(0, src_w - 1, w)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, src_h - 1)
+    x1 = np.minimum(x0 + 1, src_w - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = arr.astype(np.float32)
+    top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+    bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def crop(img, top, left, height, width):
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    return np.asarray(img)[top : top + height, left : left + width]
+
+
+def center_crop(img, size):
+    h, w = _size_pair(size)
+    if _is_pil(img):
+        iw, ih = img.size
+    else:
+        ih, iw = np.asarray(img).shape[:2]
+    return crop(img, max(0, (ih - h) // 2), max(0, (iw - w) // 2), h, w)
+
+
+def hflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    return np.asarray(img)[::-1]
+
+
+# ------------------------------------------------------------------ classes
+
+
+class BaseTransform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        # scalars broadcast over whatever channel count the image has
+        # (a grayscale input must stay single-channel)
+        if isinstance(mean, numbers.Number):
+            mean = [mean]
+        if isinstance(std, numbers.Number):
+            std = [std]
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size, self.interpolation = size, interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = _size_pair(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            pad = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad, mode="constant")
+        h, w = self.size
+        ih, iw = arr.shape[:2]
+        top = random.randint(0, max(0, ih - h))
+        left = random.randint(0, max(0, iw - w))
+        return crop(arr, top, left, h, w)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = _size_pair(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = np.asarray(img) if not _is_pil(img) else img
+        if _is_pil(arr):
+            iw, ih = arr.size
+        else:
+            ih, iw = arr.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            w = int(round((target * ar) ** 0.5))
+            h = int(round((target / ar) ** 0.5))
+            if 0 < w <= iw and 0 < h <= ih:
+                top = random.randint(0, ih - h)
+                left = random.randint(0, iw - w)
+                patch = crop(img, top, left, h, w)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(img, min(ih, iw)), self.size,
+                      self.interpolation)
+
+
+class Transpose(BaseTransform):
+    """HWC → CHW (paddle parity for pipelines that skip ToTensor)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
