@@ -1,0 +1,258 @@
+"""Sparse tensor surface (parity: python/paddle/sparse/).
+
+The reference carries COO/CSR tensor types plus a sparse kernel set
+(paddle/phi/kernels/sparse/, paddle/phi/core/sparse_coo_tensor.h). On TPU
+the honest design is different: XLA has no native sparse execution — the
+MXU wants dense tiles — so sparse tensors here are a *representation and
+interop* layer built on ``jax.experimental.sparse`` (BCOO/BCSR). Ops keep
+data sparse where jax's sparse rules support it (elementwise, dot_general,
+reductions) and densify only where unavoidable; under ``jit`` the
+sparsity-structure ops trace like any other jax code.
+
+SelectedRows (the reference's embedding-gradient format,
+paddle/phi/core/selected_rows.h) is deliberately absent: under XLA,
+embedding grads are produced by scatter-add fusion and never materialize a
+rows+values pair.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.parameter import Parameter
+from . import nn  # noqa: F401  (namespace parity: paddle.sparse.nn)
+
+__all__ = [
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "to_dense",
+    "to_sparse_coo",
+    "to_sparse_csr",
+    "is_sparse",
+    "is_sparse_coo",
+    "is_sparse_csr",
+    "coalesce",
+    "add",
+    "subtract",
+    "multiply",
+    "divide",
+    "matmul",
+    "masked_matmul",
+    "transpose",
+    "relu",
+    "nnz",
+]
+
+
+def _v(x):
+    return x.value if isinstance(x, Parameter) else x
+
+
+def _as_bcoo(x, coalesce: bool = False):
+    """Normalize any sparse operand to BCOO (optionally coalesced)."""
+    x = _v(x)
+    if isinstance(x, jsparse.BCSR):
+        x = x.to_bcoo()
+    if coalesce and isinstance(x, jsparse.BCOO):
+        # nse is preserved: duplicates are summed and the freed slots
+        # padded with out-of-range indices, which todense/ops drop —
+        # required so this stays trace-compatible under jit.
+        x = jsparse.bcoo_sort_indices(x.sum_duplicates(nse=x.nse))
+    return x
+
+
+# -- construction -----------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Build a COO sparse array from ``[sparse_ndim, nnz]`` indices.
+
+    Mirrors ``paddle.sparse.sparse_coo_tensor`` (reference surface:
+    python/paddle/sparse/creation.py). Returns a jax BCOO with n_batch=0,
+    n_dense=0 — the direct analog of phi's SparseCooTensor.
+    """
+    indices = jnp.asarray(_v(indices))
+    values = jnp.asarray(_v(values), dtype=dtype)
+    if indices.ndim != 2:
+        raise ValueError(
+            f"indices must be [sparse_ndim, nnz]; got shape {indices.shape}")
+    if shape is None:
+        if indices.shape[1] == 0 or isinstance(indices, jax.core.Tracer):
+            raise ValueError(
+                "shape must be given explicitly for empty or traced "
+                "indices — it cannot be inferred")
+        shape = tuple(int(m) + 1 for m in jnp.max(indices, axis=1))
+    # BCOO stores indices as [nnz, sparse_ndim]
+    return jsparse.BCOO((values, indices.T.astype(jnp.int32)),
+                        shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Build a CSR sparse matrix (parity: paddle.sparse.sparse_csr_tensor)."""
+    crows = jnp.asarray(_v(crows), dtype=jnp.int32)
+    cols = jnp.asarray(_v(cols), dtype=jnp.int32)
+    values = jnp.asarray(_v(values), dtype=dtype)
+    if len(shape) != 2:
+        raise ValueError("sparse_csr_tensor supports 2-D matrices; "
+                         f"got shape {shape}")
+    return jsparse.BCSR((values, cols, crows), shape=tuple(shape))
+
+
+# -- conversion -------------------------------------------------------------
+
+def to_sparse_coo(x, sparse_dim: Optional[int] = None):
+    x = _v(x)
+    if isinstance(x, jsparse.BCSR):
+        return x.to_bcoo()
+    if isinstance(x, jsparse.BCOO):
+        return x
+    n_sparse = sparse_dim if sparse_dim is not None else jnp.ndim(x)
+    return jsparse.BCOO.fromdense(jnp.asarray(x), n_dense=jnp.ndim(x) - n_sparse)
+
+
+def to_sparse_csr(x):
+    x = _v(x)
+    if isinstance(x, jsparse.BCSR):
+        return x
+    if isinstance(x, jsparse.BCOO):
+        # eager conversion: drop duplicate/padded slots for real (nse
+        # shrinks), so the CSR carries only true entries
+        return jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sort_indices(x.sum_duplicates()))
+    return jsparse.BCSR.fromdense(jnp.asarray(x))
+
+
+def to_dense(x):
+    x = _v(x)
+    if isinstance(x, (jsparse.BCOO, jsparse.BCSR)):
+        return x.todense()
+    return jnp.asarray(x)
+
+
+def is_sparse(x):
+    return isinstance(_v(x), (jsparse.BCOO, jsparse.BCSR))
+
+
+def is_sparse_coo(x):
+    return isinstance(_v(x), jsparse.BCOO)
+
+
+def is_sparse_csr(x):
+    return isinstance(_v(x), jsparse.BCSR)
+
+
+def nnz(x):
+    """Number of stored *in-range* entries.
+
+    After ``coalesce`` the buffer keeps its nse with freed slots padded by
+    out-of-range indices; those are not real entries and are not counted
+    (parity: Tensor.coalesce shrinks nnz in the reference).
+    """
+    x = _v(x)
+    if isinstance(x, jsparse.BCOO):
+        n_sparse = x.indices.shape[-1]
+        bound = jnp.array(x.shape[x.n_batch:x.n_batch + n_sparse])
+        count = jnp.sum(jnp.all(x.indices < bound, axis=-1))
+        return int(count) if not isinstance(count, jax.core.Tracer) else count
+    return x.nse
+
+
+def bcoo_coalesced(x: jsparse.BCOO) -> jsparse.BCOO:
+    return _as_bcoo(x, coalesce=True)
+
+
+def coalesce(x):
+    """Sum duplicate indices and sort (parity: Tensor.coalesce)."""
+    x = _v(x)
+    if isinstance(x, jsparse.BCOO):
+        return _as_bcoo(x, coalesce=True)
+    return x
+
+
+# -- math -------------------------------------------------------------------
+
+def _binary(op, x, y):
+    x, y = _v(x), _v(y)
+    xs, ys = is_sparse(x), is_sparse(y)
+    if not xs and not ys:
+        return op(x, y)
+    # jax sparse rules: sparse+sparse and sparse*dense stay sparse where
+    # supported; fall back through sparsify for the rest.
+    fn = jsparse.sparsify(op)
+    return fn(_as_bcoo(x) if xs else x, _as_bcoo(y) if ys else y)
+
+
+def add(x, y):
+    return _binary(jnp.add, x, y)
+
+
+def subtract(x, y):
+    return _binary(jnp.subtract, x, y)
+
+
+def multiply(x, y):
+    return _binary(jnp.multiply, x, y)
+
+
+def divide(x, y):
+    # division only defined against dense/scalar divisors (as in reference)
+    x = _as_bcoo(x)
+    if isinstance(x, jsparse.BCOO):
+        return jsparse.BCOO((x.data / jnp.asarray(_v(y)), x.indices),
+                            shape=x.shape) if jnp.ndim(_v(y)) == 0 else \
+            jsparse.sparsify(jnp.divide)(x, jnp.asarray(_v(y)))
+    return jnp.divide(x, _v(y))
+
+
+def matmul(x, y):
+    """Sparse @ dense / sparse @ sparse matmul (parity: paddle.sparse.matmul).
+
+    Lowers to ``bcoo_dot_general`` — on TPU this compiles to gather+dense
+    dot; for highly-sparse operands that beats densifying first in HBM
+    traffic, which is the only win sparsity can buy on this hardware.
+    """
+    x, y = _as_bcoo(x), _as_bcoo(y)
+    return jsparse.sparsify(jnp.matmul)(x, y)
+
+
+def masked_matmul(x, y, mask):
+    """Dense@dense with output sampled at ``mask``'s sparsity pattern.
+
+    Parity: paddle.sparse.masked_matmul (SDDMM). Uses
+    ``bcoo_dot_general_sampled`` so only the nse output entries are formed.
+    """
+    x, y = jnp.asarray(_v(x)), jnp.asarray(_v(y))
+    # coalesce: a duplicate mask index would sample the dot twice and
+    # todense would sum the copies, doubling the value
+    mask = _as_bcoo(to_sparse_coo(mask), coalesce=True)
+    dn = (((x.ndim - 1,), (y.ndim - 2,)), ((), ()))
+    data = jsparse.bcoo_dot_general_sampled(x, y, mask.indices,
+                                            dimension_numbers=dn)
+    return jsparse.BCOO((data, mask.indices), shape=mask.shape)
+
+
+def transpose(x, perm: Sequence[int]):
+    x = _as_bcoo(x)
+    if isinstance(x, jsparse.BCOO):
+        return jsparse.bcoo_transpose(x, permutation=tuple(perm))
+    return jnp.transpose(x, tuple(perm))
+
+
+def map_values(x, fn):
+    """Apply ``fn`` elementwise to stored values. Coalesces first: with
+    duplicate indices a per-entry nonlinear map would disagree with the
+    dense semantics (relu(2) + relu(-3) != relu(2 + -3))."""
+    x = _as_bcoo(x, coalesce=True)
+    if isinstance(x, jsparse.BCOO):
+        return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape)
+    return fn(jnp.asarray(x))
+
+
+def relu(x):
+    """Elementwise relu on values (parity: paddle.sparse.nn.ReLU)."""
+    return map_values(x, jax.nn.relu)

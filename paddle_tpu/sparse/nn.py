@@ -1,0 +1,141 @@
+"""Sparse layers (parity: python/paddle/sparse/nn/).
+
+The reference ships ReLU/BatchNorm/Conv3D for point-cloud workloads
+(paddle/phi/kernels/sparse/). Point-cloud submanifold conv is a
+gather/scatter workload with data-dependent patterns — a poor fit for the
+MXU — so we provide the activation/norm layers over BCOO values and leave
+Conv3D as a documented densify-and-conv fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.module import Layer
+
+__all__ = ["ReLU", "LeakyReLU", "Softmax", "BatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import map_values
+
+        return map_values(x, jax.nn.relu)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from . import map_values
+
+        return map_values(
+            x, lambda v: jax.nn.leaky_relu(v, self.negative_slope))
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a sparse matrix's stored entries.
+
+    Parity: paddle.sparse.nn.Softmax (CSR row softmax). Computed on the
+    COO form with a segment-softmax over row ids.
+    """
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse softmax supports axis=-1 only")
+
+    def forward(self, x):
+        from . import _as_bcoo
+
+        x = _as_bcoo(x, coalesce=True)
+        if x.n_dense:
+            raise ValueError("sparse Softmax expects scalar stored values "
+                             f"(n_dense=0); got n_dense={x.n_dense}")
+        # group by ALL leading sparse dims — softmax normalizes over the
+        # last axis only, whatever the tensor rank.
+        lead = x.indices[:, :-1].astype(jnp.int32)
+        n_groups = 1
+        seg = jnp.zeros((x.indices.shape[0],), jnp.int32)
+        for d in range(lead.shape[1]):
+            seg = seg * x.shape[d] + jnp.clip(lead[:, d], 0, x.shape[d] - 1)
+            n_groups *= x.shape[d]
+        # padded slots from coalescing carry out-of-range ids; mark them
+        # with an out-of-range segment so segment ops drop them.
+        valid = jnp.all(x.indices < jnp.array(x.shape), axis=1)
+        seg = jnp.where(valid, seg, n_groups)
+        segmax = jax.ops.segment_max(x.data, seg, num_segments=n_groups + 1)
+        idx = jnp.clip(seg, 0, n_groups)
+        shifted = jnp.exp(x.data - segmax[idx])
+        denom = jax.ops.segment_sum(shifted, seg, num_segments=n_groups + 1)
+        out = shifted / denom[idx]
+        return jsparse.BCOO((out, x.indices), shape=x.shape)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense trailing channel of sparse activations.
+
+    Operates on COO tensors whose *values carry a dense channel dim* —
+    i.e. ``n_dense >= 1`` with values shaped [nnz, ..., C], the layout
+    the reference's sparse batch_norm kernels use for point clouds
+    (values [nnz, C] for an [N, D, H, W, C] SparseCooTensor). Tracks
+    running statistics; eval mode normalizes with them.
+    """
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5):
+        super().__init__()
+        from ..core.parameter import Parameter
+        self.num_features = num_features
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+        self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer(
+            "_variance", jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        from . import _as_bcoo
+
+        x = _as_bcoo(x, coalesce=True)
+        if x.n_dense < 1 or x.data.shape[-1] != self.num_features:
+            raise ValueError(
+                "sparse BatchNorm needs values with a trailing dense "
+                f"channel of size {self.num_features} (n_dense>=1); got "
+                f"values of shape {x.data.shape} with n_dense={x.n_dense}. "
+                "Build the input with to_sparse_coo(dense, sparse_dim=k) "
+                "so the channel dim stays dense.")
+        v = x.data
+        axes = tuple(range(v.ndim - 1))
+        if self.training:
+            # coalescing pads freed slots with zero values at out-of-range
+            # indices; mask them out or they bias the statistics to zero
+            n_sparse = x.indices.shape[-1]
+            valid = jnp.all(
+                x.indices < jnp.array(x.shape[:n_sparse]), axis=-1)
+            w = valid.astype(v.dtype).reshape(
+                (-1,) + (1,) * (v.ndim - 1))
+            n = jnp.maximum(jnp.sum(valid), 1).astype(v.dtype) * (
+                v.size // v.shape[0] // self.num_features)
+            mean = jnp.sum(v * w, axis=axes) / n
+            var = jnp.sum(jnp.square(v - mean) * w, axis=axes) / n
+            if not isinstance(mean, jax.core.Tracer):
+                # eager only — same contract as dense BatchNorm2D: under
+                # jit the running stats stay frozen so no tracer leaks
+                # into the buffers
+                m = self.momentum
+                self._buffers["_mean"] = (
+                    m * self._buffers["_mean"] + (1 - m) * mean)
+                self._buffers["_variance"] = (
+                    m * self._buffers["_variance"] + (1 - m) * var)
+        else:
+            mean = self._buffers["_mean"]
+            var = self._buffers["_variance"]
+        out = (v - mean) / jnp.sqrt(var + self.epsilon)
+        out = out * self.weight.value + self.bias.value
+        return jsparse.BCOO((out, x.indices), shape=x.shape)

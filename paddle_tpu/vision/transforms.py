@@ -111,8 +111,18 @@ def resize(img, size, interpolation="bilinear"):
 
 def crop(img, top, left, height, width):
     if _is_pil(img):
+        # PIL pads out-of-bounds crops with zeros; mirror that on the
+        # numpy path below so both backends return the requested size
         return img.crop((left, top, left + width, top + height))
-    return np.asarray(img)[top : top + height, left : left + width]
+    arr = np.asarray(img)
+    out = arr[max(top, 0): top + height, max(left, 0): left + width]
+    if out.shape[0] != height or out.shape[1] != width:
+        padded = np.zeros((height, width) + arr.shape[2:], dtype=arr.dtype)
+        oy = max(-top, 0)
+        ox = max(-left, 0)
+        padded[oy:oy + out.shape[0], ox:ox + out.shape[1]] = out
+        return padded
+    return out
 
 
 def center_crop(img, size):

@@ -1,0 +1,252 @@
+"""paddle.sparse parity tests (reference: test/legacy_test sparse op tests,
+python/paddle/sparse/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(shape[0] * shape[1], size=nnz, replace=False)
+    rows, cols = np.unravel_index(flat, shape)
+    indices = np.stack([rows, cols]).astype(np.int32)
+    values = rng.standard_normal(nnz).astype(np.float32)
+    return indices, values
+
+
+def test_coo_roundtrip():
+    indices, values = _rand_coo()
+    s = sparse.sparse_coo_tensor(indices, values, shape=(4, 5))
+    assert sparse.is_sparse(s) and sparse.is_sparse_coo(s)
+    d = sparse.to_dense(s)
+    ref = np.zeros((4, 5), np.float32)
+    ref[indices[0], indices[1]] = values
+    np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-6)
+    # dense -> coo -> dense
+    s2 = sparse.to_sparse_coo(ref)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s2)), ref)
+    assert sparse.nnz(s) == 6
+
+
+def test_coo_infers_shape():
+    s = sparse.sparse_coo_tensor([[0, 2], [1, 3]], [1.0, 2.0])
+    assert s.shape == (3, 4)
+
+
+def test_csr_roundtrip():
+    crows = [0, 2, 3, 3]
+    cols = [1, 3, 2]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_csr_tensor(crows, cols, values, shape=(3, 4))
+    assert sparse.is_sparse_csr(s)
+    ref = np.array([[0, 1, 0, 2], [0, 0, 3, 0], [0, 0, 0, 0]], np.float32)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), ref)
+    coo = sparse.to_sparse_coo(s)
+    assert sparse.is_sparse_coo(coo)
+    back = sparse.to_sparse_csr(coo)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(back)), ref)
+
+
+def test_elementwise_and_scalar_ops():
+    ia, va = _rand_coo(seed=1)
+    ib, vb = _rand_coo(seed=2)
+    a = sparse.sparse_coo_tensor(ia, va, shape=(4, 5))
+    b = sparse.sparse_coo_tensor(ib, vb, shape=(4, 5))
+    da, db = np.asarray(sparse.to_dense(a)), np.asarray(sparse.to_dense(b))
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.add(a, b))), da + db, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.subtract(a, b))), da - db,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.divide(a, 2.0))), da / 2.0,
+        rtol=1e-6)
+
+
+def test_matmul_sparse_dense():
+    ia, va = _rand_coo(seed=3)
+    a = sparse.sparse_coo_tensor(ia, va, shape=(4, 5))
+    x = np.random.default_rng(0).standard_normal((5, 3)).astype(np.float32)
+    out = sparse.matmul(a, x)
+    ref = np.asarray(sparse.to_dense(a)) @ x
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(out)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = rng.standard_normal((6, 5)).astype(np.float32)
+    im, vm = _rand_coo(seed=5)
+    mask = sparse.sparse_coo_tensor(im, np.ones_like(vm), shape=(4, 5))
+    out = sparse.masked_matmul(x, y, mask)
+    full = x @ y
+    ref = np.zeros((4, 5), np.float32)
+    ref[im[0], im[1]] = full[im[0], im[1]]
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(out)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_transpose_and_relu():
+    ia, va = _rand_coo(seed=6)
+    a = sparse.sparse_coo_tensor(ia, va, shape=(4, 5))
+    t = sparse.transpose(a, [1, 0])
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(t)),
+        np.asarray(sparse.to_dense(a)).T, rtol=1e-6)
+    r = sparse.relu(a)
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(r)),
+        np.maximum(np.asarray(sparse.to_dense(a)), 0), rtol=1e-6)
+
+
+def test_coalesce_sums_duplicates():
+    s = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 2]], [1.0, 2.0, 3.0],
+                                 shape=(2, 3))
+    c = sparse.coalesce(s)
+    d = np.asarray(sparse.to_dense(c))
+    assert d[0, 1] == pytest.approx(3.0)
+    assert d[1, 2] == pytest.approx(3.0)
+    assert sparse.nnz(c) == 2  # padded slots are not counted
+
+
+def test_softmax_3d_normalizes_last_axis_only():
+    dense = np.zeros((1, 2, 2), np.float32)
+    dense[0, 0] = [1.0, 2.0]
+    dense[0, 1] = [3.0, 4.0]
+    s = sparse.to_sparse_coo(dense)
+    out = np.asarray(sparse.to_dense(sparse.nn.Softmax()(s)))
+    np.testing.assert_allclose(out[0, 0].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1].sum(), 1.0, rtol=1e-6)
+
+
+def test_sparse_batchnorm_jit_and_state_dict():
+    import jax
+
+    dense = np.zeros((6, 4), np.float32)
+    dense[[0, 2, 5]] = np.random.default_rng(1).standard_normal(
+        (3, 4)).astype(np.float32)
+    s = sparse.to_sparse_coo(dense, sparse_dim=1)
+    bn = sparse.nn.BatchNorm(4)
+    # jitted training call must not leak tracers into running stats
+    jax.jit(lambda t: bn(t).data)(s)
+    bn.eval()
+    bn(s)  # would raise UnexpectedTracerError before the fix
+    # running stats live in state_dict
+    assert "_mean" in bn.state_dict() and "_variance" in bn.state_dict()
+
+
+def test_sparse_nn_layers():
+    ia, va = _rand_coo(seed=7)
+    a = sparse.sparse_coo_tensor(ia, va, shape=(4, 5))
+    da = np.asarray(sparse.to_dense(a))
+
+    relu = sparse.nn.ReLU()
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(relu(a))),
+                               np.maximum(da, 0), rtol=1e-6)
+
+    leaky = sparse.nn.LeakyReLU(0.1)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(leaky(a))),
+                               np.where(da > 0, da, 0.1 * da),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_softmax_rows():
+    # one fully-stored row → softmax over stored entries must sum to 1
+    s = sparse.sparse_coo_tensor([[0, 0, 1], [0, 1, 2]], [1.0, 2.0, 5.0],
+                                 shape=(2, 3))
+    out = sparse.nn.Softmax()(s)
+    d = np.asarray(sparse.to_dense(out))
+    np.testing.assert_allclose(d[0].sum(), 1.0, rtol=1e-6)
+    ref0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    np.testing.assert_allclose(d[0, :2], ref0, rtol=1e-6)
+    np.testing.assert_allclose(d[1, 2], 1.0, rtol=1e-6)
+
+
+def test_relu_on_uncoalesced_matches_dense_semantics():
+    # duplicate index (0,1): stored 2.0 and -3.0 → dense value -1 → relu 0
+    s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [2.0, -3.0], shape=(2, 3))
+    d = np.asarray(sparse.to_dense(sparse.relu(s)))
+    assert d[0, 1] == pytest.approx(0.0)
+    # softmax over duplicates: row 1 has entries 1+1 (dup) and 2 → equal
+    s2 = sparse.sparse_coo_tensor([[1, 1, 1], [0, 0, 2]], [1.0, 1.0, 2.0],
+                                  shape=(2, 3))
+    d2 = np.asarray(sparse.to_dense(sparse.nn.Softmax()(s2)))
+    np.testing.assert_allclose(d2[1, 0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(d2[1, 2], 0.5, rtol=1e-6)
+
+
+def test_empty_indices_require_shape():
+    with pytest.raises(ValueError, match="shape must be given"):
+        sparse.sparse_coo_tensor(np.zeros((2, 0)), np.zeros((0,)))
+    s = sparse.sparse_coo_tensor(np.zeros((2, 0), np.int32),
+                                 np.zeros((0,), np.float32), shape=(3, 4))
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)),
+                               np.zeros((3, 4)))
+
+
+def test_sparse_batchnorm_dense_channel():
+    rng = np.random.default_rng(9)
+    dense = np.zeros((6, 4), np.float32)
+    rows = [0, 2, 5]
+    dense[rows] = rng.standard_normal((3, 4)).astype(np.float32)
+    s = sparse.to_sparse_coo(dense, sparse_dim=1)  # values [nnz, C]
+    bn = sparse.nn.BatchNorm(4)
+    out = bn(s)
+    v = np.asarray(out.data)
+    kept = v[np.any(v != 0, axis=1)]
+    np.testing.assert_allclose(kept.mean(axis=0), 0.0, atol=1e-5)
+    # eval mode uses running stats, not batch stats
+    bn.eval()
+    out2 = np.asarray(bn(s).data)
+    assert not np.allclose(out2, v)
+    # wrong layout (no dense channel) → clear error
+    flat = sparse.sparse_coo_tensor([[0], [1]], [1.0], shape=(2, 3))
+    with pytest.raises(ValueError, match="trailing dense channel"):
+        sparse.nn.BatchNorm(4)(flat)
+
+
+def test_sparse_under_jit():
+    import jax
+
+    ia, va = _rand_coo(seed=8)
+    a = sparse.sparse_coo_tensor(ia, va, shape=(4, 5))
+    x = jnp.ones((5, 2), jnp.float32)
+
+    @jax.jit
+    def f(s, x):
+        return sparse.to_dense(sparse.matmul(s, x))
+
+    out = f(a, x)
+    ref = np.asarray(sparse.to_dense(a)) @ np.asarray(x)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul_duplicate_mask_indices():
+    mask = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 1.0],
+                                    shape=(2, 2))
+    out = sparse.masked_matmul(np.ones((2, 3), np.float32),
+                               np.ones((3, 2), np.float32), mask)
+    d = np.asarray(sparse.to_dense(out))
+    assert d[0, 1] == pytest.approx(3.0)  # not doubled
+
+
+def test_nnz_csr_after_duplicated_coo():
+    d = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 2]], [1.0, 2.0, 3.0],
+                                 shape=(2, 3))
+    assert sparse.nnz(sparse.to_sparse_csr(d)) == 2
+
+
+def test_batchnorm_stats_ignore_padded_slots():
+    dense = np.zeros((4, 2), np.float32)
+    dense[[0, 2]] = [[0.4, 0.6], [0.4, 0.6]]
+    s = sparse.to_sparse_coo(dense, sparse_dim=1)
+    x = sparse.add(s, s)  # creates duplicate indices → coalesce pads
+    bn = sparse.nn.BatchNorm(2, momentum=0.0)
+    bn(x)
+    np.testing.assert_allclose(np.asarray(bn._buffers["_mean"]),
+                               [0.8, 1.2], rtol=1e-5)
