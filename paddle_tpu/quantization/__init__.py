@@ -32,35 +32,101 @@ def quantize_weight_int8(w: jax.Array, axis: int = 0):
     return q, scale
 
 
-def weight_only_linear(x, qweight, scale, bias=None):
-    """y = x @ dequant(qweight) (+ bias). qweight int8 [in, out], scale
-    [1, out] (per-out-channel). Parity: phi weight_only_linear."""
-    w = qweight.astype(x.dtype) * scale.astype(x.dtype)
-    y = jnp.matmul(x, w)
+def weight_only_linear(x, qweight, scale, bias=None, weight_dtype="int8",
+                       group_size=None, use_pallas=False):
+    """y = x @ dequant(qweight) (+ bias). Parity: phi weight_only_linear.
+
+    Two scale layouts:
+      - per-out-channel (the original int8 path): scale [1, out];
+      - group-wise (``group_size`` set): scale [in // group_size, out],
+        qweight int8 [in, out] or int4 packed [in // 2, out].
+    ``use_pallas`` routes group-wise matmuls through the Pallas
+    blockwise-dequant kernel (kernels/quant_matmul.py) when shapes tile.
+    """
+    if group_size is None:
+        w = qweight.astype(x.dtype) * scale.astype(x.dtype)
+        y = jnp.matmul(x, w)
+    else:
+        from ..kernels import quant_matmul as qmm
+
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        m, k = x2.shape
+        n = qweight.shape[1]
+        tiles = (use_pallas and m % 256 == 0 and n % 256 == 0
+                 and k % 256 == 0 and 256 % group_size == 0)
+        if tiles:
+            y = qmm.weight_only_matmul_pallas(
+                x2, qweight, scale, group_size=group_size,
+                weight_dtype=weight_dtype)
+        else:
+            y = qmm.weight_only_matmul_xla(
+                x2, qweight, scale, group_size=group_size,
+                weight_dtype=weight_dtype)
+        y = y.reshape(lead + (n,))
     if bias is not None:
         y = y + bias
     return y
 
 
 class WeightOnlyLinear(Layer):
-    """Drop-in for nn.Linear with int8 weights (inference)."""
+    """Drop-in for nn.Linear with int8/int4 weights (inference).
 
-    def __init__(self, linear_or_in, out_features: Optional[int] = None):
+    ``weight_dtype='int4'`` packs two 4-bit values per byte with
+    group-wise scales — weight HBM traffic drops 4x vs bf16, which is
+    what decode latency buys from (see kernels/quant_matmul.py).
+    """
+
+    def __init__(self, linear_or_in, out_features: Optional[int] = None,
+                 weight_dtype: str = "int8", group_size: Optional[int] = None,
+                 use_pallas: bool = True):
         super().__init__()
+        from ..kernels import quant_matmul as qmm
         from ..nn.layer.common import Linear
 
+        self.weight_dtype = weight_dtype
+        self.use_pallas = use_pallas
+        if weight_dtype == "int4" and group_size is None:
+            group_size = 128
         if isinstance(linear_or_in, Linear):
             src = linear_or_in
-            q, s = quantize_weight_int8(src.weight.value, axis=1)
             self.in_features = src.in_features
             self.out_features = src.out_features
+            if group_size is not None and self.in_features % group_size:
+                group_size = self.in_features  # degenerate single group
+            w = src.weight.value
+            if weight_dtype == "int4":
+                q, s = qmm.quantize_weight_int4_grouped(w, group_size)
+            elif group_size is not None:
+                q, s = qmm.quantize_weight_int8_grouped(w, group_size)
+            else:
+                q, s = quantize_weight_int8(w, axis=1)
             bias = None if src.bias is None else src.bias.value
         else:
             self.in_features = linear_or_in
             self.out_features = out_features
-            q = jnp.zeros((self.in_features, self.out_features), jnp.int8)
-            s = jnp.ones((1, self.out_features), jnp.float32)
+            if group_size is not None and self.in_features % group_size:
+                group_size = self.in_features  # degenerate single group
+            if weight_dtype == "int4":
+                if self.in_features % 2:
+                    raise ValueError(
+                        "int4 packing needs an even in_features; got "
+                        f"{self.in_features}")
+                q = jnp.zeros(
+                    (self.in_features // 2, self.out_features), jnp.int8)
+                s = jnp.ones((self.in_features // group_size,
+                              self.out_features), jnp.float32)
+            elif group_size is not None:
+                q = jnp.zeros(
+                    (self.in_features, self.out_features), jnp.int8)
+                s = jnp.ones((self.in_features // group_size,
+                              self.out_features), jnp.float32)
+            else:
+                q = jnp.zeros(
+                    (self.in_features, self.out_features), jnp.int8)
+                s = jnp.ones((1, self.out_features), jnp.float32)
             bias = jnp.zeros((self.out_features,), jnp.float32)
+        self.group_size = group_size
         self.register_buffer("qweight", q)
         self.register_buffer("scale", s)
         if bias is not None:
@@ -72,6 +138,8 @@ class WeightOnlyLinear(Layer):
         return weight_only_linear(
             x, self._buffers["qweight"], self._buffers["scale"],
             None if self.bias is None else self.bias.value,
+            weight_dtype=self.weight_dtype, group_size=self.group_size,
+            use_pallas=self.use_pallas,
         )
 
 
@@ -103,12 +171,23 @@ class FakeQuant(Layer):
         return x + jax.lax.stop_gradient(q - x)
 
 
-def quantize_model_weight_only(model: Layer) -> Layer:
+def quantize_model_weight_only(model: Layer, weight_dtype: str = "int8",
+                               group_size: Optional[int] = None) -> Layer:
     """Replace every nn.Linear in the tree with WeightOnlyLinear."""
     from ..nn.layer.common import Linear
+    from .qat import replace_layers
 
-    for parent in model.sublayers(include_self=True):
-        for name, sub in list(parent._sub_layers.items()):
-            if type(sub) is Linear:
-                parent._sub_layers[name] = WeightOnlyLinear(sub)
-    return model
+    return replace_layers(
+        model, lambda s: type(s) is Linear,
+        lambda s: WeightOnlyLinear(s, weight_dtype=weight_dtype,
+                                   group_size=group_size))
+
+
+from .observer import (  # noqa: E402,F401
+    AbsmaxObserver,
+    BaseObserver,
+    EMAObserver,
+    MSEObserver,
+    PercentileObserver,
+)
+from .qat import PTQ, QAT, QuantConfig, QuantedLinear  # noqa: E402,F401

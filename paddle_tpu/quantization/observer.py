@@ -1,0 +1,117 @@
+"""Calibration observers (parity: python/paddle/quantization/observers/).
+
+Observers watch activations/weights during PTQ calibration (eager, host
+side — calibration is a few dozen batches, not a hot path) and produce
+the quantization scale used at convert time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.module import Layer
+
+
+class BaseObserver(Layer):
+    """Pass-through layer that records statistics of what flows through."""
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scale(self, qmax: int = 127):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (parity: AbsmaxObserver)."""
+
+    def __init__(self):
+        super().__init__()
+        self._amax = 0.0
+
+    def observe(self, x):
+        self._amax = max(self._amax, float(jnp.max(jnp.abs(x))))
+
+    def scale(self, qmax: int = 127):
+        return max(self._amax, 1e-8) / qmax
+
+
+class EMAObserver(BaseObserver):
+    """Exponential moving average of per-batch absmax (parity:
+    EMDObserver/AVGObserver family — smooths outlier batches)."""
+
+    def __init__(self, momentum: float = 0.9):
+        super().__init__()
+        self.momentum = momentum
+        self._amax = None
+
+    def observe(self, x):
+        amax = float(jnp.max(jnp.abs(x)))
+        self._amax = amax if self._amax is None else (
+            self.momentum * self._amax + (1 - self.momentum) * amax)
+
+    def scale(self, qmax: int = 127):
+        return max(self._amax or 0.0, 1e-8) / qmax
+
+
+class PercentileObserver(BaseObserver):
+    """Clips to the p-th percentile of |x| samples (parity:
+    HistObserver/KL-based observers' role: outlier-robust range)."""
+
+    def __init__(self, percentile: float = 99.9, max_samples: int = 1 << 20):
+        super().__init__()
+        self.percentile = percentile
+        self.max_samples = max_samples
+        self._samples = []
+
+    def observe(self, x):
+        flat = np.abs(np.asarray(x, dtype=np.float32)).ravel()
+        if flat.size > self.max_samples:
+            idx = np.random.default_rng(0).choice(
+                flat.size, self.max_samples, replace=False)
+            flat = flat[idx]
+        self._samples.append(flat)
+
+    def scale(self, qmax: int = 127):
+        if not self._samples:
+            return 1e-8
+        allv = np.concatenate(self._samples)
+        return max(float(np.percentile(allv, self.percentile)), 1e-8) / qmax
+
+
+class MSEObserver(BaseObserver):
+    """Searches the clip range minimizing quantization MSE (parity:
+    MSEObserver). Candidate scales are fractions of the observed absmax."""
+
+    def __init__(self, steps: int = 20):
+        super().__init__()
+        self.steps = steps
+        self._amax = 0.0
+        self._samples = []
+
+    def observe(self, x):
+        arr = np.asarray(x, dtype=np.float32).ravel()
+        if arr.size > (1 << 18):
+            arr = arr[:: arr.size // (1 << 18) + 1]
+        self._samples.append(arr)
+        self._amax = max(self._amax, float(np.max(np.abs(arr))))
+
+    def scale(self, qmax: int = 127):
+        if not self._samples or self._amax == 0.0:
+            return 1e-8
+        v = np.concatenate(self._samples)
+        best, best_err = self._amax, np.inf
+        for i in range(self.steps):
+            amax = self._amax * (1.0 - i / (2.0 * self.steps))
+            s = amax / qmax
+            q = np.clip(np.round(v / s), -qmax, qmax) * s
+            err = float(np.mean((v - q) ** 2))
+            if err < best_err:
+                best, best_err = amax, err
+        return max(best, 1e-8) / qmax

@@ -1,0 +1,206 @@
+"""QAT / PTQ workflows (parity: python/paddle/quantization/{qat,ptq}.py,
+QuantConfig in python/paddle/quantization/config.py).
+
+Usage parity with the reference:
+
+    q_config = QuantConfig(activation=FakeQuant(bits=8), weight=...)
+    qat = QAT(q_config)
+    qmodel = qat.quantize(model)        # Linear → QuantedLinear (STE)
+    ... train ...
+    infer_model = qat.convert(qmodel)   # → WeightOnlyLinear (int8)
+
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver))
+    pmodel = ptq.quantize(model)        # insert observers
+    for batch in calib: pmodel(batch)
+    infer_model = ptq.convert(pmodel)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, Optional, Type
+
+from ..core.module import Layer
+from ..nn.layer.common import Linear
+from .observer import AbsmaxObserver, BaseObserver
+
+
+class _Unset:
+    def __repr__(self):
+        return "<UNSET>"
+
+
+UNSET = _Unset()
+
+
+class QuantConfig:
+    """Which layers get quantized and with what quanter/observer.
+
+    ``activation`` / ``weight`` accept a factory (class / zero-arg
+    callable) or a template quanter *instance* (deep-copied per
+    instrumented layer so statistics are never shared). Explicit ``None``
+    means "leave unquantized" (reference semantics); leaving an override
+    field unset inherits the global setting.
+    """
+
+    def __init__(self, activation=UNSET, weight=UNSET):
+        self.activation = activation
+        self.weight = weight
+        self._layer_overrides: Dict[int, dict] = {}
+        self._type_overrides: Dict[Type, dict] = {}
+
+    def add_layer_config(self, layer, activation=UNSET, weight=UNSET):
+        for lyr in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_overrides[id(lyr)] = {
+                "activation": activation, "weight": weight}
+        return self
+
+    def add_type_config(self, layer_type, activation=UNSET, weight=UNSET):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_overrides[t] = {
+                "activation": activation, "weight": weight}
+        return self
+
+    def _for(self, layer) -> dict:
+        override = self._layer_overrides.get(id(layer)) or \
+            self._type_overrides.get(type(layer)) or {}
+        out = {"activation": self.activation, "weight": self.weight}
+        for k, v in override.items():
+            if v is not UNSET:
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _make(factory, default=None):
+        """UNSET → default; None → None (disabled); Layer instance →
+        per-layer deep copy; class/callable → call it."""
+        if factory is UNSET:
+            factory = default
+        if factory is None:
+            return None
+        if isinstance(factory, Layer):
+            return copy.deepcopy(factory)
+        return factory() if callable(factory) else factory
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on activations and weights (QAT training)."""
+
+    def __init__(self, linear: Linear, act_quanter=None, wt_quanter=None):
+        super().__init__()
+        self.source = linear
+        self.act_quanter = act_quanter
+        self.wt_quanter = wt_quanter
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.source.weight.value
+        if self.wt_quanter is not None:
+            w = self.wt_quanter(w)
+        y = jnp.matmul(x, w.astype(x.dtype))
+        if self.source.bias is not None:
+            y = y + self.source.bias.value.astype(y.dtype)
+        return y
+
+
+def replace_layers(model: Layer, match: Callable[[Layer], bool],
+                   make: Callable[[Layer], Layer]) -> Layer:
+    """Swap every sublayer where ``match`` holds with ``make(sub)`` —
+    the single tree-mutation walk all quantize/convert passes share."""
+    for parent in model.sublayers(include_self=True):
+        for name, sub in list(parent._sub_layers.items()):
+            if match(sub):
+                parent._sub_layers[name] = make(sub)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (parity: paddle.quantization.QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(linear):
+            from . import FakeQuant
+
+            cfg = self.config._for(linear)
+            act = QuantConfig._make(cfg["activation"], default=FakeQuant)
+            wt = QuantConfig._make(cfg["weight"], default=FakeQuant)
+            if act is None and wt is None:
+                return linear  # explicitly disabled for this layer
+            return QuantedLinear(linear, act, wt)
+
+        return replace_layers(model, lambda s: type(s) is Linear, make)
+
+    def convert(self, model: Layer, inplace: bool = True,
+                weight_dtype: str = "int8") -> Layer:
+        """Strip quanters; emit WeightOnlyLinear for deployment."""
+        from . import WeightOnlyLinear
+
+        if not inplace:
+            model = copy.deepcopy(model)
+        return replace_layers(
+            model, lambda s: isinstance(s, QuantedLinear),
+            lambda s: WeightOnlyLinear(s.source, weight_dtype=weight_dtype))
+
+
+class PTQ:
+    """Post-training quantization driver (parity: paddle.quantization.PTQ).
+
+    ``quantize`` inserts activation observers in front of each Linear;
+    run calibration batches through the model eagerly; ``convert``
+    replaces the pairs with WeightOnlyLinear whose *activation scale* is
+    stored for downstream use (weight scales are computed from weights
+    directly, matching the reference's weight-only PTQ path).
+    """
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig(activation=AbsmaxObserver)
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(linear):
+            cfg = self.config._for(linear)
+            obs = QuantConfig._make(cfg["activation"], default=AbsmaxObserver)
+            if obs is None:
+                return linear
+            return _ObservedLinear(linear, obs)
+
+        return replace_layers(model, lambda s: type(s) is Linear, make)
+
+    def convert(self, model: Layer, inplace: bool = True,
+                weight_dtype: str = "int8") -> Layer:
+        from . import WeightOnlyLinear
+
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(sub):
+            wol = WeightOnlyLinear(sub.source, weight_dtype=weight_dtype)
+            wol.act_scale = sub.observer.scale()
+            return wol
+
+        return replace_layers(
+            model, lambda s: isinstance(s, _ObservedLinear), make)
+
+
+class _ObservedLinear(Layer):
+    def __init__(self, linear: Linear, observer: BaseObserver):
+        super().__init__()
+        self.source = linear
+        self.observer = observer
+
+    def forward(self, x):
+        self.observer.observe(x)
+        return self.source(x)

@@ -115,7 +115,8 @@ def crop(img, top, left, height, width):
         # numpy path below so both backends return the requested size
         return img.crop((left, top, left + width, top + height))
     arr = np.asarray(img)
-    out = arr[max(top, 0): top + height, max(left, 0): left + width]
+    out = arr[max(top, 0): max(top + height, 0),
+              max(left, 0): max(left + width, 0)]
     if out.shape[0] != height or out.shape[1] != width:
         padded = np.zeros((height, width) + arr.shape[2:], dtype=arr.dtype)
         oy = max(-top, 0)

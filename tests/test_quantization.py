@@ -1,0 +1,194 @@
+"""Quantization parity tests (reference: test/quantization/, phi
+weight_only_linear kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu import quantization as Q
+from paddle_tpu.kernels import quant_matmul as qmm
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+
+
+def test_int8_grouped_quantize_roundtrip():
+    w = _rand((256, 64))
+    q, s = qmm.quantize_weight_int8_grouped(w, group_size=128)
+    assert q.dtype == jnp.int8 and s.shape == (2, 64)
+    deq = np.asarray(q, np.float32).reshape(2, 128, 64) * \
+        np.asarray(s)[:, None, :]
+    np.testing.assert_allclose(deq.reshape(256, 64), w, atol=np.abs(w).max() / 100)
+
+
+def test_int4_pack_unpack_roundtrip():
+    w = _rand((256, 64), seed=1)
+    packed, s = qmm.quantize_weight_int4_grouped(w, group_size=128)
+    assert packed.shape == (128, 64) and packed.dtype == jnp.int8
+    unpacked = np.asarray(qmm._unpack_int4(packed))
+    assert unpacked.shape == (256, 64)
+    assert unpacked.min() >= -8 and unpacked.max() <= 7
+    deq = unpacked.astype(np.float32).reshape(2, 128, 64) * \
+        np.asarray(s)[:, None, :]
+    # int4 is coarse: tolerance is half a quant step per group
+    err = np.abs(deq.reshape(256, 64) - w)
+    step = np.repeat(np.asarray(s), 128, axis=0)
+    assert (err <= step * 0.5 + 1e-6).all()
+
+
+@pytest.mark.parametrize("wdtype", ["int8", "int4"])
+def test_pallas_matmul_matches_xla(wdtype):
+    w = _rand((512, 256), seed=2)
+    x = _rand((256, 512), seed=3)
+    if wdtype == "int4":
+        q, s = qmm.quantize_weight_int4_grouped(w, group_size=128)
+    else:
+        q, s = qmm.quantize_weight_int8_grouped(w, group_size=128)
+    ref = np.asarray(qmm.weight_only_matmul_xla(
+        jnp.asarray(x), q, s, group_size=128, weight_dtype=wdtype))
+    out = np.asarray(qmm.weight_only_matmul_pallas(
+        jnp.asarray(x), q, s, group_size=128, weight_dtype=wdtype))
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+    # and both approximate the fp matmul: per-element error grows
+    # ~sqrt(k)·step, so check the relative Frobenius error instead
+    rel = np.linalg.norm(out - x @ w) / np.linalg.norm(x @ w)
+    assert rel < (0.01 if wdtype == "int8" else 0.2)
+
+
+@pytest.mark.parametrize("wdtype", ["int8", "int4"])
+def test_weight_only_linear_layer(wdtype):
+    lin = nn.Linear(128, 64)
+    x = jnp.asarray(_rand((4, 128), seed=4))
+    ref = np.asarray(lin(x))
+    wol = Q.WeightOnlyLinear(lin, weight_dtype=wdtype, group_size=64)
+    out = np.asarray(wol(x))
+    tol = 0.05 if wdtype == "int8" else 0.6
+    assert np.abs(out - ref).max() < tol
+    # state_dict carries quantized buffers
+    sd = wol.state_dict()
+    assert "qweight" in sd and "scale" in sd
+
+
+def test_quantize_model_weight_only_int4():
+    model = nn.Sequential(nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 32))
+    x = jnp.asarray(_rand((2, 64), seed=5))
+    ref = np.asarray(model(x))
+    qmodel = Q.quantize_model_weight_only(model, weight_dtype="int4",
+                                          group_size=32)
+    out = np.asarray(qmodel(x))
+    assert np.abs(out - ref).max() < 0.5
+
+
+def test_observers():
+    x = jnp.asarray(_rand((1000,), seed=6))
+    for obs_cls in [Q.AbsmaxObserver, Q.EMAObserver, Q.PercentileObserver,
+                    Q.MSEObserver]:
+        obs = obs_cls()
+        obs(x)
+        s = obs.scale(127)
+        assert s > 0
+        # scale roughly amax/127
+        assert s <= float(jnp.max(jnp.abs(x))) / 127 * 1.5 + 1e-6
+    # percentile clips outliers below absmax
+    y = jnp.concatenate([x, jnp.asarray([100.0])])
+    pobs, aobs = Q.PercentileObserver(99.0), Q.AbsmaxObserver()
+    pobs(y); aobs(y)
+    assert pobs.scale() < aobs.scale()
+
+
+def test_qat_roundtrip_and_convert():
+    model = nn.Sequential(nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 8))
+    x = jnp.asarray(_rand((4, 32), seed=7))
+    ref = np.asarray(model(x))
+
+    qat = Q.QAT(Q.QuantConfig())
+    qmodel = qat.quantize(model, inplace=False)
+    assert any(isinstance(m, Q.QuantedLinear)
+               for m in qmodel.sublayers(include_self=True))
+    out = np.asarray(qmodel(x))
+    assert np.abs(out - ref).max() < 0.5  # fake-quant ~ close to fp
+
+    # STE: gradients flow through fake-quant to the source weights
+    from paddle_tpu.core.functional import functional_call
+
+    params = {n: p.value for n, p in qmodel.named_parameters()}
+
+    def loss_fn(params):
+        y = functional_call(qmodel, params, x)
+        return jnp.mean(y ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    gnorms = [float(jnp.linalg.norm(g)) for g in grads.values()]
+    assert any(g > 0 for g in gnorms)
+
+    infer = qat.convert(qmodel, inplace=False)
+    assert any(isinstance(m, Q.WeightOnlyLinear)
+               for m in infer.sublayers(include_self=True))
+    out2 = np.asarray(infer(x))
+    assert np.abs(out2 - ref).max() < 0.5
+
+
+def test_ptq_calibrate_convert():
+    model = nn.Sequential(nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 8))
+    x = jnp.asarray(_rand((16, 32), seed=8))
+    ref = np.asarray(model(x))
+    ptq = Q.PTQ(Q.QuantConfig(activation=Q.AbsmaxObserver))
+    pmodel = ptq.quantize(model, inplace=False)
+    for i in range(3):  # calibration passes
+        pmodel(x)
+    infer = ptq.convert(pmodel, inplace=False)
+    wols = [m for m in infer.sublayers(include_self=True)
+            if isinstance(m, Q.WeightOnlyLinear)]
+    assert len(wols) == 2
+    assert all(getattr(m, "act_scale", 0) > 0 for m in wols)
+    out = np.asarray(infer(x))
+    assert np.abs(out - ref).max() < 0.2
+
+
+def test_quantconfig_instance_template_and_none_semantics():
+    # docstring usage: a pre-configured quanter INSTANCE as template
+    cfg = Q.QuantConfig(activation=Q.FakeQuant(bits=4), weight=None)
+    model = nn.Sequential(nn.Linear(16, 16), nn.Linear(16, 16))
+    qm = Q.QAT(cfg).quantize(model, inplace=False)
+    qls = [m for m in qm.sublayers(include_self=True)
+           if isinstance(m, Q.QuantedLinear)]
+    assert len(qls) == 2
+    # each layer got its OWN copy (no shared stats) and weight=None stuck
+    assert qls[0].act_quanter is not qls[1].act_quanter
+    assert qls[0].act_quanter.qmax == 7  # bits=4 template honored
+    assert all(q.wt_quanter is None for q in qls)
+    # explicit None for both → layer left untouched
+    cfg2 = Q.QuantConfig(activation=None, weight=None)
+    qm2 = Q.QAT(cfg2).quantize(model, inplace=False)
+    assert not any(isinstance(m, Q.QuantedLinear)
+                   for m in qm2.sublayers(include_self=True))
+    # override inherits unset fields from global config
+    cfg3 = Q.QuantConfig(activation=Q.FakeQuant(bits=4))
+    lyr = model._sub_layers["0"]
+    cfg3.add_layer_config(lyr, weight=None)
+    got = cfg3._for(lyr)
+    assert got["weight"] is None and got["activation"] is not None
+
+
+def test_weight_only_linear_shape_ctor_degenerate_group():
+    wol = Q.WeightOnlyLinear(100, 64, weight_dtype="int4")  # 100 % 128 != 0
+    assert wol.group_size == 100
+    assert wol._buffers["scale"].shape == (1, 64)
+    assert wol._buffers["qweight"].shape == (50, 64)
+    x = jnp.zeros((2, 100))
+    assert wol(x).shape == (2, 64)
+    with pytest.raises(ValueError, match="even in_features"):
+        Q.WeightOnlyLinear(99, 64, weight_dtype="int4")
+
+
+def test_crop_fully_outside_returns_zeros():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.ones((10, 10, 3), np.uint8)
+    out = T.crop(img, -5, 0, 3, 10)
+    assert out.shape == (3, 10, 3)
+    assert (out == 0).all()
