@@ -172,6 +172,20 @@ class Predictor:
         return self._ttft_ms
 
 
+from .paged import (  # noqa: E402,F401
+    PagedLayerCache,
+    PagedState,
+    PagePool,
+    init_paged_pool,
+    paged_attention,
+)
+from .serving import (  # noqa: E402,F401
+    ContinuousBatchingEngine,
+    EngineConfig,
+    Request,
+)
+
+
 def create_predictor(model_or_config, config: Optional[Config] = None):
     """Parity: paddle_infer.create_predictor. Accepts a Layer directly
     (the TPU-native path) or a Config whose model_dir holds a saved

@@ -1,0 +1,166 @@
+"""Paged KV cache (parity: the reference's decode-path cache machinery —
+phi ``masked_multihead_attention`` / ``fused_multi_transformer``'s
+contiguous per-sequence caches — upgraded to a vLLM-style page pool).
+
+TPU-native design: XLA needs static shapes, so the pool is a fixed
+tensor ``[n_pages, page_size, kv_heads, head_dim]`` per layer and the
+indirection is data: a ``block_table`` [slots, max_pages] of page ids
+and per-slot ``seq_lens``. Gathers over the page axis compile to
+efficient dynamic-gathers; no recompilation as sequences come and go.
+The win over per-slot contiguous caches is oversubscription: the pool
+holds ``n_pages × page_size`` tokens total, which can be far less than
+``slots × max_len`` when sequence lengths vary — the same HBM savings
+that motivate paging on GPUs, but with the block-table gather living
+inside one jitted decode program.
+
+Page allocation (free-list) is host-side bookkeeping in the engine —
+it's O(requests), not O(tokens), and never enters the compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedLayerCache(NamedTuple):
+    """Per-layer page pool + indirection (all device arrays)."""
+
+    k_pages: jax.Array  # [n_pages, page_size, kv_heads, head_dim]
+    v_pages: jax.Array  # [n_pages, page_size, kv_heads, head_dim]
+
+
+class PagedState(NamedTuple):
+    """Cross-layer decode state carried through the jitted step."""
+
+    block_tables: jax.Array  # [slots, max_pages] int32 page ids
+    seq_lens: jax.Array  # [slots] int32 — tokens already in cache
+
+
+def init_paged_pool(n_layers: int, n_pages: int, page_size: int,
+                    kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+    return [
+        PagedLayerCache(
+            k_pages=jnp.zeros((n_pages, page_size, kv_heads, head_dim),
+                              dtype),
+            v_pages=jnp.zeros((n_pages, page_size, kv_heads, head_dim),
+                              dtype),
+        )
+        for _ in range(n_layers)
+    ]
+
+
+def append_kv(cache: PagedLayerCache, state: PagedState, k, v
+              ) -> PagedLayerCache:
+    """Write one token's K/V per slot at its current length.
+
+    k, v: [slots, 1, kv_heads, head_dim]. The destination of slot i is
+    page ``block_tables[i, len_i // page_size]`` offset ``len_i %
+    page_size`` — a scatter with computed indices, fully inside jit.
+    """
+    page_size = cache.k_pages.shape[1]
+    slots = k.shape[0]
+    lens = state.seq_lens
+    page_idx = lens // page_size
+    offs = lens % page_size
+    pages = state.block_tables[jnp.arange(slots), page_idx]  # [slots]
+    k_pages = cache.k_pages.at[pages, offs].set(
+        k[:, 0].astype(cache.k_pages.dtype))
+    v_pages = cache.v_pages.at[pages, offs].set(
+        v[:, 0].astype(cache.v_pages.dtype))
+    return PagedLayerCache(k_pages, v_pages)
+
+
+def gather_kv(cache: PagedLayerCache, state: PagedState
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Materialize each slot's logical KV view: [slots, max_ctx, kvh, d]
+    where max_ctx = max_pages * page_size (mask handles the tail)."""
+    bt = state.block_tables  # [slots, max_pages]
+    slots, max_pages = bt.shape
+    _, page_size, kvh, d = cache.k_pages.shape
+    k = cache.k_pages[bt]  # [slots, max_pages, page_size, kvh, d]
+    v = cache.v_pages[bt]
+    return (k.reshape(slots, max_pages * page_size, kvh, d),
+            v.reshape(slots, max_pages * page_size, kvh, d))
+
+
+def paged_attention(q, cache: PagedLayerCache, state: PagedState,
+                    scale=None):
+    """Decode attention over the paged cache.
+
+    q: [slots, 1, heads, head_dim] (GQA: heads a multiple of kv_heads).
+    The current token's K/V must already be appended, so slot i attends
+    to positions [0, seq_lens[i]] inclusive of itself.
+    Returns [slots, 1, heads, head_dim].
+    """
+    slots, one, h, d = q.shape
+    k, v = gather_kv(cache, state)  # [slots, ctx, kvh, d]
+    ctx = k.shape[1]
+    kvh = k.shape[2]
+    if h != kvh:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    # [slots, h, 1, ctx]
+    s = jnp.einsum("sqhd,skhd->shqk", qf, k.astype(jnp.float32))
+    mask = jnp.arange(ctx)[None, :] <= state.seq_lens[:, None]  # [slots,ctx]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shqk,skhd->sqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class PagePool:
+    """Host-side page allocator (free list) + device state mirror.
+
+    The engine calls ``alloc``/``free`` as requests arrive/finish and
+    pushes the updated block table to the device as plain int32 data —
+    allocation never triggers recompilation.
+    """
+
+    def __init__(self, n_pages: int, page_size: int, slots: int,
+                 max_pages_per_slot: int):
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.slots = slots
+        self.max_pages_per_slot = max_pages_per_slot
+        self._free = list(range(n_pages - 1, -1, -1))
+        self.block_tables = np.zeros((slots, max_pages_per_slot), np.int32)
+        self.pages_of: dict = {i: [] for i in range(slots)}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def alloc(self, slot: int, n_tokens: int) -> bool:
+        """Ensure slot has pages for n_tokens total; False if pool full."""
+        have = len(self.pages_of[slot])
+        need = self.pages_needed(n_tokens) - have
+        if need > len(self._free) or \
+                have + max(need, 0) > self.max_pages_per_slot:
+            return False
+        for _ in range(max(need, 0)):
+            p = self._free.pop()
+            self.block_tables[slot, len(self.pages_of[slot])] = p
+            self.pages_of[slot].append(p)
+        return True
+
+    def free(self, slot: int):
+        self._free.extend(reversed(self.pages_of[slot]))
+        self.pages_of[slot] = []
+        self.block_tables[slot] = 0
+
+    def device_state(self, seq_lens: np.ndarray) -> PagedState:
+        return PagedState(
+            block_tables=jnp.asarray(self.block_tables),
+            seq_lens=jnp.asarray(seq_lens, jnp.int32),
+        )

@@ -1,0 +1,317 @@
+"""Continuous-batching decode engine (parity: the reference's serving
+decode path — phi ``fused_multi_transformer`` + ``masked_multihead_
+attention``'s batched per-sequence caches, as driven by FastDeploy-style
+servers; upgraded with a paged KV pool).
+
+TPU-native shape discipline: ONE compiled decode program with a static
+``[slots, 1]`` token batch serves the whole lifetime of the engine.
+Sequences enter and leave *as data*: per-slot lengths, an active mask,
+and (paged mode) block tables are device arrays the host scheduler
+updates — no shape ever changes, so nothing recompiles. Prefill runs
+per-request on bucketed lengths (each bucket compiles once) and its KV
+is scattered into the live pool, overlapping new-request admission with
+ongoing decode — the essence of continuous batching.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.functional import extract_params, functional_call
+from ..core.module import Layer
+from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
+
+
+@dataclass
+class EngineConfig:
+    max_slots: int = 4
+    max_len: int = 1024
+    seq_buckets: Sequence[int] = (64, 128, 256, 512, 1024)
+    paged: bool = False
+    page_size: int = 64
+    n_pages: Optional[int] = None  # default: slots*max_len/page_size (+sink)
+    cache_dtype: object = jnp.float32
+    greedy: bool = True
+    temperature: float = 1.0
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    output: List[int] = field(default_factory=list)
+    ttft_ms: Optional[float] = None
+    slot: Optional[int] = None
+    done: bool = False
+    _submit_t: float = 0.0
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a causal-LM Layer.
+
+    The model must expose ``init_kv_caches`` and accept ``kv_caches`` /
+    ``cache_index`` (vector per-slot lengths) in forward — the contract
+    ``models/llama.py`` implements.
+    """
+
+    def __init__(self, model: Layer, config: Optional[EngineConfig] = None):
+        self.model = model
+        self.cfg = config or EngineConfig()
+        model.eval()
+        self.params = extract_params(model)
+        cfg = self.cfg
+
+        self.seq_lens = np.zeros((cfg.max_slots,), np.int64)
+        self.active = np.zeros((cfg.max_slots,), bool)
+        self.last_tok = np.zeros((cfg.max_slots,), np.int64)
+        self._slot_req: Dict[int, Request] = {}
+        self._queue: collections.deque = collections.deque()
+        self._next_rid = 0
+        self._finished: Dict[int, Request] = {}
+        self._key = jax.random.PRNGKey(cfg.seed)
+
+        mcfg = model.config
+        self._n_layers = mcfg.num_hidden_layers
+        kvh = mcfg.num_key_value_heads
+        hd = mcfg.head_dim
+        if cfg.paged:
+            if cfg.max_len % cfg.page_size:
+                raise ValueError("max_len must be divisible by page_size")
+            max_pages_per_slot = cfg.max_len // cfg.page_size
+            # +1: page 0 is the inactive-slot write sink, never allocated
+            n_pages = cfg.n_pages or \
+                cfg.max_slots * max_pages_per_slot + 1
+            # page 0 is a write sink for inactive slots — never allocated
+            self.pool = PagePool(n_pages, cfg.page_size, cfg.max_slots,
+                                 max_pages_per_slot)
+            self.pool._free = [p for p in self.pool._free if p != 0]
+            self.layer_caches = init_paged_pool(
+                self._n_layers, n_pages, cfg.page_size, kvh, hd,
+                dtype=cfg.cache_dtype)
+        else:
+            self.pool = None
+            self.caches = model.init_kv_caches(
+                cfg.max_slots, cfg.max_len, dtype=cfg.cache_dtype)
+
+        self._decode_c = None
+        self._prefill_c = None
+        self._insert_c = None
+        self._scatter_c = None
+
+    # ---------------- request lifecycle ----------------
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    eos_token_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt).reshape(-1)
+        if prompt.size + max_new_tokens > self.cfg.max_len:
+            raise ValueError(
+                f"prompt({prompt.size}) + max_new_tokens({max_new_tokens}) "
+                f"exceeds max_len={self.cfg.max_len}")
+        req = Request(self._next_rid, prompt, max_new_tokens, eos_token_id,
+                      _submit_t=time.perf_counter())
+        self._next_rid += 1
+        self._queue.append(req)
+        return req.rid
+
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.cfg.max_slots) if not self.active[i]]
+
+    # ---------------- compiled programs ----------------
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.seq_buckets:
+            if n <= b:
+                return min(b, self.cfg.max_len)
+        return self.cfg.max_len
+
+    def _prefill(self):
+        # one jitted fn serves every bucket: jit specializes per shape
+        if self._prefill_c is None:
+            def fn(params, ids, caches):
+                pos = jnp.broadcast_to(
+                    jnp.arange(ids.shape[1])[None, :], ids.shape)
+                return functional_call(self.model, params, ids,
+                                       position_ids=pos, kv_caches=caches,
+                                       cache_index=0)
+            self._prefill_c = jax.jit(fn)
+        return self._prefill_c
+
+    def _insert_contig(self):
+        # write a single-sequence prefill cache into slot `slot` of the
+        # global contiguous cache (dynamic_update_slice over slot axis)
+        if self._insert_c is None:
+            def fn(global_caches, one_caches, slot):
+                out = []
+                for (gk, gv), (ok, ov) in zip(global_caches, one_caches):
+                    pad = gk.shape[1] - ok.shape[1]
+                    ok = jnp.pad(ok, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    ov = jnp.pad(ov, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    gk = jax.lax.dynamic_update_slice_in_dim(
+                        gk, ok.astype(gk.dtype), slot, 0)
+                    gv = jax.lax.dynamic_update_slice_in_dim(
+                        gv, ov.astype(gv.dtype), slot, 0)
+                    out.append((gk, gv))
+                return out
+            self._insert_c = jax.jit(fn, donate_argnums=(0,))
+        return self._insert_c
+
+    def _scatter_paged(self):
+        # scatter a [1, bucket] prefill cache into this slot's pages;
+        # bucket/n_used come from the traced shapes, so one jitted fn
+        # specializes per bucket automatically
+        if self._scatter_c is None:
+            ps = self.cfg.page_size
+
+            def fn(layer_caches, one_caches, bt_row):
+                out = []
+                for cache, (ok, ov) in zip(layer_caches, one_caches):
+                    n_used = ok.shape[1] // ps
+                    pages = bt_row[:n_used]
+                    okp = ok[0].reshape(n_used, ps, *ok.shape[2:])
+                    ovp = ov[0].reshape(n_used, ps, *ov.shape[2:])
+                    out.append(PagedLayerCache(
+                        cache.k_pages.at[pages].set(
+                            okp.astype(cache.k_pages.dtype)),
+                        cache.v_pages.at[pages].set(
+                            ovp.astype(cache.v_pages.dtype)),
+                    ))
+                return out
+            self._scatter_c = jax.jit(fn, donate_argnums=(0,))
+        return self._scatter_c
+
+    def _decode(self):
+        if self._decode_c is None:
+            paged = self.cfg.paged
+
+            def fn(params, toks, caches, state_or_lens, key):
+                # only `caches` (arg 2) is donated; the per-slot lengths /
+                # block tables must NOT alias it (f(donate(a), a) trap)
+                if paged:
+                    state = state_or_lens
+                    seq_lens = state.seq_lens
+                    kv = [(c, state) for c in caches]
+                else:
+                    seq_lens = state_or_lens
+                    kv = caches
+                pos = seq_lens[:, None]
+                logits, new_kv = functional_call(
+                    self.model, params, toks, position_ids=pos,
+                    kv_caches=kv, cache_index=seq_lens)
+                logits = logits[:, -1, :]
+                if self.cfg.greedy:
+                    nxt = jnp.argmax(logits, axis=-1)
+                else:
+                    nxt = jax.random.categorical(
+                        key, logits / self.cfg.temperature, axis=-1)
+                if paged:
+                    new_caches = [c for c, _ in new_kv]
+                    return nxt, new_caches
+                return nxt, new_kv
+            self._decode_c = jax.jit(fn, donate_argnums=(2,))
+        return self._decode_c
+
+    # ---------------- scheduling ----------------
+    def _admit(self):
+        while self._queue and self._free_slots():
+            req = self._queue[0]
+            slot = self._free_slots()[0]
+            n = req.prompt.size
+            # paged: allocate for the full prefill bucket too — the
+            # prefill scatter writes bucket//page_size whole pages, and
+            # a bucket coarser than prompt+max_new must not spill into
+            # the sink page or pages owned by other slots
+            need = max(n + req.max_new_tokens, self._bucket(n))
+            if self.cfg.paged and not self.pool.alloc(slot, need):
+                if not self.active.any():
+                    raise RuntimeError(
+                        f"request {req.rid} needs "
+                        f"{self.pool.pages_needed(need)} pages but the "
+                        f"pool has {self.pool.free_pages} free with no "
+                        "request running — size n_pages up")
+                break  # pool exhausted: wait for a finisher
+            self._queue.popleft()
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int64)
+            padded[0, :n] = req.prompt
+            one_caches = self.model.init_kv_caches(
+                1, bucket, dtype=self.cfg.cache_dtype)
+            logits, filled = self._prefill()(
+                self.params, jnp.asarray(padded, jnp.int32), one_caches)
+            if self.cfg.paged:
+                self.layer_caches = self._scatter_paged()(
+                    self.layer_caches, filled,
+                    jnp.asarray(self.pool.block_tables[slot]))
+            else:
+                self.caches = self._insert_contig()(
+                    self.caches, filled, slot)
+            first = int(jnp.argmax(logits[0, n - 1]))
+            req.ttft_ms = (time.perf_counter() - req._submit_t) * 1e3
+            req.output.append(first)
+            req.slot = slot
+            self.active[slot] = True
+            self.seq_lens[slot] = n
+            self.last_tok[slot] = first
+            self._slot_req[slot] = req
+            self._maybe_finish(slot, first)
+
+    def _maybe_finish(self, slot: int, tok: int):
+        req = self._slot_req.get(slot)
+        if req is None:
+            return
+        hit_eos = (req.eos_token_id is not None and tok == req.eos_token_id)
+        if hit_eos or len(req.output) >= req.max_new_tokens or \
+                self.seq_lens[slot] + 1 >= self.cfg.max_len:
+            req.done = True
+            self._finished[req.rid] = req
+            self.active[slot] = False
+            self.seq_lens[slot] = 0
+            del self._slot_req[slot]
+            if self.pool is not None:
+                self.pool.free(slot)
+
+    def step(self) -> bool:
+        """Admit waiting requests, run one decode step for all active
+        slots. Returns False when there is nothing left to do."""
+        self._admit()
+        if not self.active.any():
+            return bool(self._queue)
+        self._key, sub = jax.random.split(self._key)
+        toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
+        lens = jnp.asarray(self.seq_lens, jnp.int32)
+        if self.cfg.paged:
+            state = PagedState(
+                block_tables=jnp.asarray(self.pool.block_tables),
+                seq_lens=lens)
+            nxt, self.layer_caches = self._decode()(
+                self.params, toks, self.layer_caches, state, sub)
+        else:
+            nxt, self.caches = self._decode()(
+                self.params, toks, self.caches, lens, sub)
+        nxt = np.asarray(nxt)
+        for slot in range(self.cfg.max_slots):
+            if not self.active[slot]:
+                continue
+            tok = int(nxt[slot])
+            self._slot_req[slot].output.append(tok)
+            self.seq_lens[slot] += 1
+            self.last_tok[slot] = tok
+            self._maybe_finish(slot, tok)
+        return True
+
+    def run(self, prompts: Sequence, max_new_tokens: int = 32,
+            eos_token_id: Optional[int] = None) -> List[Request]:
+        """Submit all prompts, drive until completion, return Requests
+        in submission order (each carries .output and .ttft_ms)."""
+        rids = [self.add_request(p, max_new_tokens, eos_token_id)
+                for p in prompts]
+        while self.step() or self._queue or self.active.any():
+            pass
+        return [self._finished[r] for r in rids]

@@ -128,20 +128,50 @@ class LlamaAttention(Layer):
         v = shard_activation(v, ("dp", "fsdp"), "sep", "tp", None)
         q, k = apply_rope(q, k, cos, sin, position_ids)
         if kv_cache is not None:
-            # decode path: insert current kv at cache_index
-            ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, 1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, 1)
-            mask_len = ck.shape[1]
-            # causal within the block AND limited to filled cache slots:
-            # query at absolute position cache_index+qi sees kv_idx <= it
-            q_pos = cache_index + jnp.arange(s)  # [s]
-            kv_idx = jnp.arange(mask_len)  # [mask_len]
-            kv_mask = (kv_idx[None, :] <= q_pos[:, None])[None, None, :, :]
-            out = F.scaled_dot_product_attention(
-                q, ck, cv, attn_mask=kv_mask, training=False
-            )
-            new_cache = (ck, cv)
+            from ..inference.paged import (PagedLayerCache, append_kv,
+                                           paged_attention)
+
+            if isinstance(kv_cache[0], PagedLayerCache):
+                # paged decode (s == 1): write this token's kv into its
+                # slot's page, then attend over the gathered page view
+                cache, state = kv_cache
+                cache = append_kv(cache, state, k, v)
+                out = paged_attention(q, cache, state)
+                new_cache = (cache, state)
+            else:
+                ck, cv = kv_cache
+                k = k.astype(ck.dtype)
+                v = v.astype(cv.dtype)
+                per_slot = getattr(cache_index, "ndim", 0) == 1
+                if per_slot:
+                    # continuous batching: each slot writes at its own
+                    # length (s == 1) and masks to its own history
+                    if s != 1:
+                        raise ValueError(
+                            "per-slot cache_index decoding is single-"
+                            f"token (s=1); got s={s}")
+                    ck = ck.at[jnp.arange(b), cache_index].set(k[:, 0])
+                    cv = cv.at[jnp.arange(b), cache_index].set(v[:, 0])
+                    kv_idx = jnp.arange(ck.shape[1])
+                    kv_mask = (kv_idx[None, :] <=
+                               cache_index[:, None])[:, None, None, :]
+                else:
+                    # single shared index: insert current kv block
+                    ck = jax.lax.dynamic_update_slice_in_dim(
+                        ck, k, cache_index, 1)
+                    cv = jax.lax.dynamic_update_slice_in_dim(
+                        cv, v, cache_index, 1)
+                    # causal within the block AND limited to filled
+                    # slots: query at absolute position cache_index+qi
+                    # sees kv_idx <= it
+                    q_pos = cache_index + jnp.arange(s)  # [s]
+                    kv_idx = jnp.arange(ck.shape[1])
+                    kv_mask = (kv_idx[None, :] <=
+                               q_pos[:, None])[None, None, :, :]
+                out = F.scaled_dot_product_attention(
+                    q, ck, cv, attn_mask=kv_mask, training=False
+                )
+                new_cache = (ck, cv)
         else:
             from ..distributed.sharding import current_mesh
 

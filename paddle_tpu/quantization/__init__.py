@@ -129,6 +129,9 @@ class WeightOnlyLinear(Layer):
         self.group_size = group_size
         self.register_buffer("qweight", q)
         self.register_buffer("scale", s)
+        # calibrated activation scale (filled by PTQ.convert; buffer so
+        # it persists through state_dict)
+        self.register_buffer("act_scale", jnp.zeros((), jnp.float32))
         if bias is not None:
             self.bias = Parameter(bias, trainable=False)
         else:

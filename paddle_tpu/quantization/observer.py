@@ -64,25 +64,38 @@ class PercentileObserver(BaseObserver):
     """Clips to the p-th percentile of |x| samples (parity:
     HistObserver/KL-based observers' role: outlier-robust range)."""
 
-    def __init__(self, percentile: float = 99.9, max_samples: int = 1 << 20):
+    def __init__(self, percentile: float = 99.9, max_samples: int = 1 << 18):
         super().__init__()
         self.percentile = percentile
         self.max_samples = max_samples
-        self._samples = []
+        # fixed-size reservoir: memory stays O(max_samples) total no
+        # matter how many calibration batches flow through
+        self._reservoir = np.empty((0,), np.float32)
+        self._seen = 0
+        self._rng = np.random.default_rng(0)
 
     def observe(self, x):
         flat = np.abs(np.asarray(x, dtype=np.float32)).ravel()
-        if flat.size > self.max_samples:
-            idx = np.random.default_rng(0).choice(
-                flat.size, self.max_samples, replace=False)
-            flat = flat[idx]
-        self._samples.append(flat)
+        self._seen += flat.size
+        room = self.max_samples - self._reservoir.size
+        if room > 0:
+            take = flat[:room]
+            self._reservoir = np.concatenate([self._reservoir, take])
+            flat = flat[room:]
+        if flat.size:
+            # replace a proportional slice so late batches stay represented
+            n_rep = min(flat.size,
+                        max(1, int(self.max_samples * flat.size /
+                                   self._seen)))
+            idx = self._rng.choice(self.max_samples, n_rep, replace=False)
+            src = self._rng.choice(flat.size, n_rep, replace=False)
+            self._reservoir[idx] = flat[src]
 
     def scale(self, qmax: int = 127):
-        if not self._samples:
+        if not self._reservoir.size:
             return 1e-8
-        allv = np.concatenate(self._samples)
-        return max(float(np.percentile(allv, self.percentile)), 1e-8) / qmax
+        return max(float(np.percentile(self._reservoir, self.percentile)),
+                   1e-8) / qmax
 
 
 class MSEObserver(BaseObserver):

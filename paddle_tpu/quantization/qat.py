@@ -187,8 +187,13 @@ class PTQ:
             model = copy.deepcopy(model)
 
         def make(sub):
+            import jax.numpy as jnp
+
             wol = WeightOnlyLinear(sub.source, weight_dtype=weight_dtype)
-            wol.act_scale = sub.observer.scale()
+            # buffer (not a plain attr): survives state_dict save/load —
+            # losing the calibration result would defeat the PTQ pass
+            wol._buffers["act_scale"] = jnp.asarray(
+                sub.observer.scale(), jnp.float32)
             return wol
 
         return replace_layers(

@@ -192,3 +192,25 @@ def test_crop_fully_outside_returns_zeros():
     out = T.crop(img, -5, 0, 3, 10)
     assert out.shape == (3, 10, 3)
     assert (out == 0).all()
+
+
+def test_ptq_act_scale_survives_state_dict():
+    model = nn.Sequential(nn.Linear(16, 16))
+    ptq = Q.PTQ()
+    pmodel = ptq.quantize(model, inplace=False)
+    pmodel(jnp.asarray(_rand((4, 16), seed=11)))
+    infer = ptq.convert(pmodel, inplace=False)
+    wol = next(m for m in infer.sublayers(include_self=True)
+               if isinstance(m, Q.WeightOnlyLinear))
+    assert float(wol.act_scale) > 0
+    sd = infer.state_dict()
+    key = next(k for k in sd if k.endswith("act_scale"))
+    assert float(sd[key]) > 0
+
+
+def test_percentile_observer_bounded_memory():
+    obs = Q.PercentileObserver(99.0, max_samples=1000)
+    for i in range(50):
+        obs.observe(jnp.asarray(_rand((4096,), seed=i)))
+    assert obs._reservoir.size == 1000  # bounded despite 200k samples
+    assert obs.scale() > 0

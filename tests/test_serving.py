@@ -1,0 +1,203 @@
+"""Paged KV cache + continuous batching engine tests (reference decode
+path: phi masked_multihead_attention / fused_multi_transformer caches)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (
+    Config,
+    ContinuousBatchingEngine,
+    EngineConfig,
+    Predictor,
+)
+from paddle_tpu.inference.paged import (
+    PagedLayerCache,
+    PagedState,
+    PagePool,
+    append_kv,
+    init_paged_pool,
+    paged_attention,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(seed=0):
+    import paddle_tpu as pt
+
+    pt.seed(seed)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+# ---------------- paged primitives ----------------
+
+def test_page_pool_alloc_free():
+    pool = PagePool(n_pages=8, page_size=4, slots=2, max_pages_per_slot=4)
+    assert pool.free_pages == 8
+    assert pool.alloc(0, 10)  # 3 pages
+    assert pool.free_pages == 5
+    assert len(pool.pages_of[0]) == 3
+    assert pool.alloc(0, 12)  # grow to exactly 3 pages → no-op
+    assert pool.alloc(0, 13)  # grow to 4
+    assert pool.free_pages == 4
+    assert not pool.alloc(1, 100)  # exceeds per-slot max
+    assert pool.alloc(1, 16)
+    assert pool.free_pages == 0
+    pool.free(0)
+    assert pool.free_pages == 4
+    assert (pool.block_tables[0] == 0).all()
+
+
+def test_paged_append_gather_attention_matches_dense():
+    slots, ps, n_pages, kvh, d, h = 2, 4, 9, 2, 8, 4
+    pool = PagePool(n_pages, ps, slots, max_pages_per_slot=4)
+    pool._free = [p for p in pool._free if p != 0]
+    cache = init_paged_pool(1, n_pages, ps, kvh, d, dtype=jnp.float32)[0]
+    rng = np.random.default_rng(0)
+    lens = [6, 3]  # tokens already cached per slot
+    dense_k = np.zeros((slots, 16, kvh, d), np.float32)
+    dense_v = np.zeros((slots, 16, kvh, d), np.float32)
+    for s in range(slots):
+        pool.alloc(s, lens[s] + 1)
+        for t in range(lens[s]):
+            k = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+            v = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+            state = pool.device_state(
+                np.array([t if i == s else 0 for i in range(slots)]))
+            # append only writes slot s meaningfully; other slot writes
+            # land at its own (stale) position — emulate per-slot append
+            cache = append_kv(cache, state, jnp.asarray(k), jnp.asarray(v))
+            dense_k[s, t] = k[s, 0]
+            dense_v[s, t] = v[s, 0]
+            # restore the other slot's stale-position value
+            o = 1 - s
+            dense_k[o, 0] = k[o, 0]
+            dense_v[o, 0] = v[o, 0]
+
+    # now append the "current token" for both slots at their real lens
+    k = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+    v = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+    state = pool.device_state(np.array(lens))
+    cache = append_kv(cache, state, jnp.asarray(k), jnp.asarray(v))
+    for s in range(slots):
+        dense_k[s, lens[s]] = k[s, 0]
+        dense_v[s, lens[s]] = v[s, 0]
+
+    q = rng.standard_normal((slots, 1, h, d)).astype(np.float32)
+    out = np.asarray(paged_attention(jnp.asarray(q), cache, state))
+    # dense reference with GQA repeat + causal-length mask
+    for s in range(slots):
+        L = lens[s] + 1
+        kk = np.repeat(dense_k[s, :L], h // kvh, axis=1)
+        vv = np.repeat(dense_v[s, :L], h // kvh, axis=1)
+        att = np.einsum("qhd,khd->hqk", q[s] / np.sqrt(d), kk)
+        p = np.exp(att - att.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hqk,khd->qhd", p, vv)
+        np.testing.assert_allclose(out[s], ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------- engine end-to-end ----------------
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_matches_sequential_predictor(paged):
+    model, cfg = _model()
+    prompt = np.array([3, 7, 11, 2, 9])
+    pred = Predictor(model, Config())
+    ref = pred.generate(prompt, max_new_tokens=8)[0]
+
+    ecfg = EngineConfig(max_slots=2, max_len=64, seq_buckets=(8, 16),
+                        paged=paged, page_size=8)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    reqs = eng.run([prompt], max_new_tokens=8)
+    assert reqs[0].done
+    assert reqs[0].ttft_ms is not None and reqs[0].ttft_ms > 0
+    np.testing.assert_array_equal(np.array(reqs[0].output), ref)
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_continuous_batching_many_requests(paged):
+    model, cfg = _model()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n)
+               for n in (4, 7, 3, 9, 5)]
+    # sequential reference
+    pred = Predictor(model, Config())
+    refs = [pred.generate(p, max_new_tokens=6)[0] for p in prompts]
+
+    # 5 requests through 2 slots → forced admission waves
+    ecfg = EngineConfig(max_slots=2, max_len=32, seq_buckets=(16,),
+                        paged=paged, page_size=8)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    reqs = eng.run(prompts, max_new_tokens=6)
+    for req, ref in zip(reqs, refs):
+        assert req.done
+        np.testing.assert_array_equal(np.array(req.output), ref)
+
+
+def test_engine_eos_frees_slot_early():
+    model, cfg = _model()
+    prompt = np.array([1, 2, 3])
+    pred = Predictor(model, Config())
+    ref = pred.generate(prompt, max_new_tokens=1)[0]
+    eos = int(ref[0])  # first generated token == eos → stops immediately
+    eng = ContinuousBatchingEngine(
+        model, EngineConfig(max_slots=1, max_len=32, seq_buckets=(8,)))
+    reqs = eng.run([prompt], max_new_tokens=10, eos_token_id=eos)
+    assert reqs[0].done and len(reqs[0].output) == 1
+    assert not eng.active.any()
+
+
+def test_paged_pool_oversubscription():
+    # pool smaller than slots*max_len still serves requests in waves
+    model, cfg = _model()
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, cfg.vocab_size, size=5) for _ in range(3)]
+    ecfg = EngineConfig(max_slots=3, max_len=32, seq_buckets=(8,),
+                        paged=True, page_size=8,
+                        n_pages=1 + 2 * (32 // 8))  # sink + 2 slots' worth
+    eng = ContinuousBatchingEngine(model, ecfg)
+    reqs = eng.run(prompts, max_new_tokens=4)
+    assert all(r.done for r in reqs)
+    pred = Predictor(model, Config())
+    for req, p in zip(reqs, prompts):
+        ref = pred.generate(p, max_new_tokens=4)[0]
+        np.testing.assert_array_equal(np.array(req.output), ref)
+
+
+def test_engine_bucket_never_exceeds_max_len():
+    model, cfg = _model()
+    # default-ish buckets larger than max_len must clamp, not crash
+    ecfg = EngineConfig(max_slots=1, max_len=16, seq_buckets=(64, 128))
+    eng = ContinuousBatchingEngine(model, ecfg)
+    reqs = eng.run([np.array([1, 2, 3])], max_new_tokens=4)
+    assert reqs[0].done and len(reqs[0].output) == 4
+
+
+def test_engine_default_pool_admits_max_len_request():
+    model, cfg = _model()
+    ecfg = EngineConfig(max_slots=1, max_len=32, seq_buckets=(16,),
+                        paged=True, page_size=8)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    # prompt + max_new == max_len: needs every page of the slot
+    reqs = eng.run([np.arange(1, 17)], max_new_tokens=16)
+    assert reqs[0].done
+
+
+def test_engine_paged_pool_too_small_raises():
+    model, cfg = _model()
+    ecfg = EngineConfig(max_slots=1, max_len=32, seq_buckets=(16,),
+                        paged=True, page_size=8, n_pages=2)  # sink + 1
+    eng = ContinuousBatchingEngine(model, ecfg)
+    with pytest.raises(RuntimeError, match="size n_pages up"):
+        eng.run([np.arange(1, 17)], max_new_tokens=16)
+
+
+def test_engine_cache_dtype_is_ctor_arg():
+    model, cfg = _model()
+    ecfg = EngineConfig(max_slots=1, max_len=16, seq_buckets=(8,),
+                        cache_dtype=jnp.bfloat16)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    assert eng.caches[0][0].dtype == jnp.bfloat16
