@@ -125,12 +125,15 @@ class PagePool:
     """
 
     def __init__(self, n_pages: int, page_size: int, slots: int,
-                 max_pages_per_slot: int):
+                 max_pages_per_slot: int, reserve_sink: bool = False):
+        """``reserve_sink``: keep page 0 out of circulation as a write
+        sink for inactive slots (their block tables point at it)."""
         self.n_pages = n_pages
         self.page_size = page_size
         self.slots = slots
         self.max_pages_per_slot = max_pages_per_slot
-        self._free = list(range(n_pages - 1, -1, -1))
+        first = 1 if reserve_sink else 0
+        self._free = list(range(n_pages - 1, first - 1, -1))
         self.block_tables = np.zeros((slots, max_pages_per_slot), np.int32)
         self.pages_of: dict = {i: [] for i in range(slots)}
 

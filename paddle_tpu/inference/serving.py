@@ -87,14 +87,17 @@ class ContinuousBatchingEngine:
         if cfg.paged:
             if cfg.max_len % cfg.page_size:
                 raise ValueError("max_len must be divisible by page_size")
+            for bkt in cfg.seq_buckets:
+                if min(bkt, cfg.max_len) % cfg.page_size:
+                    raise ValueError(
+                        f"seq bucket {bkt} not divisible by page_size="
+                        f"{cfg.page_size} — prefill scatters whole pages")
             max_pages_per_slot = cfg.max_len // cfg.page_size
             # +1: page 0 is the inactive-slot write sink, never allocated
             n_pages = cfg.n_pages or \
                 cfg.max_slots * max_pages_per_slot + 1
-            # page 0 is a write sink for inactive slots — never allocated
             self.pool = PagePool(n_pages, cfg.page_size, cfg.max_slots,
-                                 max_pages_per_slot)
-            self.pool._free = [p for p in self.pool._free if p != 0]
+                                 max_pages_per_slot, reserve_sink=True)
             self.layer_caches = init_paged_pool(
                 self._n_layers, n_pages, cfg.page_size, kvh, hd,
                 dtype=cfg.cache_dtype)
@@ -252,7 +255,12 @@ class ContinuousBatchingEngine:
             else:
                 self.caches = self._insert_contig()(
                     self.caches, filled, slot)
-            first = int(jnp.argmax(logits[0, n - 1]))
+            if self.cfg.greedy:
+                first = int(jnp.argmax(logits[0, n - 1]))
+            else:
+                self._key, sub = jax.random.split(self._key)
+                first = int(jax.random.categorical(
+                    sub, logits[0, n - 1] / self.cfg.temperature))
             req.ttft_ms = (time.perf_counter() - req._submit_t) * 1e3
             req.output.append(first)
             req.slot = slot

@@ -1,0 +1,134 @@
+"""Pallas chunked selective scan (S6 linear recurrence) for Mamba.
+
+Parity: the reference's selective-scan CUDA kernel (the "Mamba-2 / RWKV
+selective-scan + linear-recurrence Phi op" BASELINE.json config).
+
+Why a kernel when ``jax.lax.associative_scan`` already runs on TPU: the
+associative formulation materializes the discretized operands
+``dA, dBu`` — two ``[b, s, d, n]`` f32 tensors, a ``2n``-fold blowup of
+the activations — and streams them through HBM O(log s) times. This
+kernel never forms them: the sequence is processed in chunks with the
+``[n, d]`` recurrent state resident in VMEM scratch across the
+(sequential) chunk grid dimension, so HBM traffic is just the
+``[b, s, d]``/``[b, s, n]`` inputs once and the output once — the same
+streaming structure the reference's CUDA scan uses, mapped onto the
+Pallas grid. Layout: state is kept ``[n, d]`` with d on lanes (n is
+small, e.g. 16), so every VPU op runs full-width.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _scan_kernel(u_ref, delta_ref, b_ref, c_ref, at_ref, y_ref, h_scratch,
+                 *, chunk):
+    ic = pl.program_id(2)
+
+    @pl.when(ic == 0)
+    def _reset():
+        h_scratch[:] = jnp.zeros_like(h_scratch)
+
+    at = at_ref[...]  # [n, d_block]
+
+    def body(t, h):
+        # all [n, d] with d on lanes
+        dt = delta_ref[0, t][None, :]          # [1, d]
+        da = jnp.exp(dt * at)                  # [n, d]
+        dbu = (dt * u_ref[0, t][None, :]) * b_ref[0, t][:, None]
+        h = da * h + dbu
+        y = jnp.sum(h * c_ref[0, t][:, None], axis=0)  # [d]
+        y_ref[0, t] = y.astype(y_ref.dtype)
+        return h
+
+    h_scratch[:] = jax.lax.fori_loop(0, chunk, body, h_scratch[...])
+
+
+def associative_selective_scan(u, delta, A, B, C, D):
+    """Reference S6 scan via ``jax.lax.associative_scan``.
+
+    u: [b,s,d]; delta: [b,s,d] (softplus-activated); A: [d,n] (negative);
+    B, C: [b,s,n]; D: [d]. The combine (a,b)∘(a',b') = (a·a', a'·b+b')
+    is associative, so XLA lowers a log-depth scan — but it materializes
+    the [b,s,d,n] discretized operands in HBM, which is what the Pallas
+    kernel below avoids. Also serves as the backward path for the
+    kernel (the VJP of a linear recurrence is itself a scan XLA handles
+    well).
+    """
+    dA = jnp.exp(delta[..., None] * A[None, None])
+    dBu = (delta * u)[..., None] * B[:, :, None, :]
+
+    def combine(x, y):
+        a1, b1 = x
+        a2, b2 = y
+        return a2 * a1, a2 * b1 + b2
+
+    _, h_all = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+    y = jnp.einsum("bsdn,bsn->bsd", h_all, C)
+    return y + u * D[None, None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _chunked_scan(u, delta, A, B, C, D, chunk, d_block):
+    b, s, d = u.shape
+    n = A.shape[1]
+    grid = (b, d // d_block, s // chunk)
+    f32 = jnp.float32
+    y = pl.pallas_call(
+        functools.partial(_scan_kernel, chunk=chunk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, chunk, d_block), lambda ib, id_, ic: (ib, ic, id_)),
+            pl.BlockSpec((1, chunk, d_block), lambda ib, id_, ic: (ib, ic, id_)),
+            pl.BlockSpec((1, chunk, n), lambda ib, id_, ic: (ib, ic, 0)),
+            pl.BlockSpec((1, chunk, n), lambda ib, id_, ic: (ib, ic, 0)),
+            pl.BlockSpec((n, d_block), lambda ib, id_, ic: (0, id_)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, chunk, d_block), lambda ib, id_, ic: (ib, ic, id_)),
+        out_shape=jax.ShapeDtypeStruct((b, s, d), f32),
+        scratch_shapes=[pltpu.VMEM((n, d_block), f32)],
+        interpret=_interpret(),
+    )(u.astype(f32), delta.astype(f32), B.astype(f32), C.astype(f32),
+      A.T.astype(f32))
+    return y + u.astype(f32) * D[None, None].astype(f32)
+
+
+def _chunked_fwd(u, delta, A, B, C, D, chunk, d_block):
+    return _chunked_scan(u, delta, A, B, C, D, chunk, d_block), \
+        (u, delta, A, B, C, D)
+
+
+def _chunked_bwd(chunk, d_block, res, g):
+    # backward through the mathematically-identical associative form —
+    # the recurrence VJP is itself a scan, which XLA lowers well; the
+    # HBM saving matters most for inference/long-context forward passes
+    _, vjp = jax.vjp(associative_selective_scan, *res)
+    return vjp(g)
+
+
+_chunked_scan.defvjp(_chunked_fwd, _chunked_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "d_block"))
+def chunked_selective_scan(u, delta, A, B, C, D, *, chunk=128,
+                           d_block=None):
+    """y[b,s,d] for h_t = exp(Δ_t A)·h_{t-1} + Δ_t u_t B_t, y_t = C_t·h_t
+    (+ u·D skip). Shapes as ``associative_selective_scan``."""
+    b, s, d = u.shape
+    if d_block is None:
+        d_block = d if d <= 512 else 256
+    if s % chunk:
+        raise ValueError(f"seq len {s} not divisible by chunk {chunk}")
+    if d % d_block:
+        raise ValueError(f"d {d} not divisible by d_block {d_block}")
+    return _chunked_scan(u, delta, A, B, C, D, chunk, d_block)

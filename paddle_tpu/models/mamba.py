@@ -38,6 +38,11 @@ class MambaConfig:
     dt_rank: int = 48  # ceil(hidden/16)
     conv_kernel: int = 4
     rms_norm_eps: float = 1e-5
+    # Pallas chunked scan (kernels/selective_scan.py): avoids the
+    # [b,s,d,n] HBM blow-up of the associative scan; requires seq len
+    # divisible by scan_chunk
+    use_chunked_scan: bool = False
+    scan_chunk: int = 128
 
     @property
     def d_inner(self):
@@ -53,28 +58,11 @@ class MambaConfig:
         return cls(**kw)
 
 
-def selective_scan(u, delta, A, B, C, D):
-    """S6 selective scan via associative scan.
-
-    u:     [b, s, d]   input
-    delta: [b, s, d]   softplus-activated step sizes
-    A:     [d, n]      state matrix (negative, learned as log)
-    B, C:  [b, s, n]   input/output projections
-    D:     [d]         skip
-    returns y: [b, s, d]
-    """
-    # discretize: a = exp(delta ⊗ A)  [b,s,d,n]; bu = delta*u ⊗ B
-    dA = jnp.exp(delta[..., None] * A[None, None])
-    dBu = (delta * u)[..., None] * B[:, :, None, :]
-
-    def combine(x, y):
-        a1, b1 = x
-        a2, b2 = y
-        return a2 * a1, a2 * b1 + b2
-
-    a_all, h_all = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
-    y = jnp.einsum("bsdn,bsn->bsd", h_all, C)
-    return y + u * D[None, None]
+# canonical implementation lives beside the Pallas kernel; re-exported
+# here under its historical name
+from ..kernels.selective_scan import (  # noqa: E402
+    associative_selective_scan as selective_scan,
+)
 
 
 class MambaMixer(Layer):
@@ -127,11 +115,19 @@ class MambaMixer(Layer):
         )
         delta = jax.nn.softplus(self.dt_proj(dt))
         A = -jnp.exp(self.A_log.value.astype(jnp.float32))
-        y = selective_scan(
-            xs.astype(jnp.float32), delta.astype(jnp.float32), A,
-            B.astype(jnp.float32), C.astype(jnp.float32),
-            self.D.value.astype(jnp.float32),
-        ).astype(x.dtype)
+        if cfg.use_chunked_scan and s % cfg.scan_chunk == 0:
+            from ..kernels.selective_scan import chunked_selective_scan
+
+            y = chunked_selective_scan(
+                xs, delta, A, B, C, self.D.value,
+                chunk=cfg.scan_chunk,
+            ).astype(x.dtype)
+        else:
+            y = selective_scan(
+                xs.astype(jnp.float32), delta.astype(jnp.float32), A,
+                B.astype(jnp.float32), C.astype(jnp.float32),
+                self.D.value.astype(jnp.float32),
+            ).astype(x.dtype)
         return self.out_proj(y * F.silu(z))
 
 

@@ -83,13 +83,16 @@ class PercentileObserver(BaseObserver):
             self._reservoir = np.concatenate([self._reservoir, take])
             flat = flat[room:]
         if flat.size:
-            # replace a proportional slice so late batches stay represented
+            # reservoir admission: each new value replaces w.p.
+            # max_samples/seen — no minimum, or the reservoir would
+            # converge to just the most recent batches
             n_rep = min(flat.size,
-                        max(1, int(self.max_samples * flat.size /
-                                   self._seen)))
-            idx = self._rng.choice(self.max_samples, n_rep, replace=False)
-            src = self._rng.choice(flat.size, n_rep, replace=False)
-            self._reservoir[idx] = flat[src]
+                        int(self.max_samples * flat.size / self._seen))
+            if n_rep:
+                idx = self._rng.choice(self.max_samples, n_rep,
+                                       replace=False)
+                src = self._rng.choice(flat.size, n_rep, replace=False)
+                self._reservoir[idx] = flat[src]
 
     def scale(self, qmax: int = 127):
         if not self._reservoir.size:

@@ -187,13 +187,10 @@ class PTQ:
             model = copy.deepcopy(model)
 
         def make(sub):
-            import jax.numpy as jnp
-
             wol = WeightOnlyLinear(sub.source, weight_dtype=weight_dtype)
-            # buffer (not a plain attr): survives state_dict save/load —
-            # losing the calibration result would defeat the PTQ pass
-            wol._buffers["act_scale"] = jnp.asarray(
-                sub.observer.scale(), jnp.float32)
+            # act_scale is a registered buffer, so this assignment routes
+            # into _buffers and persists through state_dict
+            wol.act_scale = sub.observer.scale()
             return wol
 
         return replace_layers(

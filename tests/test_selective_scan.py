@@ -1,0 +1,87 @@
+"""Chunked Pallas selective scan vs associative-scan reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.selective_scan import chunked_selective_scan
+from paddle_tpu.models.mamba import (
+    MambaConfig,
+    MambaForCausalLM,
+    selective_scan,
+)
+
+
+def _inputs(b=2, s=64, d=32, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    u = rng.standard_normal((b, s, d)).astype(np.float32)
+    delta = np.abs(rng.standard_normal((b, s, d))).astype(np.float32) * 0.1
+    A = -np.abs(rng.standard_normal((d, n))).astype(np.float32)
+    B = rng.standard_normal((b, s, n)).astype(np.float32)
+    C = rng.standard_normal((b, s, n)).astype(np.float32)
+    D = rng.standard_normal((d,)).astype(np.float32)
+    return map(jnp.asarray, (u, delta, A, B, C, D))
+
+
+@pytest.mark.parametrize("chunk", [16, 64])
+def test_chunked_matches_associative(chunk):
+    u, delta, A, B, C, D = _inputs()
+    ref = np.asarray(selective_scan(u, delta, A, B, C, D))
+    out = np.asarray(chunked_selective_scan(u, delta, A, B, C, D,
+                                            chunk=chunk))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_d_blocking():
+    u, delta, A, B, C, D = _inputs(d=64)
+    ref = np.asarray(selective_scan(u, delta, A, B, C, D))
+    out = np.asarray(chunked_selective_scan(u, delta, A, B, C, D,
+                                            chunk=32, d_block=32))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_state_carries_across_chunks():
+    # long-memory input: impulse at t=0, tiny delta afterwards → later
+    # outputs depend on state carried through many chunk boundaries
+    b, s, d, n = 1, 64, 8, 4
+    u = np.zeros((b, s, d), np.float32)
+    u[:, 0] = 1.0
+    delta = np.full((b, s, d), 0.01, np.float32)
+    A = -np.full((d, n), 0.1, np.float32)
+    B = np.ones((b, s, n), np.float32)
+    C = np.ones((b, s, n), np.float32)
+    D = np.zeros((d,), np.float32)
+    args = map(jnp.asarray, (u, delta, A, B, C, D))
+    out = np.asarray(chunked_selective_scan(*args, chunk=8))
+    ref = np.asarray(selective_scan(*map(jnp.asarray,
+                                         (u, delta, A, B, C, D))))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+    assert abs(out[0, -1].sum()) > 1e-4  # state survived to the end
+
+
+def test_mamba_model_chunked_flag():
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    cfg = MambaConfig.tiny(use_chunked_scan=True, scan_chunk=8)
+    model = MambaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    logits = model(jnp.asarray(ids))
+    cfg2 = MambaConfig.tiny()
+    pt.seed(0)
+    model2 = MambaForCausalLM(cfg2)
+    ref = model2(jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_chunked_grad_flows():
+    u, delta, A, B, C, D = _inputs(b=1, s=16, d=8, n=4)
+
+    def loss(u, delta, A, B, C, D):
+        return jnp.sum(chunked_selective_scan(u, delta, A, B, C, D,
+                                              chunk=8) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 2))(u, delta, A, B, C, D)
+    assert all(float(jnp.linalg.norm(x)) > 0 for x in g)

@@ -52,8 +52,8 @@ def test_page_pool_alloc_free():
 
 def test_paged_append_gather_attention_matches_dense():
     slots, ps, n_pages, kvh, d, h = 2, 4, 9, 2, 8, 4
-    pool = PagePool(n_pages, ps, slots, max_pages_per_slot=4)
-    pool._free = [p for p in pool._free if p != 0]
+    pool = PagePool(n_pages, ps, slots, max_pages_per_slot=4,
+                    reserve_sink=True)
     cache = init_paged_pool(1, n_pages, ps, kvh, d, dtype=jnp.float32)[0]
     rng = np.random.default_rng(0)
     lens = [6, 3]  # tokens already cached per slot
@@ -201,3 +201,26 @@ def test_engine_cache_dtype_is_ctor_arg():
                         cache_dtype=jnp.bfloat16)
     eng = ContinuousBatchingEngine(model, ecfg)
     assert eng.caches[0][0].dtype == jnp.bfloat16
+
+
+def test_engine_sampled_first_token_not_always_argmax():
+    model, cfg = _model()
+    prompt = np.array([1, 2, 3])
+    ecfg = EngineConfig(max_slots=1, max_len=32, seq_buckets=(8,),
+                        greedy=False, temperature=5.0, seed=0)
+    firsts = set()
+    for seed in range(6):
+        ecfg2 = EngineConfig(max_slots=1, max_len=32, seq_buckets=(8,),
+                             greedy=False, temperature=5.0, seed=seed)
+        eng = ContinuousBatchingEngine(model, ecfg2)
+        reqs = eng.run([prompt], max_new_tokens=1)
+        firsts.add(reqs[0].output[0])
+    assert len(firsts) > 1  # high temperature → varies across seeds
+
+
+def test_engine_paged_bucket_page_divisibility_checked():
+    model, cfg = _model()
+    with pytest.raises(ValueError, match="not divisible by page_size"):
+        ContinuousBatchingEngine(model, EngineConfig(
+            max_slots=1, max_len=32, seq_buckets=(12,),
+            paged=True, page_size=8))
