@@ -63,12 +63,42 @@ class CollectiveController:
         self.args = args
         self.extra = extra
         self.containers: List[Container] = []
+        self.manager = None
+        if args.np:
+            # elastic membership via the shared-store ElasticManager
+            # (reference: fleet/elastic with etcd; SURVEY.md §5)
+            from ..elastic import ElasticManager, FileStore, parse_np_range
 
-    def build(self):
+            store = FileStore(args.elastic_store, args.job_id)
+            self.manager = ElasticManager(
+                store, parse_np_range(args.np),
+                fault_timeout=args.elastic_timeout)
+            self.manager.register()
+
+    def _world(self, grace: bool = False):
+        if self.manager is None:
+            return self.args.nnodes, self.args.node_rank
+        if grace:
+            # restart path: let a dead peer's heartbeat go stale before
+            # re-ranking, or the rebuilt world still contains it and the
+            # respawn burns max_restarts against a doomed membership
+            time.sleep(self.manager.fault_timeout)
+        self.manager.evict_faulted()
+        spec = self.manager.wait_for_world(
+            timeout=self.args.elastic_timeout * 6,
+            settle=self.args.elastic_settle)
+        if spec is None:
+            raise RuntimeError(
+                "elastic: no viable membership within timeout "
+                f"(need np in [{self.manager.min_np}, "
+                f"{self.manager.max_np}])")
+        return spec.nnodes, spec.node_rank
+
+    def build(self, grace: bool = False):
         nproc = self.args.nproc_per_node
         master = self.args.master or "127.0.0.1:49175"
-        node_rank = self.args.node_rank
-        nnodes = self.args.nnodes
+        nnodes, node_rank = self._world(grace=grace)
+        self.containers = []
         for local_rank in range(nproc):
             rank = node_rank * nproc + local_rank
             env = dict(os.environ)
@@ -115,6 +145,15 @@ class CollectiveController:
                         )
                         for c in self.containers:
                             c.terminate()
+                        # re-rank over the surviving membership before
+                        # respawning (no-op without --np)
+                        try:
+                            self.build(grace=self.manager is not None)
+                        except RuntimeError as e:
+                            print(f"elastic: {e}; tearing down")
+                            for c in self.containers:
+                                c.terminate()
+                            return 1
                         for c in self.containers:
                             c.start()
                     else:
@@ -133,6 +172,8 @@ class CollectiveController:
     def stop(self):
         for c in self.containers:
             c.terminate()
+        if self.manager is not None:
+            self.manager.deregister()
 
 
 def parse_args(argv=None):
@@ -151,6 +192,20 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--elastic", action="store_true",
                    help="restart failed workers (checkpoint-resume)")
+    p.add_argument("--np", type=str, default=None,
+                   help="elastic node range 'min:max' (implies membership "
+                        "tracking via --elastic_store)")
+    p.add_argument("--job_id", type=str,
+                   default=os.environ.get("PADDLE_JOB_ID", "default"))
+    p.add_argument("--elastic_store", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_STORE", "/tmp"),
+                   help="shared directory for membership (must be a "
+                        "filesystem ALL nodes see — NFS/GCS-fuse; the "
+                        "/tmp default only works single-node)")
+    p.add_argument("--elastic_timeout", type=float, default=5.0)
+    p.add_argument("--elastic_settle", type=float, default=1.0,
+                   help="membership must be stable this long before a "
+                        "world forms (startup race debounce)")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--poll_interval", type=float, default=1.0)
     p.add_argument("training_script", type=str)
@@ -160,6 +215,21 @@ def parse_args(argv=None):
 
 def launch(argv=None) -> int:
     args = parse_args(argv)
+    if args.np and args.elastic_store == "/tmp" and \
+            parse_np_max(args.np) > 1:
+        print("warning: --elastic_store=/tmp is node-local; multi-node "
+              "membership needs a shared filesystem path", file=sys.stderr)
     extra = [args.training_script] + list(args.script_args)
     controller = CollectiveController(args, extra).build()
-    return controller.run()
+    try:
+        return controller.run()
+    finally:
+        # always deregister + reap: a leftover heartbeat would be counted
+        # as a live ghost node by the next launch within fault_timeout
+        controller.stop()
+
+
+def parse_np_max(np_arg: str) -> int:
+    from ..elastic import parse_np_range
+
+    return parse_np_range(np_arg)[1]

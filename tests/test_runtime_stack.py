@@ -68,7 +68,7 @@ def test_metrics():
 
 def test_hapi_model_fit_evaluate_predict(tmp_path):
     pt.seed(0)
-    x = np.random.randn(64, 4).astype(np.float32)
+    x = np.random.default_rng(0).standard_normal((64, 4)).astype(np.float32)
     w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
     y = (x @ w).astype(np.float32)
     ds = io.TensorDataset(x, y)
