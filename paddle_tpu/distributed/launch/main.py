@@ -220,12 +220,14 @@ def launch(argv=None) -> int:
         print("warning: --elastic_store=/tmp is node-local; multi-node "
               "membership needs a shared filesystem path", file=sys.stderr)
     extra = [args.training_script] + list(args.script_args)
-    controller = CollectiveController(args, extra).build()
+    controller = CollectiveController(args, extra)
     try:
+        # build inside the try: a membership-wait timeout in the first
+        # build must still deregister the heartbeat, or the ghost node
+        # corrupts the next launch's world
+        controller.build()
         return controller.run()
     finally:
-        # always deregister + reap: a leftover heartbeat would be counted
-        # as a live ghost node by the next launch within fault_timeout
         controller.stop()
 
 

@@ -122,13 +122,247 @@ class Bernoulli(Distribution):
         return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
 
 
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("exponential")
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.exponential(key, shape) / self.rate
+
+    def log_prob(self, value):
+        return jnp.where(value >= 0,
+                         jnp.log(self.rate) - self.rate * value, -jnp.inf)
+
+    def entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("laplace")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.laplace(key, shape)
+
+    def log_prob(self, value):
+        return -jnp.abs(value - self.loc) / self.scale - \
+            jnp.log(2 * self.scale)
+
+    def entropy(self):
+        return 1.0 + jnp.log(2 * self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("gumbel")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.gumbel(key, shape)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.log(self.scale) + 1.0 + float(jnp.euler_gamma)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("gamma")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        return jax.random.gamma(key, self.concentration, shape) / self.rate
+
+    def log_prob(self, value):
+        a, b = self.concentration, self.rate
+        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+                - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return (a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                + (1 - a) * jax.scipy.special.digamma(a))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("beta")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.alpha.shape, self.beta.shape)
+        return jax.random.beta(key, self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(value) + (b - 1) * jnp.log1p(-value)
+                - _betaln(a, b))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return (_betaln(a, b) - (a - 1) * dg(a) - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("dirichlet")
+        return jax.random.dirichlet(key, self.concentration, tuple(shape))
+
+    def log_prob(self, value):
+        a = self.concentration
+        return (jnp.sum((a - 1) * jnp.log(value), axis=-1)
+                + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                - jnp.sum(jax.scipy.special.gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        return (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                - jax.scipy.special.gammaln(a0)
+                + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+        self.loc, self.scale = self.base.loc, self.base.scale
+
+    def sample(self, shape=()):
+        return jnp.exp(self.base.sample(shape))
+
+    def log_prob(self, value):
+        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self.base.entropy() + self.loc
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale**2 / 2)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("multinomial")
+        logits = jnp.log(self.probs_ + 1e-30)
+        draws = jax.random.categorical(
+            key, logits,
+            shape=tuple(shape) + (self.total_count,)
+            + self.probs_.shape[:-1])
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return jnp.sum(onehot, axis=len(shape))
+
+    def log_prob(self, value):
+        gl = jax.scipy.special.gammaln
+        return (gl(jnp.asarray(self.total_count + 1.0))
+                - jnp.sum(gl(value + 1.0), -1)
+                + jnp.sum(value * jnp.log(self.probs_ + 1e-30), -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("poisson")
+        return jax.random.poisson(
+            key, self.rate, tuple(shape) + self.rate.shape
+        ).astype(jnp.float32)
+
+    def log_prob(self, value):
+        return (value * jnp.log(self.rate) - self.rate
+                - jax.scipy.special.gammaln(value + 1.0))
+
+    @property
+    def mean(self):
+        return self.rate
+
+
+def _betaln(a, b):
+    gl = jax.scipy.special.gammaln
+    return gl(a) + gl(b) - gl(a + b)
+
+
 def kl_divergence(p: Distribution, q: Distribution):
+    dg = jax.scipy.special.digamma
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
         lp = jax.nn.log_softmax(p.logits, -1)
         lq = jax.nn.log_softmax(q.logits, -1)
         return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+        return pp * (jnp.log(pp) - jnp.log(qq)) + \
+            (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        out = jnp.log((q.high - q.low) / (p.high - p.low))
+        ok = (q.low <= p.low) & (p.high <= q.high)
+        return jnp.where(ok, out, jnp.inf)
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return jnp.log(r) + 1.0 / r - 1.0
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        pa, pb, qa, qb = p.concentration, p.rate, q.concentration, q.rate
+        gl = jax.scipy.special.gammaln
+        return ((pa - qa) * dg(pa) - gl(pa) + gl(qa)
+                + qa * (jnp.log(pb) - jnp.log(qb))
+                + pa * (qb - pb) / pb)
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        gl_t = _betaln(q.alpha, q.beta) - _betaln(p.alpha, p.beta)
+        return (gl_t + (p.alpha - q.alpha) * dg(p.alpha)
+                + (p.beta - q.beta) * dg(p.beta)
+                + (q.alpha - p.alpha + q.beta - p.beta)
+                * dg(p.alpha + p.beta))
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        pa, qa = p.concentration, q.concentration
+        gl = jax.scipy.special.gammaln
+        pa0 = jnp.sum(pa, -1)
+        return (gl(pa0) - jnp.sum(gl(pa), -1)
+                - gl(jnp.sum(qa, -1)) + jnp.sum(gl(qa), -1)
+                + jnp.sum((pa - qa) * (dg(pa) - dg(pa0)[..., None]), -1))
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})"
     )

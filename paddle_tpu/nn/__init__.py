@@ -4,49 +4,82 @@ from ..core.module import Layer
 from ..core.parameter import Parameter
 from . import functional
 from .layer.activation import (
+    CELU,
     ELU,
     GELU,
     GLU,
+    Hardshrink,
     Hardsigmoid,
     Hardswish,
     LeakyReLU,
+    LogSigmoid,
     LogSoftmax,
     Mish,
+    PReLU,
     ReLU,
     ReLU6,
+    SELU,
     Sigmoid,
     SiLU,
     Softmax,
     Softplus,
+    Softshrink,
+    Softsign,
     Swish,
     Tanh,
+    Tanhshrink,
+    ThresholdedReLU,
 )
 from .layer.common import (
+    Bilinear,
+    CosineSimilarity,
     Dropout,
+    Dropout2D,
     Embedding,
     Flatten,
     Identity,
     LayerList,
     Linear,
+    Pad2D,
+    PairwiseDistance,
     ParameterList,
+    PixelShuffle,
     Sequential,
+    Unflatten,
     Upsample,
 )
-from .layer.conv import AdaptiveAvgPool2D, AvgPool2D, Conv2D, MaxPool2D
+from .layer.conv import (
+    AdaptiveAvgPool2D,
+    AvgPool1D,
+    AvgPool2D,
+    Conv1D,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    MaxPool1D,
+    MaxPool2D,
+)
 from .layer.loss import (
     BCEWithLogitsLoss,
     CrossEntropyLoss,
+    HuberLoss,
+    KLDivLoss,
     L1Loss,
+    MarginRankingLoss,
     MSELoss,
     NLLLoss,
+    SmoothL1Loss,
 )
 from .layer.norm import (
     BatchNorm,
     BatchNorm2D,
     GroupNorm,
+    InstanceNorm2D,
     LayerNorm,
     RMSNorm,
+    SyncBatchNorm,
 )
+from .layer.rnn import GRU, LSTM, SimpleRNN
 from .layer.transformer import (
     MultiHeadAttention,
     TransformerEncoder,
@@ -55,13 +88,20 @@ from .layer.transformer import (
 
 __all__ = [
     "Layer", "Parameter", "functional",
-    "Linear", "Embedding", "Dropout", "Identity", "Sequential", "LayerList",
-    "ParameterList", "Flatten", "Upsample",
+    "Linear", "Embedding", "Dropout", "Dropout2D", "Identity", "Sequential",
+    "LayerList", "ParameterList", "Flatten", "Unflatten", "Upsample",
+    "Bilinear", "PixelShuffle", "Pad2D", "CosineSimilarity",
+    "PairwiseDistance",
     "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh", "LeakyReLU",
-    "ELU", "Softmax", "LogSoftmax", "Hardswish", "Hardsigmoid", "Mish",
-    "Softplus", "GLU",
+    "ELU", "CELU", "SELU", "PReLU", "Softmax", "LogSoftmax", "LogSigmoid",
+    "Hardswish", "Hardsigmoid", "Hardshrink", "Softshrink", "Tanhshrink",
+    "Softsign", "ThresholdedReLU", "Mish", "Softplus", "GLU",
     "LayerNorm", "RMSNorm", "GroupNorm", "BatchNorm", "BatchNorm2D",
-    "Conv2D", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D",
+    "InstanceNorm2D", "SyncBatchNorm",
+    "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+    "MaxPool1D", "MaxPool2D", "AvgPool1D", "AvgPool2D", "AdaptiveAvgPool2D",
+    "SimpleRNN", "LSTM", "GRU",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
+    "SmoothL1Loss", "HuberLoss", "KLDivLoss", "MarginRankingLoss",
     "MultiHeadAttention", "TransformerEncoder", "TransformerEncoderLayer",
 ]

@@ -109,3 +109,101 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class PReLU(Layer):
+    """Learnable leaky slope (parity: paddle.nn.PReLU)."""
+
+    def __init__(self, num_parameters=1, init=0.25):
+        super().__init__()
+        import jax.numpy as jnp
+
+        from ...core import initializer as I
+
+        self.weight = self.create_parameter(
+            (num_parameters,), default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        a = self.weight.value
+        if a.shape[0] > 1:
+            # per-channel: broadcast along the channel (axis 1) dim
+            shape = [1] * x.ndim
+            shape[1] = a.shape[0]
+            a = a.reshape(shape)
+        return jnp.where(x > 0, x, a * x)
+
+
+class SELU(Layer):
+    def forward(self, x):
+        import jax
+
+        return jax.nn.selu(x)
+
+
+class CELU(Layer):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        import jax
+
+        return jax.nn.celu(x, self.alpha)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        import jax
+
+        return jax.nn.log_sigmoid(x)
+
+
+class Softsign(Layer):
+    def forward(self, x):
+        import jax
+
+        return jax.nn.soft_sign(x)
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold=0.5):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        return jnp.where(jnp.abs(x) > self.threshold, x, 0.0)
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold=0.5):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        t = self.threshold
+        return jnp.where(x > t, x - t, jnp.where(x < -t, x + t, 0.0))
+
+
+class Tanhshrink(Layer):
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        return x - jnp.tanh(x)
+
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        return jnp.where(x > self.threshold, x, 0.0)

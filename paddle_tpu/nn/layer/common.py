@@ -222,3 +222,113 @@ class Upsample(Layer):
             oh, ow = int(h * self.scale_factor), int(w * self.scale_factor)
         method = {"nearest": "nearest", "bilinear": "linear"}[self.mode]
         return jax.image.resize(x, (n, oh, ow, c), method=method)
+
+
+class Bilinear(Layer):
+    """out[.., o] = x1 @ W[o] @ x2 + b (parity: paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            default_initializer=weight_attr or I.XavierUniform(),
+        )
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_features,), is_bias=True)
+
+    def forward(self, x1, x2):
+        y = jnp.einsum("bi,oij,bj->bo", x1, self.weight.value, x2)
+        if self.bias is not None:
+            y = y + self.bias.value
+        return y
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        self.padding = padding  # [left, right, top, bottom] (paddle order)
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, t, b = self.padding
+        if self.data_format == "NCHW":
+            pads = ((0, 0), (0, 0), (t, b), (l, r))
+        else:
+            pads = ((0, 0), (t, b), (l, r), (0, 0))
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    """Drops whole channels (parity: paddle.nn.Dropout2D)."""
+
+    def __init__(self, p=0.5, data_format="NCHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ...core import random as random_mod
+        import jax
+
+        key = random_mod.next_rng_key("dropout2d")
+        shape = list(x.shape)
+        if self.data_format == "NCHW":
+            shape[2] = shape[3] = 1
+        else:
+            shape[1] = shape[2] = 1
+        keep = jax.random.bernoulli(key, 1.0 - self.p, shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=-1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        d = jnp.abs(x - y) + self.epsilon
+        out = jnp.sum(d ** self.p, axis=-1) ** (1.0 / self.p)
+        return out[..., None] if self.keepdim else out
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape):
+        super().__init__()
+        self.axis = axis
+        self.shape = tuple(shape)
+
+    def forward(self, x):
+        ax = self.axis % x.ndim
+        return x.reshape(x.shape[:ax] + self.shape + x.shape[ax + 1:])

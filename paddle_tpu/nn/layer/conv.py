@@ -93,3 +93,128 @@ class AdaptiveAvgPool2D(Layer):
 
     def forward(self, x):
         return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class _ConvNd(Layer):
+    """Shared constructor for Conv1D/Conv3D (weight [out, in/g, *k])."""
+
+    NDIM = 1
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format=None):
+        super().__init__()
+        nd = self.NDIM
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * nd
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *kernel_size),
+            default_initializer=weight_attr or I.KaimingUniform(),
+        )
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), is_bias=True)
+
+
+class Conv1D(_ConvNd):
+    NDIM = 1
+
+    def __init__(self, *a, data_format="NCL", **kw):
+        super().__init__(*a, data_format=data_format, **kw)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv3D(_ConvNd):
+    NDIM = 3
+
+    def __init__(self, *a, data_format="NCDHW", **kw):
+        super().__init__(*a, data_format=data_format, **kw)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    """Weight layout [in_channels, out_channels/groups, kh, kw]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *kernel_size),
+            default_initializer=weight_attr or I.KaimingUniform(),
+        )
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.output_padding, self.dilation, self.groups,
+            self.data_format)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def forward(self, x):
+        # [b, c, l] → window-reduce over the trailing dim
+        import jax.numpy as jnp
+        from jax import lax
+
+        pads = ((0, 0), (0, 0), (self.padding, self.padding))
+        ident = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(
+            x, ident, lax.max,
+            (1, 1, self.kernel_size), (1, 1, self.stride), pads)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        pads = ((0, 0), (0, 0), (self.padding, self.padding))
+        win = (1, 1, self.kernel_size)
+        strides = (1, 1, self.stride)
+        s = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
+        # exclusive divisor: count only real (non-pad) elements per
+        # window — matches avg_pool2d and the reference's exclusive=True
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,
+                                strides, pads)
+        return s / cnt

@@ -60,3 +60,63 @@ class BCEWithLogitsLoss(Layer):
 
     def forward(self, logit, label):
         return F.binary_cross_entropy_with_logits(logit, label, self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    """Huber with delta (parity: paddle.nn.SmoothL1Loss)."""
+
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        d = jnp.abs(input - label)
+        loss = jnp.where(d < self.delta,
+                         0.5 * d * d,
+                         self.delta * (d - 0.5 * self.delta))
+        return _reduce(loss, self.reduction)
+
+
+HuberLoss = SmoothL1Loss
+
+
+class KLDivLoss(Layer):
+    """input is LOG-probabilities, label is probabilities (parity)."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        loss = label * (jnp.log(jnp.clip(label, 1e-30)) - input)
+        if self.reduction == "batchmean":
+            return jnp.sum(loss) / input.shape[0]
+        return _reduce(loss, self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, other, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        loss = jnp.maximum(0.0, -label * (input - other) + self.margin)
+        return _reduce(loss, self.reduction)
+
+
+def _reduce(loss, reduction):
+    import jax.numpy as jnp
+
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

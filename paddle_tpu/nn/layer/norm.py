@@ -138,3 +138,56 @@ class BatchNorm2D(Layer):
 
 
 BatchNorm = BatchNorm2D
+
+
+class InstanceNorm2D(Layer):
+    """Per-sample, per-channel normalization over H, W (parity:
+    paddle.nn.InstanceNorm2D; stateless — no running stats by default,
+    matching the reference's track_running_stats=False semantics)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.num_features = num_features
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.scale = None if weight_attr is False else \
+            self.create_parameter(
+                (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((num_features,), is_bias=True)
+
+    def forward(self, x):
+        axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
+        c_axis = 1 if self.data_format == "NCHW" else 3
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) / jnp.sqrt(var + self.epsilon)
+        shape = [1] * x.ndim
+        shape[c_axis] = self.num_features
+        if self.scale is not None:
+            y = y * self.scale.value.reshape(shape)
+        if self.bias is not None:
+            y = y + self.bias.value.reshape(shape)
+        return y.astype(x.dtype)
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica batch norm.
+
+    On TPU this is BatchNorm2D: inside pjit/GSPMD, ``jnp.mean`` over a
+    batch axis that is sharded across the mesh ALREADY reduces globally
+    (XLA inserts the all-reduce) — the reference needs an explicit NCCL
+    allreduce (paddle/nn/layer/norm.py SyncBatchNorm) only because its
+    per-rank eager kernels see local shards. ``convert_sync_batchnorm``
+    is therefore an in-place class swap kept for API parity.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for parent in layer.sublayers(include_self=True):
+            for name, sub in list(parent._sub_layers.items()):
+                if type(sub) is BatchNorm2D:
+                    sub.__class__ = cls
+        return layer

@@ -1,0 +1,142 @@
+"""Distribution parity tests (reference: test/distribution/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+
+def setup_function(_):
+    pt.seed(0)
+
+
+def _moments(dist, n=20000):
+    s = np.asarray(dist.sample((n,)))
+    return s.mean(axis=0), s.var(axis=0)
+
+
+def test_exponential():
+    d = D.Exponential(2.0)
+    m, v = _moments(d)
+    np.testing.assert_allclose(m, 0.5, rtol=0.05)
+    np.testing.assert_allclose(v, 0.25, rtol=0.15)
+    # log_prob: f(x) = rate * exp(-rate x)
+    np.testing.assert_allclose(float(d.log_prob(1.0)),
+                               np.log(2.0) - 2.0, rtol=1e-6)
+    assert float(d.log_prob(-1.0)) == -np.inf
+    np.testing.assert_allclose(float(d.entropy()), 1 - np.log(2.0),
+                               rtol=1e-6)
+
+
+def test_laplace_and_gumbel():
+    lap = D.Laplace(1.0, 2.0)
+    m, v = _moments(lap)
+    np.testing.assert_allclose(m, 1.0, atol=0.1)
+    np.testing.assert_allclose(v, 2 * 4.0, rtol=0.2)
+    np.testing.assert_allclose(float(lap.log_prob(1.0)),
+                               -np.log(4.0), rtol=1e-6)
+    g = D.Gumbel(0.0, 1.0)
+    m, v = _moments(g)
+    np.testing.assert_allclose(m, np.euler_gamma, atol=0.05)
+    np.testing.assert_allclose(v, np.pi**2 / 6, rtol=0.1)
+
+
+def test_gamma_beta():
+    g = D.Gamma(3.0, 2.0)
+    m, v = _moments(g)
+    np.testing.assert_allclose(m, 1.5, rtol=0.05)
+    np.testing.assert_allclose(v, 3 / 4, rtol=0.15)
+    # log_prob at x=1: a log b + (a-1) log x - b x - lgamma(a)
+    import math
+
+    ref = 3 * np.log(2.0) - 2.0 - math.lgamma(3.0)
+    np.testing.assert_allclose(float(g.log_prob(1.0)), ref, rtol=1e-5)
+
+    b = D.Beta(2.0, 3.0)
+    m, v = _moments(b)
+    np.testing.assert_allclose(m, 0.4, rtol=0.05)
+    ref = (np.log(0.5) * 1 + np.log(0.5) * 2
+           - (math.lgamma(2) + math.lgamma(3) - math.lgamma(5)))
+    np.testing.assert_allclose(float(b.log_prob(0.5)), ref, rtol=1e-5)
+
+
+def test_dirichlet():
+    d = D.Dirichlet(jnp.asarray([1.0, 2.0, 3.0]))
+    s = np.asarray(d.sample((5000,)))
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.02)
+    lp = float(d.log_prob(jnp.asarray([0.2, 0.3, 0.5])))
+    import math
+
+    ref = (0 * np.log(0.2) + 1 * np.log(0.3) + 2 * np.log(0.5)
+           + math.lgamma(6) - (math.lgamma(1) + math.lgamma(2)
+                               + math.lgamma(3)))
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+
+
+def test_lognormal_multinomial_poisson():
+    ln = D.LogNormal(0.0, 0.5)
+    m, _ = _moments(ln)
+    np.testing.assert_allclose(m, np.exp(0.125), rtol=0.05)
+
+    mn = D.Multinomial(10, jnp.asarray([0.2, 0.3, 0.5]))
+    s = np.asarray(mn.sample((2000,)))
+    assert (s.sum(-1) == 10).all()
+    np.testing.assert_allclose(s.mean(0), [2, 3, 5], rtol=0.1)
+    # log_prob of an observed count vector
+
+
+def test_poisson():
+    p = D.Poisson(4.0)
+    s = np.asarray(p.sample((20000,)))
+    np.testing.assert_allclose(s.mean(), 4.0, rtol=0.05)
+    np.testing.assert_allclose(s.var(), 4.0, rtol=0.1)
+    import math
+
+    ref = 2 * np.log(4.0) - 4.0 - math.lgamma(3.0)
+    np.testing.assert_allclose(float(p.log_prob(2.0)), ref, rtol=1e-5)
+
+
+def test_kl_pairs():
+    # closed forms verified against hand computation
+    kl = D.kl_divergence(D.Exponential(2.0), D.Exponential(1.0))
+    r = 2.0
+    np.testing.assert_allclose(float(kl), np.log(r) + 1 / r - 1, rtol=1e-6)
+
+    kl = D.kl_divergence(D.Bernoulli(0.3), D.Bernoulli(0.5))
+    ref = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+    np.testing.assert_allclose(float(kl), ref, rtol=1e-5)
+
+    # KL(p||p) == 0 for every registered pair
+    pairs = [
+        (D.Normal(0.0, 1.0), D.Normal(0.0, 1.0)),
+        (D.Gamma(2.0, 3.0), D.Gamma(2.0, 3.0)),
+        (D.Beta(2.0, 3.0), D.Beta(2.0, 3.0)),
+        (D.Dirichlet(jnp.asarray([1.0, 2.0])),
+         D.Dirichlet(jnp.asarray([1.0, 2.0]))),
+        (D.Uniform(0.0, 1.0), D.Uniform(0.0, 1.0)),
+        (D.Exponential(1.5), D.Exponential(1.5)),
+        (D.Bernoulli(0.4), D.Bernoulli(0.4)),
+    ]
+    for p, q in pairs:
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), 0.0,
+                                   atol=1e-5)
+
+    # KL via monte carlo for Gamma pair
+    p, q = D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)
+    s = p.sample((50000,))
+    mc = float(jnp.mean(p.log_prob(s) - q.log_prob(s)))
+    np.testing.assert_allclose(float(D.kl_divergence(p, q)), mc,
+                               rtol=0.05)
+
+
+def test_entropy_matches_mc():
+    for d in [D.Gamma(2.0, 1.5), D.Beta(2.0, 3.0), D.Laplace(0.0, 1.0),
+              D.Gumbel(1.0, 2.0)]:
+        s = d.sample((50000,))
+        mc = float(-jnp.mean(d.log_prob(s)))
+        np.testing.assert_allclose(float(jnp.sum(d.entropy())), mc,
+                                   rtol=0.05)

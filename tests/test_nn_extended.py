@@ -1,0 +1,285 @@
+"""Extended nn coverage: conv variants, RNNs, activations, losses, norms
+(parity: paddle.nn layer set, test/legacy_test op tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+# ---------------- convs ----------------
+
+def test_conv1d_matches_manual():
+    x = jnp.asarray(_rand((2, 3, 10)))
+    lyr = nn.Conv1D(3, 5, 3, padding=1)
+    y = lyr(x)
+    assert y.shape == (2, 5, 10)
+    # compare against conv2d with a dummy height dim
+    w2 = lyr.weight.value[:, :, None, :]
+    y2 = F.conv2d(x[:, :, None, :], w2, lyr.bias, stride=1,
+                  padding=[(0, 0), (1, 1)])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2[:, :, 0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv3d_shape_and_identity_kernel():
+    x = jnp.asarray(_rand((1, 2, 4, 6, 6)))
+    lyr = nn.Conv3D(2, 2, 1, bias_attr=False)
+    # identity kernel: out[c] = in[c]
+    eye = np.zeros((2, 2, 1, 1, 1), np.float32)
+    eye[0, 0] = eye[1, 1] = 1.0
+    lyr.weight.value = jnp.asarray(eye)
+    np.testing.assert_allclose(np.asarray(lyr(x)), np.asarray(x),
+                               rtol=1e-6)
+
+
+def test_conv2d_transpose_inverts_stride():
+    x = jnp.asarray(_rand((1, 3, 5, 5)))
+    lyr = nn.Conv2DTranspose(3, 4, 3, stride=2, padding=1,
+                             output_padding=1)
+    y = lyr(x)
+    assert y.shape == (1, 4, 10, 10)
+    # torch cross-check (cpu torch is available in the image)
+    import torch
+
+    ty = torch.nn.functional.conv_transpose2d(
+        torch.tensor(np.asarray(x)),
+        torch.tensor(np.asarray(lyr.weight.value)),
+        torch.tensor(np.asarray(lyr.bias.value)),
+        stride=2, padding=1, output_padding=1)
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_pool1d():
+    x = jnp.asarray(_rand((2, 3, 8)))
+    my = nn.MaxPool1D(2)(x)
+    ay = nn.AvgPool1D(2)(x)
+    xr = np.asarray(x).reshape(2, 3, 4, 2)
+    np.testing.assert_allclose(np.asarray(my), xr.max(-1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ay), xr.mean(-1), rtol=1e-6)
+
+
+# ---------------- rnns ----------------
+
+@pytest.mark.parametrize("cls,gates", [(nn.SimpleRNN, 1), (nn.GRU, 3),
+                                       (nn.LSTM, 4)])
+def test_rnn_shapes_and_state(cls, gates):
+    pt.seed(0)
+    rnn = cls(6, 8, num_layers=2, direction="bidirect")
+    x = jnp.asarray(_rand((3, 5, 6)))
+    out, state = rnn(x)
+    assert out.shape == (3, 5, 16)  # bidirectional concat
+    if cls is nn.LSTM:
+        h, c = state
+        assert h.shape == (4, 3, 8) and c.shape == (4, 3, 8)
+    else:
+        assert state.shape == (4, 3, 8)
+
+
+def test_lstm_matches_torch():
+    import torch
+
+    pt.seed(0)
+    rnn = nn.LSTM(4, 5)
+    t = torch.nn.LSTM(4, 5, batch_first=True)
+    # copy our params into torch (torch stores transposed)
+    sd = {
+        "weight_ih_l0": np.asarray(rnn.weight_ih_l0.value).T,
+        "weight_hh_l0": np.asarray(rnn.weight_hh_l0.value).T,
+        "bias_ih_l0": np.asarray(rnn.bias_ih_l0.value),
+        "bias_hh_l0": np.asarray(rnn.bias_hh_l0.value),
+    }
+    t.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    x = _rand((2, 7, 4))
+    out, (h, c) = rnn(jnp.asarray(x))
+    tout, (th, tc) = t(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h[0]), th[0].detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    import torch
+
+    pt.seed(1)
+    rnn = nn.GRU(4, 5)
+    t = torch.nn.GRU(4, 5, batch_first=True)
+    sd = {
+        "weight_ih_l0": np.asarray(rnn.weight_ih_l0.value).T,
+        "weight_hh_l0": np.asarray(rnn.weight_hh_l0.value).T,
+        "bias_ih_l0": np.asarray(rnn.bias_ih_l0.value),
+        "bias_hh_l0": np.asarray(rnn.bias_hh_l0.value),
+    }
+    t.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    x = _rand((2, 7, 4))
+    out, h = rnn(jnp.asarray(x))
+    tout, th = t(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------- activations / misc ----------------
+
+def test_new_activations_numerics():
+    x = jnp.asarray(_rand((50,), seed=3))
+    xn = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(nn.PReLU(1, 0.2)(x)),
+                               np.where(xn > 0, xn, 0.2 * xn), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(nn.Softsign()(x)),
+                               xn / (1 + np.abs(xn)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(nn.Tanhshrink()(x)),
+                               xn - np.tanh(xn), rtol=1e-5, atol=1e-6)
+    hs = np.asarray(nn.Hardshrink(0.5)(x))
+    np.testing.assert_allclose(hs, np.where(np.abs(xn) > 0.5, xn, 0))
+    ss = np.asarray(nn.Softshrink(0.5)(x))
+    ref = np.where(xn > 0.5, xn - 0.5, np.where(xn < -0.5, xn + 0.5, 0))
+    np.testing.assert_allclose(ss, ref, rtol=1e-6)
+
+
+def test_prelu_per_channel():
+    x = jnp.asarray(_rand((2, 3, 4, 4), seed=4))
+    p = nn.PReLU(3, 0.1)
+    p.weight.value = jnp.asarray([0.1, 0.2, 0.3])
+    y = np.asarray(p(x))
+    xn = np.asarray(x)
+    for c, a in enumerate([0.1, 0.2, 0.3]):
+        np.testing.assert_allclose(
+            y[:, c], np.where(xn[:, c] > 0, xn[:, c], a * xn[:, c]),
+            rtol=1e-6)
+
+
+def test_losses():
+    a = jnp.asarray(_rand((10,), seed=5))
+    b = jnp.asarray(_rand((10,), seed=6))
+    an, bn = np.asarray(a), np.asarray(b)
+    sl = float(nn.SmoothL1Loss()(a, b))
+    d = np.abs(an - bn)
+    ref = np.where(d < 1, 0.5 * d * d, d - 0.5).mean()
+    np.testing.assert_allclose(sl, ref, rtol=1e-5)
+
+    logp = jnp.asarray(np.log(np.full((4, 3), 1 / 3, np.float32)))
+    probs = jnp.asarray(np.array([[0.2, 0.3, 0.5]] * 4, np.float32))
+    kl = float(nn.KLDivLoss(reduction="batchmean")(logp, probs))
+    ref = (np.array([0.2, 0.3, 0.5]) *
+           (np.log([0.2, 0.3, 0.5]) - np.log(1 / 3))).sum()
+    np.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+    mr = float(nn.MarginRankingLoss(margin=0.1)(a, b,
+                                                jnp.ones_like(a)))
+    ref = np.maximum(0, -(an - bn) + 0.1).mean()
+    np.testing.assert_allclose(mr, ref, rtol=1e-5)
+
+
+def test_instance_norm_and_sync_bn():
+    x = jnp.asarray(_rand((2, 3, 8, 8), seed=7))
+    inorm = nn.InstanceNorm2D(3)
+    y = np.asarray(inorm(x))
+    np.testing.assert_allclose(y.mean(axis=(2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(axis=(2, 3)), 1, atol=1e-3)
+    net = nn.Sequential(nn.Conv2D(3, 4, 1), nn.BatchNorm2D(4))
+    nn.SyncBatchNorm.convert_sync_batchnorm(net)
+    assert type(net._sub_layers["1"]) is nn.SyncBatchNorm
+
+
+def test_misc_layers():
+    x1 = jnp.asarray(_rand((4, 3), seed=8))
+    x2 = jnp.asarray(_rand((4, 5), seed=9))
+    bl = nn.Bilinear(3, 5, 2)
+    y = bl(x1, x2)
+    assert y.shape == (4, 2)
+    ref = np.einsum("bi,oij,bj->bo", np.asarray(x1),
+                    np.asarray(bl.weight.value), np.asarray(x2)) + \
+        np.asarray(bl.bias.value)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5)
+
+    ps = nn.PixelShuffle(2)
+    x = jnp.asarray(_rand((1, 8, 3, 3), seed=10))
+    out = ps(x)
+    assert out.shape == (1, 2, 6, 6)
+    import torch
+
+    tref = torch.pixel_shuffle(torch.tensor(np.asarray(x)), 2).numpy()
+    np.testing.assert_allclose(np.asarray(out), tref, rtol=1e-6)
+
+    pad = nn.Pad2D([1, 2, 3, 4])
+    assert pad(jnp.zeros((1, 1, 5, 5))).shape == (1, 1, 12, 8)
+
+    cs = nn.CosineSimilarity()(x1, jnp.asarray(_rand((4, 3), seed=11)))
+    assert cs.shape == (4,)
+    uf = nn.Unflatten(1, (2, 4))
+    assert uf(jnp.zeros((3, 8))).shape == (3, 2, 4)
+
+
+def test_dropout2d_drops_whole_channels():
+    pt.seed(0)
+    d = nn.Dropout2D(0.5)
+    x = jnp.ones((8, 16, 4, 4))
+    y = np.asarray(d(x))
+    per_channel = y.reshape(8, 16, -1)
+    # each channel is either all zero or all scaled
+    for b in range(8):
+        for c in range(16):
+            vals = np.unique(per_channel[b, c])
+            assert len(vals) == 1 and vals[0] in (0.0, 2.0)
+    d.eval()
+    np.testing.assert_allclose(np.asarray(d(x)), np.asarray(x))
+
+
+def test_lstm_initial_states_used():
+    import torch
+
+    pt.seed(2)
+    rnn = nn.LSTM(4, 5)
+    x = _rand((2, 3, 4), seed=12)
+    h0 = _rand((1, 2, 5), seed=13)
+    c0 = _rand((1, 2, 5), seed=14)
+    out0, _ = rnn(jnp.asarray(x))
+    out1, _ = rnn(jnp.asarray(x),
+                  (jnp.asarray(h0), jnp.asarray(c0)))
+    assert not np.allclose(np.asarray(out0), np.asarray(out1))
+    t = torch.nn.LSTM(4, 5, batch_first=True)
+    t.load_state_dict({
+        "weight_ih_l0": torch.tensor(np.asarray(rnn.weight_ih_l0.value).T),
+        "weight_hh_l0": torch.tensor(np.asarray(rnn.weight_hh_l0.value).T),
+        "bias_ih_l0": torch.tensor(np.asarray(rnn.bias_ih_l0.value)),
+        "bias_hh_l0": torch.tensor(np.asarray(rnn.bias_hh_l0.value)),
+    })
+    tout, _ = t(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    np.testing.assert_allclose(np.asarray(out1), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool1d_bf16_negative():
+    x = jnp.asarray([[-5.0, -4.0, -3.0, -2.0]], jnp.bfloat16)[None]
+    y = np.asarray(nn.MaxPool1D(2)(x), np.float32)
+    np.testing.assert_allclose(y[0, 0], [-4.0, -2.0])
+
+
+def test_avgpool1d_exclusive_padding():
+    x = jnp.asarray([[[1.0, 2.0, 3.0, 4.0]]])
+    y = np.asarray(nn.AvgPool1D(2, stride=2, padding=1)(x))
+    np.testing.assert_allclose(y[0, 0], [1.0, 2.5, 4.0])
+
+
+def test_instance_norm_attr_independence():
+    a = nn.InstanceNorm2D(3, bias_attr=False)
+    assert a.scale is not None and a.bias is None
+    b = nn.InstanceNorm2D(3, weight_attr=False)
+    assert b.scale is None and b.bias is not None
+    x = jnp.asarray(_rand((1, 3, 4, 4), seed=15))
+    assert a(x).shape == x.shape and b(x).shape == x.shape
+
+
+def test_swish_is_silu_alias():
+    assert nn.Swish is nn.SiLU
