@@ -6,56 +6,125 @@ bf16 compute / fp32 master, ZeRO-3-equivalent sharding when >1 chip).
 vs_baseline: BASELINE.json has "published": {} (no reference numbers), so
 this reports the ratio against our own recorded first measurement when
 BENCH_BASELINE.json exists, else 1.0.
+
+Resilience contract (round-1 failed rc=1 on TPU-backend init): the TPU
+backend is probed in a KILLABLE SUBPROCESS with retries/backoff — a hung
+or failing PJRT init can never take this process down. If the TPU is
+unreachable the benchmark still emits a valid JSON line from a CPU smoke
+run, with the TPU failure diagnostics in "extra.tpu_probe".
+
+Usage:
+  python bench.py            # headline: llama train step
+  python bench.py --config moe|vit|mamba|infer   # secondary benchmarks
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+PROBE_TIMEOUTS = (240, 120)  # seconds per attempt; first covers cold init
 
-def main():
+
+def probe_tpu():
+    """Try to bring up the TPU backend in a killable child. Returns
+    (ok, diagnostics)."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "import jax.numpy as jnp; "
+        "x = jnp.ones((128, 128)); "
+        "print((x @ x).sum()); "
+        "print('PROBE_OK', len(ds), ds[0].platform)"
+    )
+    diags = []
+    for attempt, tmo in enumerate(PROBE_TIMEOUTS):
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=tmo,
+            )
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                return True, diags
+            diags.append({
+                "attempt": attempt, "rc": r.returncode,
+                "elapsed_s": round(time.time() - t0, 1),
+                "stderr_tail": r.stderr[-800:],
+            })
+        except subprocess.TimeoutExpired:
+            diags.append({
+                "attempt": attempt, "rc": "timeout",
+                "elapsed_s": round(time.time() - t0, 1),
+                "stderr_tail": f"probe hung > {tmo}s (PJRT init stall)",
+            })
+        if attempt < len(PROBE_TIMEOUTS) - 1:
+            time.sleep(5 * (attempt + 1))
+    return False, diags
+
+
+def _llama_cfg(platform):
+    from paddle_tpu.models import LlamaConfig
+
+    if platform == "tpu":
+        # a ~350M-param Llama: big enough to be MXU-bound, small enough
+        # to fit one v5e chip with batch tokens that saturate it
+        return LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=16,
+            num_attention_heads=8,  # head_dim 128 → Pallas flash kernel
+            num_key_value_heads=8,
+            max_position_embeddings=2048,
+            use_flash_attention=True,
+            use_recompute=True,
+            dtype="bfloat16",
+        ), 4, 2048, 10
+    # CPU smoke: tiny but same code path
+    return LlamaConfig(
+        vocab_size=512,
+        hidden_size=256,
+        intermediate_size=512,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        use_flash_attention=False,
+        dtype="float32",
+    ), 2, 256, 3
+
+
+def bench_llama_train(tpu_diags):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as pt
-    from paddle_tpu import amp, distributed as dist, optimizer as opt
+    from paddle_tpu import distributed as dist, optimizer as opt
     from paddle_tpu.distributed.strategy import (
         DistributedStrategy,
         HybridConfig,
     )
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import LlamaForCausalLM
     from paddle_tpu.trainer import TrainStep
 
     devices = jax.devices()
     n = len(devices)
     platform = devices[0].platform
 
-    # a ~350M-param Llama: big enough to be MXU-bound, small enough to
-    # fit one v5e chip with batch tokens that saturate it
-    cfg = LlamaConfig(
-        vocab_size=32000,
-        hidden_size=1024,
-        intermediate_size=2816,
-        num_hidden_layers=16,
-        num_attention_heads=8,  # head_dim 128 → Pallas flash kernel
-        num_key_value_heads=8,
-        max_position_embeddings=2048,
-        use_flash_attention=True,
-        use_recompute=True,
-        dtype="bfloat16",
-    )
-    batch, seq = 4, 2048
+    cfg, batch, seq, iters = _llama_cfg(platform)
 
     pt.seed(0)
     model = LlamaForCausalLM(cfg)
-    model.to(pt.bfloat16)
+    if cfg.dtype == "bfloat16":
+        model.to(pt.bfloat16)
 
     optimizer = opt.AdamW(
-        learning_rate=3e-4, weight_decay=0.01, multi_precision=True,
+        learning_rate=3e-4, weight_decay=0.01,
+        multi_precision=(cfg.dtype == "bfloat16"),
         grad_clip=opt.ClipGradByGlobalNorm(1.0),
     )
     strategy = DistributedStrategy()
@@ -76,7 +145,6 @@ def main():
     ts.run(data).block_until_ready()
     ts.run(data).block_until_ready()
 
-    iters = 10
     t0 = time.perf_counter()
     loss = None
     for _ in range(iters):
@@ -88,38 +156,103 @@ def main():
     tokens_per_sec_chip = tokens_per_sec / n
 
     # MFU: 6*N_params*tokens/sec vs peak flops (v5e bf16 ~197 TF/s/chip)
-    n_params = sum(
-        int(np.prod(p.shape)) for p in model.parameters()
-    )
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     model_flops = 6 * n_params * tokens_per_sec_chip
     peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 197e12)
     mfu = model_flops / peak
 
     vs = 1.0
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
+    if os.path.exists(base_path) and platform == "tpu":
         try:
             with open(base_path) as f:
                 vs = tokens_per_sec_chip / float(json.load(f)["value"])
         except Exception:
             vs = 1.0
 
-    result = {
-        "metric": "llama350m_train_tokens_per_sec_per_chip",
+    extra = {
+        "n_chips": n,
+        "platform": platform,
+        "params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "step_ms": round(1000 * dt / iters, 2),
+        "mfu_est": round(mfu, 4),
+        "loss": float(loss),
+    }
+    if tpu_diags:
+        extra["tpu_probe"] = tpu_diags
+    name = ("llama350m_train_tokens_per_sec_per_chip" if platform == "tpu"
+            else "llama_train_cpu_smoke_tokens_per_sec")
+    return {
+        "metric": name,
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
-        "extra": {
-            "n_chips": n,
-            "platform": platform,
-            "params": n_params,
-            "batch": batch,
-            "seq": seq,
-            "step_ms": round(1000 * dt / iters, 2),
-            "mfu_est": round(mfu, 4),
-            "loss": float(loss),
-        },
+        "extra": extra,
     }
+
+
+def main():
+    argv = sys.argv[1:]
+    config = "llama"
+    if "--config" in argv:
+        config = argv[argv.index("--config") + 1]
+
+    tpu_diags = None
+    if os.environ.get("_BENCH_CHILD"):
+        tpu_diags = json.loads(os.environ["_BENCH_CHILD"])
+    elif os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+        ok, diags = probe_tpu()
+        if not ok:
+            # Fall back to CPU in a RE-EXEC'D child with the axon plugin
+            # env scrubbed: this interpreter already registered the
+            # tunnel plugin via sitecustomize, and jax initializes every
+            # registered plugin on first use — a hung tunnel would block
+            # even a CPU-only run in-process.
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["_BENCH_CHILD"] = json.dumps(
+                {"tpu_unavailable": True, "attempts": diags})
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)] + argv,
+                    env=env, timeout=1800, capture_output=True, text=True,
+                )
+                out = r.stdout.strip().splitlines()
+                print(out[-1] if out else json.dumps({
+                    "metric": f"bench_{config}_failed", "value": 0.0,
+                    "unit": "error", "vs_baseline": 0.0,
+                    "extra": {"stderr": r.stderr[-1000:]}}))
+            except subprocess.TimeoutExpired:
+                print(json.dumps({
+                    "metric": f"bench_{config}_failed", "value": 0.0,
+                    "unit": "error", "vs_baseline": 0.0,
+                    "extra": {"error": "cpu fallback bench timed out"}}))
+            return
+
+    try:
+        if config == "llama":
+            result = bench_llama_train(tpu_diags)
+        else:
+            from benchmarks.suite import run_config
+
+            result = run_config(config, tpu_diags)
+    except Exception as e:  # last-resort: never exit nonzero silently
+        import traceback
+
+        result = {
+            "metric": f"bench_{config}_failed",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {
+                "error": repr(e),
+                "traceback": traceback.format_exc()[-1500:],
+                "tpu_probe": tpu_diags,
+            },
+        }
     print(json.dumps(result))
 
 

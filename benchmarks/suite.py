@@ -1,0 +1,227 @@
+"""Secondary benchmark configs from BASELINE.json: ERNIE-MoE, ViT-L,
+SD-UNet, Mamba, and decode/TTFT inference.
+
+Each ``run_config(name)`` returns the same one-line JSON dict shape as
+the headline llama bench. Sizes scale by platform: real configs on TPU,
+smoke configs on CPU (so the suite is runnable anywhere, rc=0 always).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _platform():
+    return jax.devices()[0].platform
+
+
+def _result(metric, value, unit, extra, tpu_diags):
+    if tpu_diags:
+        extra["tpu_probe"] = tpu_diags
+    extra["platform"] = _platform()
+    extra["n_chips"] = len(jax.devices())
+    return {
+        "metric": metric,
+        "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": 1.0,
+        "extra": extra,
+    }
+
+
+def _train_throughput(model, data, loss_fn=None, iters=None, unit_count=0):
+    """Shared train-step timing harness → (per-sec rate, step_ms, loss)."""
+    import paddle_tpu as pt
+    from paddle_tpu import distributed as dist, optimizer as opt
+    from paddle_tpu.trainer import TrainStep
+
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    ts = TrainStep(model, opt.AdamW(1e-4, multi_precision=False), mesh,
+                   loss_fn=loss_fn)
+    iters = iters or (10 if _platform() == "tpu" else 2)
+    ts.run(data).block_until_ready()
+    ts.run(data).block_until_ready()
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = ts.run(data)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    return unit_count * iters / dt, 1000 * dt / iters, float(loss)
+
+
+def bench_moe(tpu_diags):
+    import paddle_tpu as pt
+    from paddle_tpu.models import ErnieMoEConfig, ErnieMoEForCausalLM
+
+    tpu = _platform() == "tpu"
+    cfg = (ErnieMoEConfig(
+        vocab_size=32000, hidden_size=1024, num_hidden_layers=8,
+        num_attention_heads=8, max_position_embeddings=1024,
+        num_experts=8, moe_every=2, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+        if tpu else ErnieMoEConfig.tiny(
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    batch, seq = (4, 1024) if tpu else (2, 128)
+    pt.seed(0)
+    model = ErnieMoEForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)))
+    rate, step_ms, loss = _train_throughput(
+        model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
+    return _result("ernie_moe_train_tokens_per_sec", rate, "tokens/s",
+                   {"step_ms": round(step_ms, 2), "loss": loss,
+                    "experts": cfg.num_experts}, tpu_diags)
+
+
+def bench_vit(tpu_diags):
+    import paddle_tpu as pt
+    from paddle_tpu.models import ViT, ViTConfig
+    from paddle_tpu.nn import functional as F
+
+    tpu = _platform() == "tpu"
+    cfg = ViTConfig.vit_l() if tpu else ViTConfig.tiny()
+    batch = 32 if tpu else 4
+    pt.seed(0)
+    model = ViT(cfg)
+    imgs = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (batch, cfg.image_size, cfg.image_size, cfg.num_channels)),
+        jnp.float32)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.num_classes, (batch,)))
+
+    def loss_fn(logits, label):
+        return F.cross_entropy(logits, label).mean()
+
+    rate, step_ms, loss = _train_throughput(
+        model, {"input": imgs, "label": labels}, loss_fn=loss_fn,
+        unit_count=batch)
+    return _result("vit_l_train_images_per_sec", rate, "images/s",
+                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+
+
+def bench_unet(tpu_diags):
+    import paddle_tpu as pt
+    from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+    tpu = _platform() == "tpu"
+    cfg = (UNetConfig(sample_size=32) if tpu
+           else UNetConfig.tiny(sample_size=8))
+    batch = 4 if tpu else 1
+    pt.seed(0)
+    model = UNet2DConditionModel(cfg)
+    size = cfg.sample_size
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (batch, size, size, cfg.in_channels)), jnp.float32)
+    t = jnp.asarray(np.random.default_rng(1).integers(0, 1000, (batch,)))
+    ctx = jnp.asarray(np.random.default_rng(2).standard_normal(
+        (batch, 77, cfg.cross_attention_dim)), jnp.float32)
+
+    # adapter computing the denoising MSE (proxy for the ppdiffusers
+    # training loss) so TrainStep's self-loss path applies
+    from paddle_tpu.core.module import Layer
+
+    class _Wrap(Layer):
+        def __init__(self):
+            super().__init__()
+            self.unet = model
+
+        def forward(self, sample, timestep, context, target):
+            pred = self.unet(sample, timestep, context)
+            return jnp.mean((pred - target) ** 2)
+
+    wrap = _Wrap()
+    data = {"sample": x, "timestep": t, "context": ctx, "target": x}
+    rate, step_ms, loss = _train_throughput(wrap, data, unit_count=batch)
+    return _result("sd_unet_train_samples_per_sec", rate, "samples/s",
+                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+
+
+def bench_mamba(tpu_diags):
+    import paddle_tpu as pt
+    from paddle_tpu.models import MambaConfig, MambaForCausalLM
+
+    tpu = _platform() == "tpu"
+    cfg = (MambaConfig(
+        vocab_size=32000, hidden_size=768, num_hidden_layers=12,
+        use_chunked_scan=True)
+        if tpu else MambaConfig.tiny(use_chunked_scan=True, scan_chunk=32))
+    batch, seq = (4, 1024) if tpu else (2, 64)
+    pt.seed(0)
+    model = MambaForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)))
+    rate, step_ms, loss = _train_throughput(
+        model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
+    return _result("mamba_train_tokens_per_sec", rate, "tokens/s",
+                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+
+
+def bench_infer(tpu_diags):
+    """p50 TTFT + decode tokens/sec on the flagship Llama (BASELINE's
+    inference metric)."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    tpu = _platform() == "tpu"
+    cfg = (LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=16, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=2048, use_flash_attention=True,
+        dtype="bfloat16")
+        if tpu else LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=512,
+            use_flash_attention=False))
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if tpu:
+        model.to(pt.bfloat16)
+    icfg = Config()
+    icfg.max_seq_len = 1024 if tpu else 256
+    icfg.seq_buckets = (128, 512) if tpu else (128,)
+    pred = Predictor(model, icfg)
+
+    prompt_len = 120
+    prompt = np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                               (1, prompt_len))
+    new_tokens = 64 if tpu else 8
+    # warmup (compile both programs)
+    pred.generate(prompt, max_new_tokens=4)
+    ttfts = []
+    t_decode = 0.0
+    n_decode = 0
+    for _ in range(5 if tpu else 2):
+        t0 = time.perf_counter()
+        out = pred.generate(prompt, max_new_tokens=new_tokens)
+        dt = time.perf_counter() - t0
+        ttfts.append(pred.last_ttft_ms)
+        t_decode += dt - pred.last_ttft_ms / 1e3
+        n_decode += out.shape[1] - 1
+    p50 = float(np.percentile(ttfts, 50))
+    decode_tps = n_decode / t_decode if t_decode > 0 else 0.0
+    return _result("infer_p50_ttft_ms", p50, "ms",
+                   {"decode_tokens_per_sec": round(decode_tps, 1),
+                    "prompt_len": prompt_len,
+                    "ttft_all_ms": [round(t, 2) for t in ttfts]}, tpu_diags)
+
+
+_CONFIGS = {
+    "moe": bench_moe,
+    "vit": bench_vit,
+    "unet": bench_unet,
+    "mamba": bench_mamba,
+    "infer": bench_infer,
+}
+
+
+def run_config(name, tpu_diags=None):
+    if name not in _CONFIGS:
+        raise ValueError(f"unknown config {name!r}; one of {list(_CONFIGS)}")
+    return _CONFIGS[name](tpu_diags)

@@ -139,7 +139,15 @@ class VocabParallelEmbedding(Layer):
         self.weight.is_distributed = True
 
     def forward(self, x):
-        y = F.embedding(x, self.weight)
+        # Constrain the weight's hidden dim replicated before the gather:
+        # under ZeRO-3 fsdp lands on the hidden dim (vocab is taken by
+        # tp), and a gather from a hidden-sharded table produces
+        # hidden-sharded activations that SPMD can only reshard to the
+        # batch/seq layout by full rematerialization. Forcing the
+        # all-gather onto the weight (the ZeRO-3 contract anyway) keeps
+        # the gather output partitionable along batch/seq.
+        w = shard_activation(self.weight.value, "tp", None)
+        y = F.embedding(x, w)
         return shard_activation(y, ("dp", "fsdp"), *([None] * (y.ndim - 2)), None)
 
 

@@ -87,6 +87,18 @@ def gather_kv(cache: PagedLayerCache, state: PagedState
             v.reshape(slots, max_pages * page_size, kvh, d))
 
 
+def _use_pallas_decode(cache: PagedLayerCache) -> bool:
+    import os
+
+    import jax as _jax
+
+    page_size, d = cache.k_pages.shape[1], cache.k_pages.shape[3]
+    aligned = d % 128 == 0 and page_size % 16 == 0
+    if os.environ.get("PADDLE_TPU_FORCE_PALLAS"):
+        return aligned
+    return aligned and _jax.default_backend() == "tpu"
+
+
 def paged_attention(q, cache: PagedLayerCache, state: PagedState,
                     scale=None):
     """Decode attention over the paged cache.
@@ -95,8 +107,23 @@ def paged_attention(q, cache: PagedLayerCache, state: PagedState,
     The current token's K/V must already be appended, so slot i attends
     to positions [0, seq_lens[i]] inclusive of itself.
     Returns [slots, 1, heads, head_dim].
+
+    On TPU this runs the Pallas block-table kernel
+    (kernels/paged_attention.py): pages stream straight from the pool by
+    page id — per-step HBM traffic ∝ Σ seq_lens rather than the
+    slots × max_ctx of the dense gather fallback below.
     """
     slots, one, h, d = q.shape
+    kvh_ = cache.k_pages.shape[2]
+    if _use_pallas_decode(cache) and h % kvh_ == 0:
+        from ..kernels.paged_attention import paged_decode_attention
+
+        qg = q[:, 0].reshape(slots, kvh_, h // kvh_, d)
+        out = paged_decode_attention(
+            qg, cache.k_pages, cache.v_pages, state.block_tables,
+            state.seq_lens, scale=scale,
+        )
+        return out.reshape(slots, 1, h, d)
     k, v = gather_kv(cache, state)  # [slots, ctx, kvh, d]
     ctx = k.shape[1]
     kvh = k.shape[2]

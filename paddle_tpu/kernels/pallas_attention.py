@@ -2,31 +2,40 @@
 
 Parity: the reference's flash-attn integration (phi flash_attn kernels
 wrapping libflashattn.so CUDA kernels, paddle/phi/kernels/gpu/
-flash_attn_kernel.cu). This is the TPU-native equivalent: online-softmax
-tiling in VMEM, fp32 running statistics, never materializing the
-[sq, sk] score matrix in HBM.
+flash_attn_kernel.cu, incl. the flash_attn_varlen entry point). This is
+the TPU-native equivalent: online-softmax tiling in VMEM, fp32 running
+statistics, never materializing the [sq, sk] score matrix in HBM.
 
 Design notes (per /opt/skills/guides/pallas_guide.md):
-  - grid = (batch*heads, q_blocks, k_blocks); k is the innermost
-    (sequential) dimension so the running max/denominator live in VMEM
-    scratch across k-steps.
-  - blocks are MXU-aligned (q_block × head_dim and k_block × head_dim,
-    head_dim 128-multiple); matmuls request fp32 accumulation via
-    preferred_element_type.
-  - causal masking skips fully-masked k-blocks via grid pruning in the
-    index map (block_skip) — with the mask applied inside the diagonal
-    blocks only.
-  - backward recomputes probabilities blockwise (flash-attn v2 style),
-    accumulating dq, dk, dv in fp32 VMEM scratch.
-
-GQA is handled by folding the q-heads-per-kv-head factor into the batch
-dimension outside the kernel.
+  - forward grid = (batch*kv_heads, q_per_kv, q_blocks, k_blocks); k is
+    the innermost (sequential) dimension so the running max/denominator
+    live in VMEM scratch across k-steps.
+  - GQA is native: q is viewed as [b*hk, rep, sq, d] and k/v as
+    [b*hk, sk, d]; the kv block index map ignores the rep dimension, so
+    kv is NEVER materialized rep times in HBM (no jnp.repeat).
+  - causal masking prunes fully-masked k-blocks: the kv index map clamps
+    the block index at the diagonal (a revisited block issues no DMA) and
+    the kernel body is skipped under pl.when, so causal runs ~half the
+    FLOPs and ~half the kv HBM traffic. The mask itself is applied only
+    in diagonal-straddling blocks.
+  - backward is two passes (flash-v2 style): a dq kernel with k innermost
+    accumulating dq in VMEM scratch, and a dk/dv kernel with (rep, q)
+    innermost accumulating dk/dv in VMEM scratch — no [bh, n_kb, sq, d]
+    HBM partials anywhere; every gradient's HBM footprint equals its
+    final size. The dk/dv pass also performs the GQA head-group reduction
+    in-register (sum over rep lands in the same scratch accumulator).
+  - varlen/packed sequences via segment ids (parity with
+    flash_attn_varlen): tokens attend only within equal segment id;
+    padding can be given a sentinel segment.
+  - blocks are MXU-aligned; all matmuls request fp32 accumulation via
+    preferred_element_type; per-row stats are carried lane-broadcast
+    ([q_block, 128]) to keep Mosaic layouts trivial.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +45,7 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_Q_BLOCK = 256
 DEFAULT_K_BLOCK = 256
 NEG_INF = -1e30
+LANES = 128
 
 
 def _interpret() -> bool:
@@ -43,298 +53,541 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
-                *, sm_scale, causal, q_block, k_block, k_seq_len):
-    kb = pl.program_id(2)
-    qb = pl.program_id(1)
+def _params(*parallel_then_arbitrary: str):
+    return pltpu.CompilerParams(dimension_semantics=parallel_then_arbitrary)
 
-    @pl.when(kb == 0)
+
+def _causal_j_max(i: int, q_block: int, k_block: int):
+    """Last kv block index with any unmasked element for q block i."""
+    return ((i + 1) * q_block - 1) // k_block
+
+
+def _causal_i_min(j: int, q_block: int, k_block: int):
+    """First q block index with any unmasked element for kv block j."""
+    return (j * k_block) // q_block
+
+
+def _block_mask(s, qb_idx, kb_idx, q_block, k_block, causal, q_seg, k_seg):
+    """Apply causal/segment masking to a [q_block, k_block] score tile.
+
+    Only called where it can matter: causal masking only on
+    diagonal-straddling blocks (callers prune/skip fully-masked blocks).
+    """
+    mask = None
+    if causal:
+        q_pos = qb_idx * q_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 0
+        )
+        k_pos = kb_idx * k_block + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, k_block), 1
+        )
+        mask = q_pos >= k_pos
+    if q_seg is not None:
+        seg = q_seg == k_seg  # [q_block, 1] == [1, k_block] -> broadcast
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
+                with_lse, with_segments):
+    if with_segments:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, *out_refs = refs
+    else:
+        q_ref, k_ref, v_ref, *out_refs = refs
+        qseg_ref = kseg_ref = None
+    if with_lse:
+        o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = out_refs
+    else:
+        o_ref, m_scratch, l_scratch, acc_scratch = out_refs
+        lse_ref = None
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
     def _init():
         m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    q = q_ref[0]  # [q_block, d]
-    k = k_ref[0]  # [k_block, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [q_block, k_block]
-    s = s * sm_scale
+    def _step():
+        q = q_ref[0, 0]  # [q_block, d]
+        k = k_ref[0]  # [k_block, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        k_seg = kseg_ref[...][:1, :] if kseg_ref is not None else None
+        if causal or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+
+        m_prev = m_scratch[:, :1]  # [q_block, 1]
+        l_prev = l_scratch[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [q_block, k_block] fp32
+        alpha = jnp.exp(m_prev - m_new)  # [q_block, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+        v = v_ref[0]  # [k_block, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    # pruned iterations (causal, fully above the diagonal) do no work; the
+    # kv index map clamps their block index so they issue no DMA either.
+    if causal:
+        pl.when(j <= _causal_j_max(i, q_block, k_block))(_step)
+    else:
+        _step()
+
+    @pl.when(j == n_kb - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_scratch[:, :1] + jnp.log(l)  # [q_block, 1]
+            lse_ref[0, 0] = jnp.broadcast_to(lse, (q_block, LANES))
+
+
+def _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
+                  return_lse=False):
+    """q: [g, rep, sq, d]; k, v: [g, sk, d]; g = batch * kv_heads.
+
+    qseg: [g, sq, LANES] int32 or None; kseg: [g, sk] int32 or None.
+    """
+    g, rep, sq, d = q.shape
+    sk = k.shape[1]
+    n_qb = sq // q_block
+    n_kb = sk // k_block
+
+    grid = (g, rep, n_qb, n_kb)
+
+    def kv_index(b, r, i, j):
+        if causal:
+            j = jnp.minimum(j, _causal_j_max(i, q_block, k_block))
+        return (b, j, 0)
+
+    q_spec = pl.BlockSpec((1, 1, q_block, d), lambda b, r, i, j: (b, r, i, 0))
+    k_spec = pl.BlockSpec((1, k_block, d), kv_index)
+    o_spec = q_spec
+    in_specs = [q_spec, k_spec, k_spec]
+    inputs = [q, k, v]
+    if qseg is not None:
+        in_specs.append(pl.BlockSpec((1, q_block, LANES),
+                                     lambda b, r, i, j: (b, i, 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, k_block),
+            (lambda b, r, i, j: (b, kv_index(b, r, i, j)[1]))))
+        inputs += [qseg, kseg]
+    scratch = [
+        pltpu.VMEM((q_block, LANES), jnp.float32),
+        pltpu.VMEM((q_block, LANES), jnp.float32),
+        pltpu.VMEM((q_block, d), jnp.float32),
+    ]
+    flops = 4 * g * rep * sq * sk * d // (2 if causal else 1)
+    cost = pl.CostEstimate(
+        flops=flops,
+        bytes_accessed=(q.size + 2 * g * sk * d + q.size) * 2,
+        transcendentals=g * rep * sq * sk // (2 if causal else 1),
+    )
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, q_block=q_block,
+        k_block=k_block, n_kb=n_kb, with_lse=return_lse,
+        with_segments=qseg is not None,
+    )
+    params = _params("parallel", "parallel", "parallel", "arbitrary")
+    if not return_lse:
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct((g, rep, sq, d), q.dtype),
+            scratch_shapes=scratch,
+            cost_estimate=cost,
+            compiler_params=params,
+            interpret=_interpret(),
+        )(*inputs)
+    lse_spec = pl.BlockSpec((1, 1, q_block, LANES),
+                            lambda b, r, i, j: (b, r, i, 0))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(o_spec, lse_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((g, rep, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((g, rep, sq, LANES), jnp.float32),
+        ),
+        scratch_shapes=scratch,
+        cost_estimate=cost,
+        compiler_params=params,
+        interpret=_interpret(),
+    )(*inputs)
+    return o, lse[:, :, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dq pass (grid k-innermost, dq accumulates in VMEM scratch)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
+                   with_segments):
+    if with_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dq_ref, dq_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_scratch) = refs
+        qseg_ref = kseg_ref = None
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        k_seg = kseg_ref[...][:1, :] if kseg_ref is not None else None
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     if causal:
-        q_pos = qb * q_block + jax.lax.broadcasted_iota(
-            jnp.int32, (q_block, k_block), 0
-        )
-        k_pos = kb * k_block + jax.lax.broadcasted_iota(
-            jnp.int32, (q_block, k_block), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        pl.when(j <= _causal_j_max(i, q_block, k_block))(_step)
+    else:
+        _step()
 
-    m_prev = m_scratch[:]  # [q_block, 1]
-    l_prev = l_scratch[:]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)  # [q_block, k_block] fp32
-    alpha = jnp.exp(m_prev - m_new)  # [q_block, 1]
-    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-
-    v = v_ref[0]  # [k_block, d]
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_scratch[:] = acc_scratch[:] * alpha + pv
-    m_scratch[:] = m_new
-    l_scratch[:] = l_new
-
-    @pl.when(kb == pl.num_programs(2) - 1)
-    def _finalize():
-        l = l_scratch[:]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+    @pl.when(j == n_kb - 1)
+    def _fin():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
-                    acc_scratch, *, sm_scale, causal, q_block, k_block,
-                    k_seq_len):
-    """Same as _fwd_kernel but also writes logsumexp (for the backward).
+# ---------------------------------------------------------------------------
+# backward: dk/dv pass (grid (rep, q)-innermost, dk/dv accumulate in VMEM;
+# the GQA group-sum over rep happens in the same accumulator)
+# ---------------------------------------------------------------------------
+def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
+                    with_segments):
+    if with_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_scratch, dv_scratch) = refs
+        qseg_ref = kseg_ref = None
 
-    lse is stored lane-broadcast as [.., q_block, 128] — TPU block shapes
-    need a 128-multiple minor dim (cf. jax's reference TPU flash attn).
-    """
-    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
-                sm_scale=sm_scale, causal=causal, q_block=q_block,
-                k_block=k_block, k_seq_len=k_seq_len)
-    kb = pl.program_id(2)
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    i = pl.program_id(3)
 
-    @pl.when(kb == pl.num_programs(2) - 1)
-    def _():
-        l = l_scratch[:]
-        l = jnp.where(l == 0.0, 1.0, l)
-        lse = m_scratch[:] + jnp.log(l)  # [q_block, 1]
-        lse_ref[0] = jnp.broadcast_to(lse, (q_block, 128))
-
-
-def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, delta_ref,
-                dq_ref, dk_ref, dv_ref, dk_scratch, dv_scratch,
-                *, sm_scale, causal, q_block, k_block):
-    """Grid: (bh, k_blocks, q_blocks) — q innermost so dk/dv accumulate in
-    scratch; dq is accumulated into HBM via atomicity of one-q-block-per-
-    (qb,kb) pass using input_output_alias (dq_ref starts zeroed)."""
-    qb = pl.program_id(2)
-    kb = pl.program_id(1)
-
-    @pl.when(qb == 0)
+    @pl.when(jnp.logical_and(r == 0, i == 0))
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]  # lane-broadcast [q_block, 128] → [q_block, 1]
-    delta = delta_ref[0][:, :1]
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        k_seg = kseg_ref[...][:1, :] if kseg_ref is not None else None
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * sm_scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+        p = jnp.exp(s - lse)  # [q_block, k_block]
+        # dv += p^T do
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T q
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
     if causal:
-        q_pos = qb * q_block + jax.lax.broadcasted_iota(
-            jnp.int32, (q_block, k_block), 0
-        )
-        k_pos = kb * k_block + jax.lax.broadcasted_iota(
-            jnp.int32, (q_block, k_block), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse)  # [q_block, k_block]
+        pl.when(i >= _causal_i_min(j, q_block, k_block))(_step)
+    else:
+        _step()
 
-    # dv += p^T do
-    dv_scratch[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    # dp = do @ v^T
-    dp = jax.lax.dot_general(
-        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta) * sm_scale  # [q_block, k_block]
-    # dk += ds^T q
-    dk_scratch[:] += jax.lax.dot_general(
-        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    # dq partial for this (qb, kb): grid order is (bh, kb, qb) with qb
-    # innermost, so dq cannot accumulate across kb in scratch — partials
-    # land in distinct kb slices and are summed outside (_mha_bwd_impl)
-    dqb = jax.lax.dot_general(
-        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dq_ref[0, 0] = dqb.astype(dq_ref.dtype)
-
-    @pl.when(qb == pl.num_programs(2) - 1)
+    @pl.when(jnp.logical_and(r == rep - 1, i == n_qb - 1))
     def _fin():
         dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _pad_to(x, multiple, axis):
-    size = x.shape[axis]
-    rem = size % multiple
-    if rem == 0:
-        return x, size
-    pad = multiple - rem
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths), size
-
-
-def _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block,
-                  return_lse=False):
-    """q,k,v: [bh, s, d] (heads folded into batch)."""
-    bh, sq, d = q.shape
+def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
+                  q_block, k_block, dlse=None):
+    g, rep, sq, d = q.shape
     sk = k.shape[1]
-    n_qb = pl.cdiv(sq, q_block)
-    n_kb = pl.cdiv(sk, k_block)
-
-    grid = (bh, n_qb, n_kb)
-    q_spec = pl.BlockSpec((1, q_block, d), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, k_block, d), lambda b, i, j: (b, j, 0))
-    v_spec = pl.BlockSpec((1, k_block, d), lambda b, i, j: (b, j, 0))
-    o_spec = pl.BlockSpec((1, q_block, d), lambda b, i, j: (b, i, 0))
-    scratch = [
-        pltpu.VMEM((q_block, 1), jnp.float32),
-        pltpu.VMEM((q_block, 1), jnp.float32),
-        pltpu.VMEM((q_block, d), jnp.float32),
-    ]
-    cost = pl.CostEstimate(
-        flops=4 * bh * sq * sk * d,
-        bytes_accessed=2 * bh * (sq + sk) * d * 2,
-        transcendentals=bh * sq * sk,
-    )
-    if not return_lse:
-        kernel = functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal,
-            q_block=q_block, k_block=k_block, k_seq_len=sk,
-        )
-        return pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[q_spec, k_spec, v_spec],
-            out_specs=o_spec,
-            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            scratch_shapes=scratch,
-            cost_estimate=cost,
-            interpret=_interpret(),
-        )(q, k, v)
-    kernel = functools.partial(
-        _fwd_lse_kernel, sm_scale=sm_scale, causal=causal,
-        q_block=q_block, k_block=k_block, k_seq_len=sk,
-    )
-    lse_spec = pl.BlockSpec((1, q_block, 128), lambda b, i, j: (b, i, 0))
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[q_spec, k_spec, v_spec],
-        out_specs=(o_spec, lse_spec),
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
-        ),
-        scratch_shapes=scratch,
-        cost_estimate=cost,
-        interpret=_interpret(),
-    )(q, k, v)
-    return o, lse[:, :, 0]
-
-
-def _mha_bwd_impl(q, k, v, o, do, lse, sm_scale, causal, q_block, k_block):
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    n_qb = pl.cdiv(sq, q_block)
-    n_kb = pl.cdiv(sk, k_block)
+    n_qb = sq // q_block
+    n_kb = sk // k_block
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse cotangent folds into delta: ds = p*(dp - (delta - dlse))
+        delta = delta - dlse.astype(jnp.float32)
     # lane-broadcast the per-row vectors to a 128 minor dim (TPU tiling)
-    lse = jnp.broadcast_to(lse[:, :, None], (bh, sq, 128))
-    delta = jnp.broadcast_to(delta[:, :, None], (bh, sq, 128))
+    lse_b = jnp.broadcast_to(lse[..., None], (g, rep, sq, LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (g, rep, sq, LANES))
 
-    grid = (bh, n_kb, n_qb)
-    q_spec = pl.BlockSpec((1, q_block, d), lambda b, j, i: (b, i, 0))
-    k_spec = pl.BlockSpec((1, k_block, d), lambda b, j, i: (b, j, 0))
-    o_spec = q_spec
-    lse_spec = pl.BlockSpec((1, q_block, 128), lambda b, j, i: (b, i, 0))
-    # dq partials: one [q_block, d] slice per (kb) step → [bh, n_kb, sq, d]
-    dq_spec = pl.BlockSpec((1, 1, q_block, d), lambda b, j, i: (b, j, i, 0))
-    dk_spec = pl.BlockSpec((1, k_block, d), lambda b, j, i: (b, j, 0))
+    q_spec = pl.BlockSpec((1, 1, q_block, d), lambda b, r, i, j: (b, r, i, 0))
+    row_spec = pl.BlockSpec((1, 1, q_block, LANES),
+                            lambda b, r, i, j: (b, r, i, 0))
 
-    kernel = functools.partial(
-        _bwd_kernel, sm_scale=sm_scale, causal=causal,
-        q_block=q_block, k_block=k_block,
-    )
-    dq_part, dk, dv = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[q_spec, k_spec, k_spec, o_spec, o_spec, lse_spec, lse_spec],
-        out_specs=(dq_spec, dk_spec, dk_spec),
+    def kv_index(b, r, i, j):
+        if causal:
+            j = jnp.minimum(j, _causal_j_max(i, q_block, k_block))
+        return (b, j, 0)
+
+    k_spec = pl.BlockSpec((1, k_block, d), kv_index)
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    inputs = [q, k, v, do, lse_b, delta_b]
+    if qseg is not None:
+        in_specs.append(pl.BlockSpec((1, q_block, LANES),
+                                     lambda b, r, i, j: (b, i, 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, k_block), lambda b, r, i, j: (b, kv_index(b, r, i, j)[1])))
+        inputs += [qseg, kseg]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            q_block=q_block, k_block=k_block, n_kb=n_kb,
+            with_segments=qseg is not None,
+        ),
+        grid=(g, rep, n_qb, n_kb),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((g, rep, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((q_block, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=6 * g * rep * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=4 * g * rep * sq * d * 2 + 2 * g * sk * d * 2,
+            transcendentals=g * rep * sq * sk // (2 if causal else 1),
+        ),
+        compiler_params=_params("parallel", "parallel", "parallel",
+                                "arbitrary"),
+        interpret=_interpret(),
+    )(*inputs)
+
+    # dk/dv pass: grid reordered (g, kb, rep, qb)
+    def q_index2(b, j, r, i):
+        if causal:
+            i = jnp.maximum(i, _causal_i_min(j, q_block, k_block))
+        return (b, r, i, 0)
+
+    q_spec2 = pl.BlockSpec((1, 1, q_block, d), q_index2)
+    row_spec2 = pl.BlockSpec(
+        (1, 1, q_block, LANES),
+        lambda b, j, r, i: q_index2(b, j, r, i))
+    kv_spec2 = pl.BlockSpec((1, k_block, d), lambda b, j, r, i: (b, j, 0))
+    in_specs2 = [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
+    if qseg is not None:
+        in_specs2.append(pl.BlockSpec(
+            (1, q_block, LANES),
+            lambda b, j, r, i: (b, q_index2(b, j, r, i)[2], 0)))
+        in_specs2.append(pl.BlockSpec((1, k_block),
+                                      lambda b, j, r, i: (b, j)))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            q_block=q_block, k_block=k_block, n_qb=n_qb, rep=rep,
+            with_segments=qseg is not None,
+        ),
+        grid=(g, n_kb, rep, n_qb),
+        in_specs=in_specs2,
+        out_specs=(kv_spec2, kv_spec2),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, n_kb, sq, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sk, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((g, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((g, sk, d), q.dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((k_block, d), jnp.float32),
             pltpu.VMEM((k_block, d), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=10 * bh * sq * sk * d,
-            bytes_accessed=4 * bh * (sq + sk) * d * 2,
-            transcendentals=bh * sq * sk,
+            flops=8 * g * rep * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=4 * g * rep * sq * d * 2 + 2 * g * sk * d * 2,
+            transcendentals=g * rep * sq * sk // (2 if causal else 1),
         ),
+        compiler_params=_params("parallel", "parallel", "arbitrary",
+                                "arbitrary"),
         interpret=_interpret(),
-    )(q, k, v, o, do, lse, delta)
-    dq = jnp.sum(dq_part, axis=1).astype(q.dtype)
+    )(*inputs)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _mha_folded(q, k, v, sm_scale, causal, q_block, k_block):
-    return _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block)
+# ---------------------------------------------------------------------------
+# custom VJP over the folded [g, rep, s, d] layout
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _mha_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+    return _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
+                         k_block)
 
 
-def _mha_folded_fwd(q, k, v, sm_scale, causal, q_block, k_block):
-    o, lse = _mha_fwd_impl(q, k, v, sm_scale, causal, q_block, k_block,
-                           return_lse=True)
-    return o, (q, k, v, o, lse)
+def _mha_folded_fwd(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+    o, lse = _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
+                           k_block, return_lse=True)
+    return o, (q, k, v, o, lse, qseg, kseg)
 
 
 def _mha_folded_bwd(sm_scale, causal, q_block, k_block, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, sm_scale, causal,
-                               q_block, k_block)
-    return dq, dk, dv
+    q, k, v, o, lse, qseg, kseg = res
+    dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale,
+                               causal, q_block, k_block)
+    return dq, dk, dv, None, None
 
 
 _mha_folded.defvjp(_mha_folded_fwd, _mha_folded_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _mha_lse_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+    """Like _mha_folded but also returns logsumexp — the merge statistic
+    ring/context-parallel attention needs to combine per-block results."""
+    return _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
+                         k_block, return_lse=True)
+
+
+def _mha_lse_folded_fwd(q, k, v, qseg, kseg, sm_scale, causal, q_block,
+                        k_block):
+    o, lse = _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
+                           k_block, return_lse=True)
+    return (o, lse), (q, k, v, o, lse, qseg, kseg)
+
+
+def _mha_lse_folded_bwd(sm_scale, causal, q_block, k_block, res, cts):
+    q, k, v, o, lse, qseg, kseg = res
+    do, dlse = cts
+    dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale,
+                               causal, q_block, k_block, dlse=dlse)
+    return dq, dk, dv, None, None
+
+
+_mha_lse_folded.defvjp(_mha_lse_folded_fwd, _mha_lse_folded_bwd)
+
+
+SegmentIds = Tuple[jax.Array, jax.Array]
+
+
+def _fold(q, k, v, segment_ids, q_block, k_block):
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hq % hk:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hk}")
+    rep = hq // hk
+    # choose blocks that tile the sequence exactly: prefer the requested
+    # block, else fall back to 128 (any 128-multiple seq len divides)
+    qb = min(q_block, sq)
+    if sq % qb:
+        qb = 128
+    kb = min(k_block, sk)
+    if sk % kb:
+        kb = 128
+    if sq % qb or sk % kb:
+        raise ValueError(
+            f"seq lens ({sq}, {sk}) must be multiples of 128")
+
+    # [b, s, h, d] -> q: [b*hk, rep, sq, d]; kv: [b*hk, sk, d]
+    qf = q.transpose(0, 2, 1, 3).reshape(b, hk, rep, sq, d)
+    qf = qf.reshape(b * hk, rep, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+
+    qseg = kseg = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_ids, kv_ids = segment_ids
+        else:
+            q_ids = kv_ids = segment_ids
+        q_ids = jnp.asarray(q_ids, jnp.int32)
+        kv_ids = jnp.asarray(kv_ids, jnp.int32)
+        # replicate per kv-head group: [b, s] -> [b*hk, ...]
+        qseg = jnp.broadcast_to(q_ids[:, None, :, None],
+                                (b, hk, sq, LANES)).reshape(b * hk, sq, LANES)
+        kseg = jnp.broadcast_to(kv_ids[:, None, :],
+                                (b, hk, sk)).reshape(b * hk, sk)
+    return qf, kf, vf, qseg, kseg, qb, kb
+
+
 def mha(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
-        q_block: int = DEFAULT_Q_BLOCK, k_block: int = DEFAULT_K_BLOCK):
-    """Flash attention. Layout [batch, seq, heads, head_dim]; supports GQA
-    by repeating kv heads (grouped into the folded batch dim)."""
+        q_block: int = DEFAULT_Q_BLOCK, k_block: int = DEFAULT_K_BLOCK,
+        segment_ids: Optional[Union[jax.Array, SegmentIds]] = None):
+    """Flash attention over [batch, seq, heads, head_dim].
+
+    GQA (kv_heads < q_heads) is handled inside the kernel's index maps —
+    kv is never replicated in HBM. ``segment_ids`` enables varlen/packed
+    attention (parity: flash_attn_varlen): either one [b, s] int array
+    (self-attention) or a (q_ids [b, sq], kv_ids [b, sk]) pair; tokens
+    attend only where ids match.
+    """
     b, sq, hq, d = q.shape
     hk = k.shape[2]
     sm_scale = sm_scale if sm_scale is not None else d ** -0.5
-    if hq != hk:
-        rep = hq // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    # fold heads into batch: [b, s, h, d] -> [b*h, s, d]
-    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
-    sk = kf.shape[1]
-    qb = min(q_block, sq)
-    kb = min(k_block, sk)
-    of = _mha_folded(qf, kf, vf, sm_scale, causal, qb, kb)
+    qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
+                                           q_block, k_block)
+    of = _mha_folded(qf, kf, vf, qseg, kseg, sm_scale, causal, qb, kb)
     return of.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+def mha_with_lse(q, k, v, causal: bool = False,
+                 sm_scale: Optional[float] = None,
+                 q_block: int = DEFAULT_Q_BLOCK,
+                 k_block: int = DEFAULT_K_BLOCK,
+                 segment_ids: Optional[Union[jax.Array, SegmentIds]] = None):
+    """Flash attention that also returns logsumexp [b, heads, sq] — the
+    statistic ring/context-parallel callers need to merge per-block
+    partial results (fully differentiable, incl. the lse output)."""
+    b, sq, hq, d = q.shape
+    sm_scale = sm_scale if sm_scale is not None else d ** -0.5
+    qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
+                                           q_block, k_block)
+    of, lse = _mha_lse_folded(qf, kf, vf, qseg, kseg, sm_scale, causal,
+                              qb, kb)
+    o = of.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, hq, sq)

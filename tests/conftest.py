@@ -19,6 +19,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Deregister the axon (remote TPU tunnel) PJRT plugin if the sandbox's
+# sitecustomize installed it: jax initializes every registered plugin on
+# first backend use regardless of JAX_PLATFORMS, and a down/flaky tunnel
+# then hangs the entire test run inside PJRT init.
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _reg in ("_backend_factories", "backend_factories"):
+        _d = getattr(_xb, _reg, None)
+        if isinstance(_d, dict):
+            _d.pop("axon", None)
+except Exception:
+    pass
 # numerics tests compare against float64/float32 numpy references; pin
 # matmul precision (prod default stays bf16-on-MXU, the TPU analog of the
 # reference's TF32-on-A100 default)
