@@ -1,34 +1,47 @@
-"""Distributed sharded checkpoint with cross-topology reshard-on-load.
+"""Distributed sharded checkpoint: atomic, async, cross-topology reshard.
 
 Parity: python/paddle/distributed/checkpoint/save_state_dict.py /
 load_state_dict.py — each rank writes its local shards plus a global
 metadata file recording distribution info; load reassembles slices for a
-*different* topology (SURVEY.md §5 "Checkpoint / resume").
+*different* topology (SURVEY.md §5 "Checkpoint / resume"). The TPU-world
+equivalent of the async save path is orbax/tensorstore-style: snapshot
+device→host synchronously (cheap, bounded by HBM→host bandwidth), then
+write to disk on a background thread while training continues.
 
-TPU-native layout: one directory per checkpoint;
-  metadata.json                 — {name: {shape, dtype, chunks:[{offset,
-                                   shape, file}]}}
-  chunk files (.npy)            — unique shard payloads (replicas deduped
-                                   by offset key)
+Layout: one directory per checkpoint;
+  metadata.json        — {name: {shape, dtype, chunks:[{offset, shape,
+                          file}]}}
+  chunk files (.npy)   — unique shard payloads (one writer per chunk:
+                          the process holding replica 0)
+  COMMITTED            — marker written last; its presence means the
+                          directory is complete and uncorrupted.
+
+Atomicity: all writers target ``<path>.tmp``; after a cross-process
+barrier, rank 0 merges metadata, writes the COMMITTED marker, and
+atomically swaps the tmp dir into place (rename old → ``.old``, tmp →
+final, delete old). A crash at any point leaves either the previous
+intact checkpoint at ``path`` or nothing — never a torn directory that
+load would half-read.
+
 Load path: ``jax.make_array_from_callback`` asks for exactly the slice
 each target device needs; the reader assembles it from overlapping saved
 chunks — resharding from any source topology to any target topology
 without ever materializing full tensors on one host (chunks are read via
 np.load mmap).
-
-Multi-host: each process writes only shards it owns (addressable) whose
-first-replica device belongs to it; rank 0 merges metadata (single-host
-dev boxes write everything directly).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import threading
 from typing import Dict, Optional
 
 import jax
 import numpy as np
+
+COMMITTED_MARKER = "COMMITTED"
 
 
 def _chunk_filename(name: str, offset) -> str:
@@ -37,64 +50,204 @@ def _chunk_filename(name: str, offset) -> str:
     return f"{safe}__{off}.npy"
 
 
-def save_state_dict(state_dict: Dict[str, jax.Array], path: str) -> None:
-    """Save a flat {name: jax.Array} dict (values may be sharded global
-    arrays)."""
-    os.makedirs(path, exist_ok=True)
-    meta = {}
-    pid = jax.process_index()
+def _barrier(tag: str) -> None:
+    """Cross-process barrier. No-op single-process; on multi-host uses the
+    jax coordination service (the same store launch/elastic rendezvous
+    with)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"paddle_tpu.ckpt.{tag}")
+
+
+def _snapshot_to_host(state_dict: Dict[str, jax.Array]):
+    """Device→host copy of every locally-owned unique chunk.
+
+    Returns {name: (shape, dtype_str, [(offset, np.ndarray)])}. This is
+    the only part of an async save that blocks training: once it returns,
+    the training step may mutate/donate the arrays freely.
+    """
+    snap = {}
     for name, arr in state_dict.items():
         arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
-        entry = {
-            "shape": list(arr.shape),
-            "dtype": str(arr.dtype),
-            "chunks": [],
-        }
+        chunks = []
         seen_offsets = set()
         for shard in arr.addressable_shards:
-            idx = shard.index  # tuple of slices into the global shape
-            offset = tuple(
-                (s.start or 0) for s in idx
-            ) if arr.ndim else ()
-            if offset in seen_offsets:
-                continue  # replica of a chunk we already wrote
-            seen_offsets.add(offset)
-            # in multi-host, only the process owning the first replica of
-            # this chunk writes it
+            # Only the process holding replica 0 of a chunk writes it —
+            # this skip must happen BEFORE the offset dedup, otherwise a
+            # non-zero replica enumerating first poisons seen_offsets and
+            # the real writer's chunk is silently dropped.
             if shard.replica_id != 0:
                 continue
+            idx = shard.index  # tuple of slices into the global shape
+            offset = tuple((s.start or 0) for s in idx) if arr.ndim else ()
+            if offset in seen_offsets:
+                continue
+            seen_offsets.add(offset)
+            chunks.append((offset, np.asarray(shard.data)))
+        snap[name] = (list(arr.shape), str(arr.dtype), chunks)
+    return snap
+
+
+def _write_snapshot(snap, tmp_path: str) -> None:
+    """Disk phase of a save: write chunk files + this process's metadata
+    part into the (already-created) tmp dir."""
+    meta = {}
+    pid = jax.process_index()
+    for name, (shape, dtype, chunks) in snap.items():
+        entry = {"shape": shape, "dtype": dtype, "chunks": []}
+        for offset, data in chunks:
             fname = _chunk_filename(name, offset)
-            data = np.asarray(shard.data)
             if str(data.dtype) == "bfloat16":
                 # numpy can't serialize ml_dtypes natively; store raw bits
                 data = data.view(np.uint16)
-            np.save(os.path.join(path, fname), data)
+            np.save(os.path.join(tmp_path, fname), data)
             entry["chunks"].append({
                 "offset": list(offset),
-                "shape": list(shard.data.shape),
+                "shape": list(data.shape),
                 "file": fname,
             })
         meta[name] = entry
-    meta_file = os.path.join(path, f"metadata_{pid}.json")
-    with open(meta_file, "w") as f:
+    # temp-write + rename so a concurrent reader (the async commit poll
+    # counts metadata parts by listdir) never sees a partial file
+    part = os.path.join(tmp_path, f"metadata_{pid}.json")
+    with open(part + ".part", "w") as f:
         json.dump(meta, f)
-    # merge per-process metadata (rank 0; trivially itself single-host)
-    if pid == 0:
-        merged: Dict = {}
-        for fn in sorted(os.listdir(path)):
-            if fn.startswith("metadata_") and fn.endswith(".json"):
-                with open(os.path.join(path, fn)) as f:
-                    part = json.load(f)
-                for k, v in part.items():
-                    if k not in merged:
-                        merged[k] = v
-                    else:
-                        have = {tuple(c["offset"]) for c in merged[k]["chunks"]}
-                        for c in v["chunks"]:
-                            if tuple(c["offset"]) not in have:
-                                merged[k]["chunks"].append(c)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(merged, f, indent=1)
+    os.replace(part + ".part", part)
+
+
+def _merge_metadata(tmp_path: str) -> None:
+    merged: Dict = {}
+    for fn in sorted(os.listdir(tmp_path)):
+        if fn.startswith("metadata_") and fn.endswith(".json"):
+            with open(os.path.join(tmp_path, fn)) as f:
+                part = json.load(f)
+            for k, v in part.items():
+                if k not in merged:
+                    merged[k] = v
+                else:
+                    have = {tuple(c["offset"]) for c in merged[k]["chunks"]}
+                    for c in v["chunks"]:
+                        if tuple(c["offset"]) not in have:
+                            merged[k]["chunks"].append(c)
+    with open(os.path.join(tmp_path, "metadata.json"), "w") as f:
+        json.dump(merged, f, indent=1)
+
+
+def _commit(tmp_path: str, path: str) -> None:
+    """Marker + atomic swap. Runs on rank 0 only."""
+    with open(os.path.join(tmp_path, COMMITTED_MARKER), "w") as f:
+        f.write("1")
+    old = path + ".old"
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+    if os.path.isdir(path):
+        os.rename(path, old)
+    os.rename(tmp_path, path)
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+
+
+def save_state_dict(state_dict: Dict[str, jax.Array], path: str) -> None:
+    """Atomically save a flat {name: jax.Array} dict (values may be
+    sharded global arrays). Blocks until the checkpoint is committed."""
+    snap = _snapshot_to_host(state_dict)
+    tmp_path = path + ".tmp"
+    if jax.process_index() == 0:
+        if os.path.isdir(tmp_path):  # leftover from a crashed save
+            shutil.rmtree(tmp_path)
+        os.makedirs(tmp_path, exist_ok=True)
+    _barrier("tmpdir")
+    _write_snapshot(snap, tmp_path)
+    _barrier("written")
+    if jax.process_index() == 0:
+        _merge_metadata(tmp_path)
+        _commit(tmp_path, path)
+    _barrier("committed")
+
+
+class AsyncCheckpointer:
+    """Orbax-style async saver: ``save()`` blocks only for the
+    device→host snapshot, then the serialize+commit runs on a background
+    thread. At most one save is in flight; a new ``save`` waits for the
+    previous one (so checkpoints can never commit out of order).
+
+    Usage::
+
+        saver = AsyncCheckpointer()
+        saver.save(state, "/ckpt/step_100")   # returns immediately
+        ... keep training ...
+        saver.wait_until_finished()           # before exit / next save
+    """
+
+    def __init__(self, commit_timeout: float = 600.0):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.commit_timeout = commit_timeout
+
+    def save(self, state_dict: Dict[str, jax.Array], path: str) -> None:
+        self.wait_until_finished()
+        snap = _snapshot_to_host(state_dict)  # the only blocking part
+        tmp_path = path + ".tmp"
+        if jax.process_index() == 0:
+            if os.path.isdir(tmp_path):
+                shutil.rmtree(tmp_path)
+            os.makedirs(tmp_path, exist_ok=True)
+        _barrier("async.tmpdir")
+
+        def _worker():
+            try:
+                _write_snapshot(snap, tmp_path)
+                # NOTE: no cross-process barrier inside the worker thread
+                # (the coordination service is not thread-safe to call
+                # concurrently with the training step's collectives).
+                # Multi-host async commit instead counts metadata parts:
+                # rank 0 commits once all N parts exist.
+                if jax.process_index() == 0:
+                    import time
+
+                    want = jax.process_count()
+                    deadline = time.monotonic() + self.commit_timeout
+                    while True:
+                        have = len([
+                            fn for fn in os.listdir(tmp_path)
+                            if fn.startswith("metadata_")
+                            and fn.endswith(".json")
+                        ])
+                        if have >= want:
+                            break
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"async checkpoint commit: only {have}/"
+                                f"{want} ranks wrote metadata within "
+                                f"{self.commit_timeout}s (peer died "
+                                f"mid-save?); leaving {tmp_path} "
+                                f"uncommitted")
+                        time.sleep(0.05)
+                    _merge_metadata(tmp_path)
+                    _commit(tmp_path, path)
+            except BaseException as e:  # surfaced on next wait/save
+                self._error = e
+
+        self._thread = threading.Thread(target=_worker, daemon=True)
+        self._thread.start()
+
+    def wait_until_finished(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+def is_committed(path: str) -> bool:
+    """True iff ``path`` is a complete, uncorrupted checkpoint dir."""
+    return os.path.isfile(os.path.join(path, COMMITTED_MARKER)) or (
+        # pre-marker checkpoints (round ≤2 layout) are considered
+        # committed when merged metadata exists
+        os.path.isfile(os.path.join(path, "metadata.json"))
+    )
 
 
 class _ChunkReader:
@@ -154,6 +307,11 @@ def load_state_dict(
     """
     import jax.numpy as jnp
 
+    if not is_committed(path):
+        raise FileNotFoundError(
+            f"{path!r} is not a committed checkpoint (no "
+            f"{COMMITTED_MARKER} marker / metadata.json — crashed save?)"
+        )
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     out = {}

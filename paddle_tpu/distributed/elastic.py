@@ -26,11 +26,17 @@ from typing import Dict, List, Optional, Tuple
 
 @dataclass
 class WorldSpec:
-    """What a relaunch needs: the surviving membership, re-ranked."""
+    """What a relaunch needs: the surviving membership, re-ranked.
+
+    ``hosts`` carries actual hostnames (what a relaunch command / trainer
+    endpoint list needs); ``node_ids`` the registry keys that determined
+    the ranking (hostname_pid — unique even with several nodes per
+    host)."""
 
     nnodes: int
     node_rank: int
     hosts: List[str]
+    node_ids: List[str]
 
 
 def parse_np_range(np_arg: str) -> Tuple[int, int]:
@@ -155,12 +161,18 @@ class ElasticManager:
         alive, _ = self.scan()
         if not (self.min_np <= len(alive) <= self.max_np):
             return None
-        hosts = sorted(alive)
-        if self.node_id not in hosts:
+        node_ids = sorted(alive)
+        if self.node_id not in node_ids:
             return None
-        return WorldSpec(nnodes=len(hosts),
-                         node_rank=hosts.index(self.node_id),
-                         hosts=hosts)
+        registry = self.store.nodes()
+        hosts = [
+            registry.get(nid, {}).get("host", nid.rsplit("_", 1)[0])
+            for nid in node_ids
+        ]
+        return WorldSpec(nnodes=len(node_ids),
+                         node_rank=node_ids.index(self.node_id),
+                         hosts=hosts,
+                         node_ids=node_ids)
 
     def wait_for_world(self, timeout: float = 60.0,
                        poll: float = 0.5,
@@ -173,7 +185,7 @@ class ElasticManager:
         while time.time() < deadline:
             spec = self.plan()
             if spec is not None:
-                key = tuple(spec.hosts)
+                key = tuple(spec.node_ids)
                 if key != last:
                     last, stable_since = key, time.time()
                 if time.time() - stable_since >= settle:
@@ -187,16 +199,18 @@ class ElasticManager:
 def latest_checkpoint(ckpt_root: str, prefix: str = "step_"
                       ) -> Optional[str]:
     """Newest complete checkpoint dir (the resume point after an elastic
-    restart). A checkpoint counts only when its metadata file exists —
-    half-written saves from the killed incarnation are skipped."""
+    restart). A checkpoint counts only when it is committed (COMMITTED
+    marker / merged metadata) — torn ``.tmp`` dirs from the killed
+    incarnation are skipped."""
+    from .checkpoint import is_committed
+
     if not os.path.isdir(ckpt_root):
         return None
     best, best_step = None, -1
     for name in os.listdir(ckpt_root):
         if not name.startswith(prefix):
             continue
-        meta = os.path.join(ckpt_root, name, "metadata.json")
-        if not os.path.exists(meta):
+        if not is_committed(os.path.join(ckpt_root, name)):
             continue
         try:
             step = int(name[len(prefix):])

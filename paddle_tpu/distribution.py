@@ -194,8 +194,10 @@ class Gamma(Distribution):
 
     def log_prob(self, value):
         a, b = self.concentration, self.rate
-        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
-                - jax.scipy.special.gammaln(a))
+        v = jnp.where(value > 0, value, 1.0)  # avoid nan grads off-support
+        lp = (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+              - jax.scipy.special.gammaln(a))
+        return jnp.where(value > 0, lp, -jnp.inf)
 
     def entropy(self):
         a, b = self.concentration, self.rate
@@ -220,8 +222,11 @@ class Beta(Distribution):
 
     def log_prob(self, value):
         a, b = self.alpha, self.beta
-        return ((a - 1) * jnp.log(value) + (b - 1) * jnp.log1p(-value)
-                - _betaln(a, b))
+        inside = (value > 0) & (value < 1)
+        v = jnp.where(inside, value, 0.5)
+        lp = ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+              - _betaln(a, b))
+        return jnp.where(inside, lp, -jnp.inf)
 
     def entropy(self):
         a, b = self.alpha, self.beta
@@ -267,7 +272,9 @@ class LogNormal(Distribution):
         return jnp.exp(self.base.sample(shape))
 
     def log_prob(self, value):
-        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+        v = jnp.where(value > 0, value, 1.0)
+        lp = self.base.log_prob(jnp.log(v)) - jnp.log(v)
+        return jnp.where(value > 0, lp, -jnp.inf)
 
     def entropy(self):
         return self.base.entropy() + self.loc
@@ -311,8 +318,9 @@ class Poisson(Distribution):
         ).astype(jnp.float32)
 
     def log_prob(self, value):
-        return (value * jnp.log(self.rate) - self.rate
-                - jax.scipy.special.gammaln(value + 1.0))
+        lp = (value * jnp.log(self.rate) - self.rate
+              - jax.scipy.special.gammaln(value + 1.0))
+        return jnp.where(value >= 0, lp, -jnp.inf)
 
     @property
     def mean(self):

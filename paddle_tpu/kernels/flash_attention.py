@@ -43,7 +43,11 @@ def _use_pallas(q) -> bool:
     import os
 
     b, s, h, d = q.shape
-    aligned = s % 128 == 0 and d % 128 == 0
+    # seq must tile into 128-blocks; head_dim only needs sublane (8)
+    # alignment — the kernel zero-pads d to the lane width internally
+    # (exact; see pallas_attention._fold), so 64/96-dim heads (GPT/ViT)
+    # take the flash path instead of dense XLA attention.
+    aligned = s % 128 == 0 and d % 8 == 0
     if os.environ.get("PADDLE_TPU_FORCE_PALLAS"):
         # CI/dryrun override: run the Pallas kernel in interpret mode off
         # TPU so the graft entry exercises the real kernel code path

@@ -521,6 +521,18 @@ def _fold(q, k, v, segment_ids, q_block, k_block):
     if hq % hk:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hk}")
     rep = hq // hk
+    # Unaligned head_dim (64/96 in GPT/ViT configs): zero-pad to the lane
+    # width. Exact — padded dims contribute 0 to q·k scores and 0 to the
+    # padded output columns, which the caller slices off. sm_scale is
+    # computed from the TRUE d by the caller before padding. Cheaper than
+    # falling back to dense XLA attention, which materializes [sq, sk].
+    if d % LANES:
+        d_pad = ((d + LANES - 1) // LANES) * LANES
+        pad = [(0, 0)] * 3 + [(0, d_pad - d)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        d = d_pad
     # choose blocks that tile the sequence exactly: prefer the requested
     # block, else fall back to 128 (any 128-multiple seq len divides)
     qb = min(q_block, sq)
@@ -572,7 +584,8 @@ def mha(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
     qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
                                            q_block, k_block)
     of = _mha_folded(qf, kf, vf, qseg, kseg, sm_scale, causal, qb, kb)
-    return of.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    of = of.reshape(b, hq, sq, of.shape[-1]).transpose(0, 2, 1, 3)
+    return of[..., :d]  # drop lane padding for unaligned head_dim
 
 
 def mha_with_lse(q, k, v, causal: bool = False,
@@ -589,5 +602,5 @@ def mha_with_lse(q, k, v, causal: bool = False,
                                            q_block, k_block)
     of, lse = _mha_lse_folded(qf, kf, vf, qseg, kseg, sm_scale, causal,
                               qb, kb)
-    o = of.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
-    return o, lse.reshape(b, hq, sq)
+    o = of.reshape(b, hq, sq, of.shape[-1]).transpose(0, 2, 1, 3)
+    return o[..., :d], lse.reshape(b, hq, sq)

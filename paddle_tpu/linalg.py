@@ -21,8 +21,11 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
 
 
 def norm(x, p="fro", axis=None, keepdim=False):
-    return jnp.linalg.norm(_v(x), ord=p if p != "fro" else "fro",
-                           axis=axis, keepdims=keepdim)
+    """Paddle semantics (axis=None flattens any rank; int axis → vector
+    p-norm; tuple axis → matrix norm). Shares the tensor.norm impl."""
+    from . import tensor as _tensor
+
+    return _tensor.norm(_v(x), p=p, axis=axis, keepdim=keepdim)
 
 
 def inv(x):

@@ -23,6 +23,11 @@ from ...core.parameter import Parameter
 def _v(x):
     return x.value if isinstance(x, Parameter) else x
 
+def _f32up(x):
+    """Upcast to AT LEAST float32 for stable statistics — never downcast
+    (fp64 inputs, e.g. the OpTest finite-difference harness, stay fp64)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
 
 # ---------------------------------------------------------------------------
 # linear / embedding
@@ -128,7 +133,7 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
     x = _v(x)
     # compute statistics in fp32 for bf16 inputs (parity: phi layer_norm
     # kernel accumulates in float)
-    xf = x.astype(jnp.float32)
+    xf = _f32up(x)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mean) * lax.rsqrt(var + epsilon)
@@ -143,7 +148,7 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
 def rms_norm(x, weight=None, epsilon=1e-6):
     """Parity: phi fusion rms_norm kernel."""
     x = _v(x)
-    xf = x.astype(jnp.float32)
+    xf = _f32up(x)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
     if weight is not None:
@@ -158,7 +163,7 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format=
     n, c = x.shape[:2]
     spatial = x.shape[2:]
     g = num_groups
-    xf = x.astype(jnp.float32).reshape(n, g, c // g, *spatial)
+    xf = _f32up(x).reshape(n, g, c // g, *spatial)
     axes = tuple(range(2, xf.ndim))
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.var(xf, axis=axes, keepdims=True)
@@ -208,7 +213,7 @@ def cross_entropy(
     Computes in fp32 regardless of input dtype (matching the fused kernel's
     accumulation behavior).
     """
-    logits = _v(logits).astype(jnp.float32)
+    logits = _f32up(_v(logits))
     if axis not in (-1, logits.ndim - 1):
         # normalize to class-dim-last so gathers/one-hots line up
         logits = jnp.moveaxis(logits, axis, -1)
@@ -217,7 +222,7 @@ def cross_entropy(
         axis = -1
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
-        target = _v(label).astype(jnp.float32)
+        target = _v(label).astype(logits.dtype)
         loss = -jnp.sum(target * logp, axis=axis)
         valid = jnp.ones(loss.shape, jnp.float32)
     else:
@@ -273,7 +278,8 @@ def nll_loss(log_probs, label, reduction="mean", ignore_index=-100):
 
 
 def binary_cross_entropy_with_logits(logits, label, reduction="mean"):
-    logits, label = _v(logits).astype(jnp.float32), _v(label).astype(jnp.float32)
+    logits = _f32up(_v(logits))
+    label = _v(label).astype(logits.dtype)
     loss = jnp.maximum(logits, 0) - logits * label + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     if reduction == "none":
         return loss
@@ -310,7 +316,7 @@ def scaled_dot_product_attention(
     scale = scale if scale is not None else d ** -0.5
     # [b, h, sq, sk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = logits.astype(jnp.float32)
+    logits = _f32up(logits)
     if is_causal:
         sk = k.shape[1]
         causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -320,7 +326,7 @@ def scaled_dot_product_attention(
         if m.dtype == jnp.bool_:
             logits = jnp.where(m, logits, jnp.float32(-1e30))
         else:
-            logits = logits + m.astype(jnp.float32)
+            logits = logits + m.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
         probs = dropout(probs, dropout_p, training=True)

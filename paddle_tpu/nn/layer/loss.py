@@ -62,25 +62,44 @@ class BCEWithLogitsLoss(Layer):
         return F.binary_cross_entropy_with_logits(logit, label, self.reduction)
 
 
-class SmoothL1Loss(Layer):
-    """Huber with delta (parity: paddle.nn.SmoothL1Loss)."""
+class _PiecewiseL1(Layer):
+    """Shared quadratic-below-delta / linear-above-delta loss body.
+    ``quad_scale`` multiplies the 0.5·d² zone, ``lin_scale`` the linear
+    zone — the only place SmoothL1 and Huber differ."""
 
     def __init__(self, reduction="mean", delta=1.0):
         super().__init__()
         self.reduction = reduction
         self.delta = delta
 
+    def _scales(self):
+        raise NotImplementedError
+
     def forward(self, input, label):  # noqa: A002
         import jax.numpy as jnp
 
+        quad_scale, lin_scale = self._scales()
         d = jnp.abs(input - label)
         loss = jnp.where(d < self.delta,
-                         0.5 * d * d,
-                         self.delta * (d - 0.5 * self.delta))
+                         quad_scale * 0.5 * d * d,
+                         lin_scale * (d - 0.5 * self.delta))
         return _reduce(loss, self.reduction)
 
 
-HuberLoss = SmoothL1Loss
+class SmoothL1Loss(_PiecewiseL1):
+    """Parity: paddle.nn.SmoothL1Loss. Quadratic zone scaled by 1/delta:
+    0.5·d²/delta for d<delta, else d−0.5·delta. Coincides with Huber only
+    at delta=1 — the two classes are intentionally NOT aliases."""
+
+    def _scales(self):
+        return 1.0 / self.delta, 1.0
+
+
+class HuberLoss(_PiecewiseL1):
+    """Classic Huber: 0.5·d² for d<delta, else delta·(d−0.5·delta)."""
+
+    def _scales(self):
+        return 1.0, self.delta
 
 
 class KLDivLoss(Layer):

@@ -119,12 +119,77 @@ cumsum = _alias(jnp.cumsum)
 cumprod = _alias(jnp.cumprod)
 sort = _alias(jnp.sort)
 argsort = _alias(jnp.argsort)
-topk = _alias(jax.lax.top_k)
 gather = _alias(lambda x, index, axis=0: jnp.take(x, index, axis=axis))
 einsum = _alias(jnp.einsum)
 tril = _alias(jnp.tril)
 triu = _alias(jnp.triu)
-flatten = _alias(jnp.ravel)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    """Paddle semantics: (values, indices) along ``axis``; ``largest``
+    selects direction (jax.lax.top_k is last-axis/largest-only)."""
+    x = _v(x)
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    """Paddle semantics: collapse axes [start_axis, stop_axis] into one
+    (paddle.flatten(x, 1) is the canonical NCHW→NC call)."""
+    x = _v(x)
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    s = start_axis + nd if start_axis < 0 else start_axis
+    e = stop_axis + nd if stop_axis < 0 else stop_axis
+    if not (0 <= s <= e < nd):
+        raise ValueError(
+            f"flatten: invalid range start_axis={start_axis} "
+            f"stop_axis={stop_axis} for ndim={nd}")
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def gather_nd(x, index):
+    """index[..., :k] indexes the first k dims of x (paddle.gather_nd)."""
+    x, index = _v(x), _v(index)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite=True):
+    """paddle.scatter: write ``updates`` rows into x at 1-D ``index``."""
+    x, index, updates = _v(x), _v(index), _v(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle's overwrite=False accumulates (after zeroing target rows)
+    zeroed = x.at[index].set(0)
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    x, index, updates = _v(x), _v(index), _v(updates)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return x.at[idx].add(updates)
+
+
+def put_along_axis(x, indices, values, axis):
+    x = _v(x)
+    return x.at[
+        tuple(
+            _v(indices) if i == (axis % x.ndim) else
+            jnp.arange(x.shape[i]).reshape(
+                [-1 if j == i else 1 for j in range(x.ndim)])
+            for i in range(x.ndim)
+        )
+    ].set(_v(values))
 isnan = _alias(jnp.isnan)
 isinf = _alias(jnp.isinf)
 isfinite = _alias(jnp.isfinite)
@@ -142,7 +207,33 @@ log_softmax = _alias(jax.nn.log_softmax)
 softmax = _alias(jax.nn.softmax)
 var = _alias(jnp.var)
 std = _alias(jnp.std)
-norm = _alias(jnp.linalg.norm)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    """Paddle semantics: axis=None flattens (any rank) and computes a
+    vector norm; 'fro'≡p=2 elementwise. int axis → vector p-norm;
+    2-tuple axis → matrix norm (jnp.linalg.norm rejects ndim>2 with
+    axis=None, and its defaults differ — hence no alias)."""
+    x = _v(x)
+    if axis is None:
+        flat = jnp.ravel(x)
+        pp = 2.0 if p in ("fro", None) else p
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(flat))
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(flat))
+        out = jnp.sum(jnp.abs(flat) ** pp) ** (1.0 / pp)
+        return jnp.reshape(out, (1,) * x.ndim) if keepdim else out
+    if isinstance(axis, (tuple, list)):
+        ord_ = "fro" if p in ("fro", None) else p
+        return jnp.linalg.norm(x, ord=ord_, axis=tuple(axis),
+                               keepdims=keepdim)
+    pp = 2.0 if p in ("fro", None) else p
+    if pp == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if pp == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** pp, axis=axis, keepdims=keepdim) ** (1.0 / pp)
 dot = _alias(jnp.dot)
 outer = _alias(jnp.outer)
 roll = _alias(jnp.roll)

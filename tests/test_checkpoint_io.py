@@ -76,6 +76,90 @@ def test_paddle_save_load(tmp_path):
     np.testing.assert_allclose(np.asarray(loaded["nested"]["b"]), 0.0)
 
 
+def test_replicated_axis_dedup(tmp_path):
+    """Sharded over fsdp but replicated over tp: every offset has
+    replica_id 0..tp-1 shards. Exactly one chunk per offset must be
+    written, and load must reproduce the data regardless of which replica
+    enumerates first in addressable_shards (round-2 bug: a non-zero
+    replica seen first suppressed the real writer)."""
+    mesh = dist.build_mesh(fsdp=4, tp=2)
+    w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    w_s = jax.device_put(w, NamedSharding(mesh, P("fsdp", None)))
+    # sanity: there ARE non-zero replicas in this layout
+    assert any(s.replica_id != 0 for s in w_s.addressable_shards)
+    ckpt.save_state_dict({"w": w_s}, str(tmp_path / "rep"))
+    import json as _json
+
+    with open(tmp_path / "rep" / "metadata.json") as f:
+        meta = _json.load(f)
+    offsets = [tuple(c["offset"]) for c in meta["w"]["chunks"]]
+    assert sorted(offsets) == [(0, 0), (2, 0), (4, 0), (6, 0)]
+    assert len(set(offsets)) == len(offsets)
+    loaded = ckpt.load_state_dict(str(tmp_path / "rep"))
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.asarray(w))
+
+
+def test_crashed_save_preserves_previous(tmp_path, monkeypatch):
+    """A crash mid-save must leave the previous committed checkpoint
+    loadable — the torn write only ever touches <path>.tmp."""
+    path = str(tmp_path / "atom")
+    ckpt.save_state_dict({"w": jnp.ones((4, 4))}, path)
+
+    def boom(snap, tmp):
+        # simulate dying after some chunk files landed
+        with open(os.path.join(tmp, "partial.npy"), "wb") as f:
+            f.write(b"torn")
+        raise RuntimeError("simulated crash mid-save")
+
+    monkeypatch.setattr(ckpt, "_write_snapshot", boom)
+    with pytest.raises(RuntimeError):
+        ckpt.save_state_dict({"w": jnp.zeros((4, 4))}, path)
+    # previous checkpoint intact and committed
+    assert ckpt.is_committed(path)
+    loaded = ckpt.load_state_dict(path)
+    np.testing.assert_allclose(np.asarray(loaded["w"]), 1.0)
+    # and the torn tmp dir is not mistaken for a checkpoint
+    assert not ckpt.is_committed(path + ".tmp")
+
+
+def test_uncommitted_dir_rejected(tmp_path):
+    d = tmp_path / "torn"
+    d.mkdir()
+    (d / "w__0.npy").write_bytes(b"junk")
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_state_dict(str(d))
+
+
+def test_async_checkpointer(tmp_path):
+    saver = ckpt.AsyncCheckpointer()
+    state = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+    path = str(tmp_path / "async1")
+    saver.save(state, path)
+    saver.wait_until_finished()
+    assert ckpt.is_committed(path)
+    loaded = ckpt.load_state_dict(path)
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.asarray(state["w"]))
+    # back-to-back saves serialize correctly
+    p2 = str(tmp_path / "async2")
+    saver.save({"w": jnp.zeros((2,))}, p2)
+    saver.save({"w": jnp.ones((2,))}, str(tmp_path / "async3"))
+    saver.wait_until_finished()
+    assert ckpt.is_committed(p2)
+    assert ckpt.is_committed(str(tmp_path / "async3"))
+
+
+def test_async_checkpointer_surfaces_errors(tmp_path, monkeypatch):
+    saver = ckpt.AsyncCheckpointer()
+
+    def boom(snap, tmp):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt, "_write_snapshot", boom)
+    saver.save({"w": jnp.ones((2,))}, str(tmp_path / "err"))
+    with pytest.raises(OSError):
+        saver.wait_until_finished()
+
+
 # ---------------------------------------------------------------------------
 # io
 # ---------------------------------------------------------------------------

@@ -61,7 +61,11 @@ def test_fault_detection_and_eviction(tmp_path):
         assert a.evict_faulted() == ["nodeB"]
         # membership shrinks within np range → re-ranked single world
         spec = a.plan()
-        assert spec == WorldSpec(nnodes=1, node_rank=0, hosts=["nodeA"])
+        import socket
+
+        assert spec == WorldSpec(nnodes=1, node_rank=0,
+                                 hosts=[socket.gethostname()],
+                                 node_ids=["nodeA"])
     finally:
         a.deregister()
         b.deregister()

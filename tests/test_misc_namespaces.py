@@ -101,3 +101,85 @@ def test_fake_quant_ste_grad():
     g = jax.grad(lambda x: jnp.sum(fq(x) ** 2))(x)
     # straight-through: gradient ≈ 2x
     np.testing.assert_allclose(np.asarray(g), np.asarray(2 * x), atol=0.1)
+
+
+def test_paddle_flatten_semantics():
+    x = jnp.zeros((2, 3, 4, 5))
+    assert pt.flatten(x).shape == (120,)
+    assert pt.flatten(x, 1).shape == (2, 60)          # the canonical call
+    assert pt.flatten(x, 1, 2).shape == (2, 12, 5)
+    assert pt.flatten(x, -2, -1).shape == (2, 3, 20)
+    with pytest.raises(ValueError):
+        pt.flatten(x, 3, 1)
+
+
+def test_paddle_topk_semantics():
+    x = jnp.asarray([[3.0, 1.0, 4.0, 1.5], [2.0, 7.0, 1.0, 8.0]])
+    v, i = pt.topk(x, 2)
+    np.testing.assert_allclose(np.asarray(v), [[4.0, 3.0], [8.0, 7.0]])
+    np.testing.assert_array_equal(np.asarray(i), [[2, 0], [3, 1]])
+    v, i = pt.topk(x, 2, largest=False)
+    np.testing.assert_allclose(np.asarray(v), [[1.0, 1.5], [1.0, 2.0]])
+    v, i = pt.topk(x, 1, axis=0)
+    np.testing.assert_allclose(np.asarray(v), [[3.0, 7.0, 4.0, 8.0]])
+
+
+def test_paddle_norm_semantics():
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    # axis=None on ndim>2 flattens (jnp.linalg.norm would raise)
+    np.testing.assert_allclose(
+        float(pt.norm(x)), np.linalg.norm(np.asarray(x).ravel()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(pt.norm(x, p=1, axis=-1)),
+        np.abs(np.asarray(x)).sum(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(pt.norm(x, p=float("inf"))), 23.0)
+    np.testing.assert_allclose(
+        np.asarray(linalg.norm(x, axis=(1, 2))),
+        np.linalg.norm(np.asarray(x), axis=(1, 2)), rtol=1e-6)
+
+
+def test_gather_scatter_family():
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    np.testing.assert_allclose(
+        np.asarray(pt.gather(x, jnp.asarray([2, 0]))),
+        np.asarray(x)[[2, 0]])
+    idx = jnp.asarray([[0, 1], [3, 2]])
+    np.testing.assert_allclose(
+        np.asarray(pt.gather_nd(x, idx)), [1.0, 11.0])
+    upd = jnp.asarray([[9.0, 9.0, 9.0], [7.0, 7.0, 7.0]])
+    out = pt.scatter(x, jnp.asarray([1, 3]), upd)
+    np.testing.assert_allclose(np.asarray(out)[1], 9.0)
+    np.testing.assert_allclose(np.asarray(out)[3], 7.0)
+    np.testing.assert_allclose(np.asarray(out)[0], np.asarray(x)[0])
+    out = pt.scatter_nd_add(jnp.zeros((4, 3)), idx,
+                            jnp.asarray([1.0, 2.0]))
+    assert float(out[0, 1]) == 1.0 and float(out[3, 2]) == 2.0
+
+
+def test_huber_vs_smooth_l1_delta():
+    a = jnp.asarray(np.linspace(-4, 4, 33, dtype=np.float32))
+    b = jnp.zeros((33,))
+    d = np.abs(np.asarray(a))
+    delta = 2.0
+    sl = nn.SmoothL1Loss(delta=delta)(a, b)
+    ref_sl = np.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    np.testing.assert_allclose(float(sl), ref_sl.mean(), rtol=1e-5)
+    hb = nn.HuberLoss(delta=delta)(a, b)
+    ref_hb = np.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    np.testing.assert_allclose(float(hb), ref_hb.mean(), rtol=1e-5)
+    # they must now genuinely differ for delta != 1
+    assert abs(float(sl) - float(hb)) > 1e-3
+
+
+def test_distribution_support_guards():
+    for dist_, bad, good in [
+        (distribution.Gamma(2.0, 1.0), -1.0, 1.0),
+        (distribution.Beta(2.0, 2.0), 1.5, 0.5),
+        (distribution.LogNormal(0.0, 1.0), -0.5, 1.0),
+        (distribution.Poisson(3.0), -1.0, 2.0),
+        (distribution.Exponential(1.0), -2.0, 1.0),
+        (distribution.Uniform(0.0, 1.0), 2.0, 0.5),
+    ]:
+        assert float(dist_.log_prob(jnp.asarray(bad))) == float("-inf")
+        assert np.isfinite(float(dist_.log_prob(jnp.asarray(good))))

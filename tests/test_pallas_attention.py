@@ -171,3 +171,43 @@ def test_mha_nonsquare_blocks():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3
         )
+
+
+@pytest.mark.parametrize("d", [64, 96])
+@pytest.mark.parametrize("causal", [False, True])
+def test_mha_unaligned_head_dim(d, causal):
+    """head_dim 64/96 (GPT/ViT): kernel zero-pads to lane width — must
+    match the dense reference exactly, not fall back to it."""
+    rng = np.random.default_rng(7)
+    b, s, h = 1, 256, 2
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    out = mha(q, k, v, causal=causal, q_block=128, k_block=128)
+    assert out.shape == (b, s, h, d)
+    ref = _reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_mha_unaligned_head_dim_grad():
+    rng = np.random.default_rng(8)
+    b, s, h, d = 1, 256, 4, 64
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, 2, d)), jnp.float32)  # +GQA
+    v = jnp.asarray(rng.standard_normal((b, s, 2, d)), jnp.float32)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True, q_block=128, k_block=128) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        assert a.shape == b_.shape
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3
+        )
