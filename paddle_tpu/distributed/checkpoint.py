@@ -135,7 +135,13 @@ def _merge_metadata(tmp_path: str) -> None:
 
 
 def _commit(tmp_path: str, path: str) -> None:
-    """Marker + atomic swap. Runs on rank 0 only."""
+    """Marker + atomic swap. Runs on rank 0 only.
+
+    POSIX cannot atomically swap two directories, so there is a crash
+    window between the two renames where ``path`` is absent and the
+    previous checkpoint sits at ``path + ".old"`` — ``_recover`` (called
+    by every save and load) rolls that state back to the previous intact
+    checkpoint."""
     with open(os.path.join(tmp_path, COMMITTED_MARKER), "w") as f:
         f.write("1")
     old = path + ".old"
@@ -148,12 +154,21 @@ def _commit(tmp_path: str, path: str) -> None:
         shutil.rmtree(old)
 
 
+def _recover(path: str) -> None:
+    """Heal a crash between _commit's two renames: if ``path`` is gone
+    but the previous checkpoint survives at ``.old``, restore it."""
+    old = path + ".old"
+    if not os.path.isdir(path) and os.path.isdir(old):
+        os.rename(old, path)
+
+
 def save_state_dict(state_dict: Dict[str, jax.Array], path: str) -> None:
     """Atomically save a flat {name: jax.Array} dict (values may be
     sharded global arrays). Blocks until the checkpoint is committed."""
     snap = _snapshot_to_host(state_dict)
     tmp_path = path + ".tmp"
     if jax.process_index() == 0:
+        _recover(path)
         if os.path.isdir(tmp_path):  # leftover from a crashed save
             shutil.rmtree(tmp_path)
         os.makedirs(tmp_path, exist_ok=True)
@@ -190,6 +205,7 @@ class AsyncCheckpointer:
         snap = _snapshot_to_host(state_dict)  # the only blocking part
         tmp_path = path + ".tmp"
         if jax.process_index() == 0:
+            _recover(path)
             if os.path.isdir(tmp_path):
                 shutil.rmtree(tmp_path)
             os.makedirs(tmp_path, exist_ok=True)
@@ -242,7 +258,9 @@ class AsyncCheckpointer:
 
 
 def is_committed(path: str) -> bool:
-    """True iff ``path`` is a complete, uncorrupted checkpoint dir."""
+    """True iff ``path`` is a complete, uncorrupted checkpoint dir
+    (restoring it first from ``.old`` if a commit crashed mid-swap)."""
+    _recover(path)
     return os.path.isfile(os.path.join(path, COMMITTED_MARKER)) or (
         # pre-marker checkpoints (round ≤2 layout) are considered
         # committed when merged metadata exists

@@ -2,24 +2,39 @@
 
 Parity: fleet/meta_parallel/pipeline_parallel.py (``PipelineParallel``
 1F1B / F-then-B schedules), pp_layers.py (``PipelineLayer`` /
-``LayerDesc`` segmentation), pp_utils/p2p_communication.py (send/recv
-with shape-header protocol), and the C++ FleetExecutor actor runtime that
-orchestrates static PP (paddle/fluid/distributed/fleet_executor/).
+``LayerDesc`` segmentation + seg_method cost balancing),
+pp_utils/p2p_communication.py (send/recv with shape-header protocol),
+and the C++ FleetExecutor actor runtime that orchestrates static PP
+(paddle/fluid/distributed/fleet_executor/).
 
 TPU-native design: a *single SPMD program*. Stage parameters are stacked
 on a leading [pp] dim sharded over the "pp" mesh axis; microbatches march
 through stages with ``jax.lax.ppermute`` rotations inside a
 ``shard_map`` over the pp axis only (tp/fsdp/sep stay with GSPMD via
-auto axes). The schedule emerges from one scanned loop of
-``n_micro + pp - 1`` ticks (the classic pipeline diagonal); autodiff
-through the shard_map yields the reverse-rotation backward, and XLA's
-scheduler overlaps the ppermute with stage compute — the job of the
-reference's p2p streams + interceptor actors. 1F1B's memory profile is
-recovered with ``jax.checkpoint`` around the stage body (stash only
-boundary activations).
+auto axes). There is no p2p protocol code because activations never
+leave the compiled program.
 
-There is no p2p protocol code because activations never leave the
-compiled program.
+Two schedules, selected by ``strategy.pipeline_configs.schedule_mode``:
+
+- **F-then-B** (GPipe): ``pipeline_apply`` — one scanned loop of
+  ``n_micro + pp - 1`` ticks; autodiff through the shard_map yields the
+  reverse-rotation backward. Residual memory ∝ n_micro (each stage
+  stashes every microbatch's boundary activation for the global backward
+  phase), mitigated by ``jax.checkpoint``.
+- **1F1B** (+interleaved VPP): ``pipeline_1f1b_step`` — forward AND
+  backward live inside one scanned loop of paired F/B ticks, so a
+  microbatch's backward starts as soon as its forward leaves the last
+  (virtual) stage. Residuals (stage inputs; internals are recomputed at
+  backward, the reference's remat policy) live in a ring buffer of
+  2·(V−1−v) slots per virtual stage — peak activation memory ∝ pp·vpp,
+  INDEPENDENT of n_micro, the property that lets gradient accumulation
+  scale. The schedule: F of virtual stage v, microbatch f fires at pair
+  tick v+f; B of (v, b) at pair tick 2(V−1)−v+b — the lockstep-SPMD form
+  of the reference's 1F1B steady state (fleet pipeline_parallel.py).
+  VPP: V = vpp·pp virtual stages placed round-robin (virtual stage v on
+  device v mod pp — Megatron/fleet interleaved placement), activations
+  lap the ring vpp times; each device holds vpp param chunks and runs
+  one F and one B chunk-unit per lap per tick.
 """
 
 from __future__ import annotations
@@ -62,9 +77,12 @@ def pipeline_apply(
 
     def per_stage(params, xs):
         # inside shard_map: params leaves have leading dim 1 (this stage's
-        # slice); xs: [n_micro, mb, ...] (full copy on every stage)
+        # slice); xs: [1, n_micro, mb, ...] — real data only on stage 0
+        # (other stages' blocks are the zero padding added below), so the
+        # input is never all-gathered/replicated across pp
         stage = jax.lax.axis_index(axis)
         my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        xs = xs[0]
         mb_shape = xs.shape[1:]
 
         def tick(carry, t):
@@ -97,25 +115,326 @@ def pipeline_apply(
         _, emits = jax.lax.scan(
             tick, init, jnp.arange(total_ticks)
         )  # emits: [total_ticks, mb, ...] (nonzero only on last stage)
-        # keep the last n_micro ticks' outputs; psum broadcasts the last
-        # stage's results (all other stages emitted zeros)
-        ys = emits[pp - 1:]
-        ys = jax.lax.psum(ys, axis) if pp > 1 else ys
-        return ys
+        # keep the last n_micro ticks' outputs. No psum: only the last
+        # stage's block is real, and the caller slices exactly that block
+        # out of the pp-stacked output — zero broadcast traffic.
+        return emits[pp - 1:]
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    # stage-0-only input: block 0 is the real data, blocks 1..pp-1 are
+    # zeros that only exist to give shard_map a pp-divisible leading dim
+    # (each non-0 stage receives a zero block, not a replica)
+    xs_blocks = jnp.concatenate(
+        [x[None], jnp.zeros((pp - 1, *x.shape), x.dtype)], axis=0
+    )
     fn = shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(spec_params, P()),
-        # with check_vma off a replicated out_spec can't be proven, so the
-        # (identical) per-stage results stack on a leading pp dim and the
-        # first block is taken outside
+        in_specs=(spec_params, P(axis)),
         out_specs=P(axis),
         axis_names={axis},
     )
-    ys = fn(stage_params, x)
-    return ys[:n_micro]
+    ys = fn(stage_params, xs_blocks)  # [pp * n_micro, mb, ...] stacked
+    return ys[(pp - 1) * n_micro:]
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (+ interleaved VPP) — forward and backward in one scanned schedule
+# ---------------------------------------------------------------------------
+def pipeline_1f1b_step(
+    first_fn: Callable,
+    stage_fn: Callable,
+    last_fn: Callable,
+    first_params: Any,
+    stage_params: Any,
+    last_params: Any,
+    x_mbs: Any,
+    aux_mbs: Any,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    vpp: int = 1,
+):
+    """One pipelined loss+grad evaluation under the 1F1B schedule.
+
+    - ``first_fn(first_params, x_mb) -> h`` — stage-0 prologue (embedding);
+      raw per-microbatch inputs (token ids) are replicated over pp (cheap:
+      they are int ids, ~1000x smaller than activations — activations
+      themselves never replicate).
+    - ``stage_fn(chunk_params, h) -> h`` — one VIRTUAL stage (chunk) of the
+      trunk; activations keep shape/dtype across chunks.
+    - ``last_fn(last_params, y_mb, aux_mb) -> scalar`` — head + loss
+      (mean over the microbatch), evaluated on the last stage the tick a
+      microbatch's forward completes; its dy feeds backward immediately.
+    - ``stage_params``: pytree with leading dim V = vpp*pp (virtual-stage
+      order). Virtual stage v lives on device ``v % pp`` (interleaved
+      round-robin — Megatron/fleet VPP placement), so each device holds
+      ``vpp`` chunks.
+    - ``x_mbs``/``aux_mbs``: pytrees with leading dim n_micro.
+
+    Returns ``(loss_mean, dfirst, dstage, dlast)`` where grads are summed
+    over microbatches (divide by n_micro for the mean-loss convention —
+    done here so the result matches grad-of-mean).
+
+    Memory: each virtual stage v keeps a ring of 2(V−1−v)+1 saved stage
+    INPUTS (internals recomputed at backward); peak ∝ pp·vpp,
+    independent of n_micro — the 1F1B property. Schedule (pair tick τ):
+    F(v, f) at τ = v + f; B(v, b) at τ = 2(V−1) − v + b. Dependencies:
+    F(v−1, f) at τ−1; B(v+1, b) at τ−1; B(V−1, b) in the same tick as
+    F(V−1, b).
+    """
+    pp = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    V = leaves[0].shape[0] if leaves else pp * vpp
+    if V != pp * vpp:
+        raise ValueError(
+            f"stage_params leading dim {V} != pp*vpp = {pp}*{vpp}")
+    n_micro = jax.tree_util.tree_leaves(x_mbs)[0].shape[0]
+    T = n_micro + 2 * (V - 1)
+    R = max(2 * V, 1)  # residual ring slots (≥ max in-flight 2(V-1)+1)
+
+    # virtual-stage order [V, ...] -> device-major [pp, vpp, ...]
+    dev_major = jax.tree_util.tree_map(
+        lambda p: p.reshape(vpp, pp, *p.shape[1:]).swapaxes(0, 1),
+        stage_params,
+    )
+
+    x0 = jax.tree_util.tree_map(lambda a: a[0], x_mbs)
+    h_sds = jax.eval_shape(first_fn, first_params, x0)
+
+    def per_device(sp, fp, lp, xs, auxs):
+        s_idx = jax.lax.axis_index(axis)
+        # fp/lp arrive pp-invariant; vjp of an invariant input against a
+        # varying output would insert an implicit psum over pp, polluting
+        # each device's cotangent with every OTHER device's (masked-out)
+        # phantom contribution. Cast to varying so cotangents stay
+        # per-device; the caller slices the real device's block.
+        fp = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, (axis,), to="varying"), fp)
+        lp = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, (axis,), to="varying"), lp)
+        chunks = jax.tree_util.tree_map(lambda p: p[0], sp)  # [vpp, ...]
+
+        def chunk_params(c):
+            return jax.tree_util.tree_map(lambda p: p[c], chunks)
+
+        def vary(x):
+            # scan carries become pp-varying through the ppermute/axis_index
+            # data flow; the zero-init must carry the same vma type.
+            # Idempotent: already-varying values pass through.
+            if axis in getattr(jax.typeof(x), "vma", frozenset()):
+                return x
+            return jax.lax.pcast(x, (axis,), to="varying")
+
+        zero_h = vary(jnp.zeros(h_sds.shape, h_sds.dtype))
+        carry0 = {
+            "fbuf": [zero_h for _ in range(vpp)],
+            "bbuf": [zero_h for _ in range(vpp)],
+            "res": [vary(jnp.zeros((R, *h_sds.shape), h_sds.dtype))
+                    for _ in range(vpp)],
+            "dstage": [jax.tree_util.tree_map(jnp.zeros_like, chunk_params(c))
+                       for c in range(vpp)],
+            "dfirst": jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), fp),
+            "dlast": jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), lp),
+            "loss_sum": vary(jnp.zeros((), jnp.float32)),
+        }
+
+        def take_mb(tree, i):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, i, 0,
+                                                       keepdims=False),
+                tree,
+            )
+
+        def macc(acc, g, active):
+            return jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(active, b, 0).astype(a.dtype),
+                acc, g,
+            )
+
+        def tick(carry, t):
+            fbuf, bbuf = carry["fbuf"], carry["bbuf"]
+            res, dstage = carry["res"], carry["dstage"]
+            dfirst, dlast = carry["dfirst"], carry["dlast"]
+            loss_sum = carry["loss_sum"]
+
+            # embedding for the microbatch entering v=0 this tick
+            f0 = jnp.clip(t, 0, n_micro - 1)
+            a_embed = first_fn(fp, take_mb(xs, f0))
+
+            f_out = [None] * vpp
+            b_out = [None] * vpp
+            dy_stash = zero_h
+            new_fbuf, new_bbuf, new_res = list(fbuf), list(bbuf), list(res)
+            new_dstage = list(dstage)
+
+            for c in range(vpp):
+                v = c * pp + s_idx  # traced (device-dependent)
+                params_c = chunk_params(c)
+
+                # ---- F slot ----
+                f = t - v
+                active_f = (f >= 0) & (f < n_micro)
+                fsafe = jnp.clip(f, 0, n_micro - 1)
+                a_in = jnp.where(v == 0, a_embed, fbuf[c])
+                slot_f = fsafe % R
+                new_res[c] = jnp.where(
+                    active_f,
+                    jax.lax.dynamic_update_index_in_dim(
+                        new_res[c], a_in, slot_f, 0),
+                    new_res[c],
+                )
+                out_f = stage_fn(params_c, a_in)
+                f_out[c] = out_f
+
+                # last virtual stage: head+loss now; dy feeds B this tick.
+                # v == V-1 requires c == vpp-1 (v = c*pp + s, s < pp), so
+                # the head forward+VJP — the vocab-size matmul, usually
+                # the most expensive per-tick op — is built ONLY for the
+                # final lap, not masked-out for every lap.
+                if c == vpp - 1:
+                    is_last_v = v == V - 1
+                    aux_f = take_mb(auxs, fsafe)
+                    loss_f, head_vjp = jax.vjp(
+                        lambda lp_, y_: last_fn(lp_, y_, aux_f), lp, out_f)
+                    ct_one = jax.lax.pcast(jnp.ones((), loss_f.dtype),
+                                           (axis,), to="varying")
+                    dlast_f, dy_f = head_vjp(ct_one)
+                    keep = active_f & is_last_v
+                    loss_sum = loss_sum + jnp.where(
+                        keep, loss_f, 0.0).astype(jnp.float32)
+                    dlast = macc(dlast, dlast_f, keep)
+                    dy_stash = jnp.where(is_last_v, dy_f, dy_stash)
+
+                # ---- B slot ----
+                b = t - (2 * (V - 1) - v)
+                active_b = (b >= 0) & (b < n_micro)
+                bsafe = jnp.clip(b, 0, n_micro - 1)
+                # dy feeds B only where v can be V-1 (the final lap)
+                ct_in = (jnp.where(v == V - 1, dy_stash, bbuf[c])
+                         if c == vpp - 1 else bbuf[c])
+                a_saved = jax.lax.dynamic_index_in_dim(
+                    new_res[c], bsafe % R, 0, keepdims=False)
+                _, stage_vjp = jax.vjp(stage_fn, params_c, a_saved)
+                dp_c, da = stage_vjp(ct_in)
+                new_dstage[c] = macc(new_dstage[c], dp_c, active_b)
+
+                # v == 0 (only possible on lap 0): backprop through the
+                # prologue (embedding scatter-grad built once, not per lap)
+                if c == 0:
+                    _, first_vjp = jax.vjp(first_fn, fp, take_mb(xs, bsafe))
+                    dfirst_b, _ = first_vjp(da)
+                    dfirst = macc(dfirst, dfirst_b, active_b & (v == 0))
+                b_out[c] = da
+
+            # ---- rotations ----
+            fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+            bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+            f_stack = jnp.stack(f_out)  # [vpp, ...]
+            b_stack = jnp.stack(b_out)
+            f_rot = jax.lax.ppermute(f_stack, axis, fwd_perm)
+            b_rot = jax.lax.ppermute(b_stack, axis, bwd_perm)
+            # wraparound lap shift: device 0 receives lap c data into
+            # lap c+1 slots (fwd); device pp-1 receives lap c into c-1
+            # (bwd). Lap 0 @ device 0 / lap vpp-1 @ device pp-1 take the
+            # embed / dy paths instead, so their stale values are unused.
+            f_shift = jnp.roll(f_rot, 1, axis=0)
+            b_shift = jnp.roll(b_rot, -1, axis=0)
+            f_next = jnp.where(s_idx == 0, f_shift, f_rot)
+            b_next = jnp.where(s_idx == pp - 1, b_shift, b_rot)
+            for c in range(vpp):
+                new_fbuf[c] = f_next[c]
+                new_bbuf[c] = b_next[c]
+
+            return {
+                "fbuf": new_fbuf, "bbuf": new_bbuf, "res": new_res,
+                "dstage": new_dstage, "dfirst": dfirst, "dlast": dlast,
+                "loss_sum": loss_sum,
+            }, None
+
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+        inv = 1.0 / n_micro  # mean-loss convention
+        dstage_local = jax.tree_util.tree_map(
+            lambda *gs: jnp.stack(gs) * inv, *final["dstage"]
+        )  # [vpp, ...]
+        dfirst_out = jax.tree_util.tree_map(
+            lambda g: (g * inv)[None], final["dfirst"])
+        dlast_out = jax.tree_util.tree_map(
+            lambda g: (g * inv)[None], final["dlast"])
+        loss_out = (final["loss_sum"] * inv)[None]
+        dstage_out = jax.tree_util.tree_map(
+            lambda g: g[None], dstage_local)  # [1, vpp, ...] for P(axis)
+        return loss_out, dfirst_out, dstage_out, dlast_out
+
+    spec_sp = jax.tree_util.tree_map(lambda _: P(axis), dev_major)
+    repl = jax.tree_util.tree_map(lambda _: P(), first_params)
+    repl_l = jax.tree_util.tree_map(lambda _: P(), last_params)
+    repl_x = jax.tree_util.tree_map(lambda _: P(), x_mbs)
+    repl_a = jax.tree_util.tree_map(lambda _: P(), aux_mbs)
+    out_spec = (
+        P(axis),
+        jax.tree_util.tree_map(lambda _: P(axis), first_params),
+        jax.tree_util.tree_map(lambda _: P(axis), dev_major),
+        jax.tree_util.tree_map(lambda _: P(axis), last_params),
+    )
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(spec_sp, repl, repl_l, repl_x, repl_a),
+        out_specs=out_spec,
+        axis_names={axis},
+    )
+    loss_st, dfirst_st, dstage_st, dlast_st = fn(
+        dev_major, first_params, last_params, x_mbs, aux_mbs)
+    # loss/dlast are real only on the last device's block; dfirst on the
+    # first's — slice, never broadcast
+    loss = loss_st[-1]
+    dfirst = jax.tree_util.tree_map(lambda g: g[0], dfirst_st)
+    dlast = jax.tree_util.tree_map(lambda g: g[-1], dlast_st)
+    dstage = jax.tree_util.tree_map(
+        lambda g: g.swapaxes(0, 1).reshape(V, *g.shape[2:]), dstage_st
+    )
+    return loss, dfirst, dstage, dlast
+
+
+def segment_layers(costs, num_stages: int):
+    """Cost-balanced contiguous segmentation (parity: fleet pp_layers
+    ``segment_layers`` with seg_method="layer:.*"/"uniform" — here the
+    general balanced-partition form): split ``costs`` into
+    ``num_stages`` contiguous groups minimizing the max group cost.
+    Returns stage boundary indices [0, b1, ..., L]."""
+    costs = list(costs)
+    L = len(costs)
+    if num_stages <= 0 or L < num_stages:
+        raise ValueError(f"cannot split {L} layers into {num_stages} stages")
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def greedy(cap):
+        """Fill stages up to ``cap`` each (always leaving ≥1 layer per
+        remaining stage). Returns bounds or None if infeasible."""
+        bounds = [0]
+        i = 0
+        for stage in range(num_stages):
+            start = i
+            last_possible = L - (num_stages - stage - 1)
+            while (i < last_possible
+                   and (prefix[i + 1] - prefix[start] <= cap or i == start)):
+                i += 1
+            bounds.append(i)
+        return bounds if bounds[-1] == L else None
+
+    lo, hi = max(costs), prefix[-1]
+    for _ in range(60):  # binary search the bottleneck stage cost
+        mid = (lo + hi) / 2
+        if greedy(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    return greedy(hi)
 
 
 class LayerDesc:
@@ -131,13 +450,20 @@ class LayerDesc:
 
 
 class SharedLayerDesc(LayerDesc):
-    """Parity: tied weights across stages (e.g. embedding/lm-head). In the
-    SPMD pipeline tied weights live outside the pipelined trunk, so this
-    marks layers the segmenter must keep out of the stage stack."""
+    """Parity: fleet SharedLayerDesc — tied weights across stages (e.g.
+    embedding/lm-head). All descs with the same ``key`` resolve to ONE
+    built layer (one parameter set); a later occurrence may override the
+    call with ``forward_func(layer, x)`` (the fleet convention for
+    reusing the embedding matrix as the lm head). In the SPMD pipeline
+    tied layers live outside the pipelined trunk (pre/post segments), so
+    the shared parameter is one array with grads summed from both uses —
+    no cross-stage weight sync step is needed (the reference needs an
+    explicit allreduce between the tied stages)."""
 
-    def __init__(self, key, layer_cls, *args, **kwargs):
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
         super().__init__(layer_cls, *args, **kwargs)
         self.key = key
+        self.forward_func = forward_func
 
 
 class PipelineLayer(Layer):
@@ -237,3 +563,254 @@ class PipelineLayer(Layer):
 
         h, _ = jax.lax.scan(one, x, params)
         return h
+
+
+class PipelineModule(Layer):
+    """Parity: fleet pp_layers.PipelineLayer taking a heterogeneous
+    ``LayerDesc`` list — e.g. ``[SharedLayerDesc("embed", Embedding, ...),
+    LayerDesc(Block, ...) * L, LayerNorm, SharedLayerDesc("embed", ...,
+    forward_func=...)]``.
+
+    TPU-native segmentation: the maximal homogeneous run of descs becomes
+    the pipelined trunk (stacked params, SPMD ring — ``PipelineLayer``
+    storage); everything before/after runs on the first/last (virtual)
+    stage under plain GSPMD. ``segment_layers`` balances trunk layers per
+    stage by cost. SharedLayerDescs with equal keys build once — tied
+    parameters are genuinely one array.
+    """
+
+    def __init__(self, descs, num_stages: Optional[int] = None,
+                 seg_method: str = "uniform", cost_fn=None):
+        super().__init__()
+        if seg_method != "uniform":
+            raise NotImplementedError(
+                f"seg_method={seg_method!r}: the stacked-parameter trunk "
+                "requires equal layers per stage; only 'uniform' is "
+                "supported (cost_fn is validated against it)")
+        self.num_stages = num_stages
+        self._shared = {}
+        self._shared_fwd = {}
+
+        sig = [self._sig(d) for d in descs]
+        lo, hi = self._longest_run(sig)
+        if hi - lo < 2:
+            raise ValueError(
+                "PipelineModule needs a homogeneous run of >=2 LayerDescs "
+                "to pipeline (the transformer trunk)")
+        self.trunk_range = (lo, hi)
+        self.pre_descs = descs[:lo]
+        self.post_descs = descs[hi:]
+        self.trunk = PipelineLayer(descs[lo], hi - lo,
+                                   num_stages=num_stages)
+        self.pre = [self._build(d, f"pre_{i}")
+                    for i, d in enumerate(self.pre_descs)]
+        self.post = [self._build(d, f"post_{i}")
+                     for i, d in enumerate(self.post_descs)]
+        # cost-based segmentation check: the stacked storage splits the
+        # trunk into EQUAL chunks, so a cost_fn whose balanced partition
+        # is non-uniform cannot be honored — fail loudly instead of
+        # silently imbalancing stages
+        if num_stages:
+            costs = ([cost_fn(d) for d in descs[lo:hi]] if cost_fn
+                     else [1.0] * (hi - lo))
+            self.segments = segment_layers(costs, num_stages)
+            sizes = {b - a for a, b in zip(self.segments,
+                                           self.segments[1:])}
+            if len(sizes) > 1:
+                raise ValueError(
+                    f"cost-balanced segmentation {self.segments} is "
+                    "non-uniform; the stacked-parameter trunk requires "
+                    "equal layers per stage — pad the trunk or drop "
+                    "cost_fn")
+
+    @staticmethod
+    def _sig(d):
+        return (d.layer_cls, repr(d.args), repr(sorted(d.kwargs.items())),
+                isinstance(d, SharedLayerDesc))
+
+    @staticmethod
+    def _longest_run(sig):
+        best = (0, 0)
+        i = 0
+        while i < len(sig):
+            j = i
+            while j < len(sig) and sig[j] == sig[i] and not sig[i][3]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = max(j, i + 1)
+        return best
+
+    def _build(self, desc, attr):
+        if isinstance(desc, SharedLayerDesc):
+            if desc.key not in self._shared:
+                layer = desc.build()
+                self._shared[desc.key] = layer
+                self.add_sublayer(f"shared_{desc.key}", layer)
+            self._shared_fwd[attr] = desc.forward_func
+            return ("shared", desc.key, attr)
+        layer = desc.build()
+        self.add_sublayer(attr, layer)
+        return ("own", attr, attr)
+
+    def _apply_seq(self, entries, x):
+        for kind, key, attr in entries:
+            if kind == "shared":
+                layer = self._shared[key]
+                fwd = self._shared_fwd.get(attr)
+                x = fwd(layer, x) if fwd is not None else layer(x)
+            else:
+                x = getattr(self, key)(x)
+        return x
+
+    def forward(self, x, n_micro: int = 1, mesh: Optional[Mesh] = None):
+        """F-then-B (GPipe) forward — pre → pipelined trunk → post.
+        Backward is jax autodiff (use ``PipelineTrainStep`` for 1F1B)."""
+        x = self._apply_seq(self.pre, x)
+        x = self.trunk(x, n_micro=n_micro, mesh=mesh)
+        return self._apply_seq(self.post, x)
+
+
+class PipelineTrainStep:
+    """1F1B/VPP training step over a ``PipelineModule``.
+
+    Parity: fleet PipelineParallel.train_batch with
+    ``schedule_mode="1F1B"`` / ``vpp_degree`` (strategy.pipeline_configs)
+    — here one jitted SPMD program per step built on
+    ``pipeline_1f1b_step``. ``schedule_mode="F-then-B"`` falls back to
+    autodiff through the GPipe forward.
+
+    loss_fn(out_mb, aux_mb) -> scalar (mean over the microbatch).
+    """
+
+    def __init__(self, module: PipelineModule, optimizer, mesh: Mesh,
+                 strategy=None, loss_fn=None):
+        self.module = module
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.strategy = strategy
+        self.loss_fn = loss_fn or (lambda out, aux: out.mean())
+        pcfg = getattr(strategy, "pipeline_configs", None)
+        self.schedule = getattr(pcfg, "schedule_mode", "1F1B")
+        self.vpp = max(1, getattr(pcfg, "vpp_degree", 1))
+        self.n_micro = max(1, getattr(pcfg, "accumulate_steps", 1))
+        pp = mesh.shape["pp"]
+        L = module.trunk.num_layers
+        if L % (pp * self.vpp):
+            raise ValueError(
+                f"trunk layers {L} must divide pp*vpp = {pp * self.vpp}")
+
+        # flat param dicts (optimizer-compatible)
+        pre_names = self._seq_param_names(module.pre)
+        post_names = self._seq_param_names(module.post)
+        trunk_p = module.trunk.stage_params()
+        self.params = {}
+        for n in pre_names | post_names:
+            self.params[n] = dict(module.named_parameters())[n].value
+        for k, v in trunk_p.items():
+            self.params[f"trunk.{k}"] = v
+        self._pre_names, self._post_names = pre_names, post_names
+        self.opt_state = optimizer.init(self.params)
+        self._step = jax.jit(self._make_step())
+
+    def _seq_param_names(self, entries):
+        names = set()
+        all_params = dict(self.module.named_parameters())
+        for kind, key, attr in entries:
+            prefix = f"shared_{key}." if kind == "shared" else f"{attr}."
+            names |= {n for n in all_params if n.startswith(prefix)}
+        return names
+
+    def _make_step(self):
+        module = self.module
+        mesh, vpp = self.mesh, self.vpp
+        pp = mesh.shape["pp"]
+        V = pp * vpp
+        loss_fn = self.loss_fn
+        from ..core.functional import bind_params
+
+        def first_fn(first_params, x_mb):
+            with bind_params(module, first_params):
+                return module._apply_seq(module.pre, x_mb)
+
+        def stage_fn(chunk_params, h):
+            # chunk leaves: [per_chunk, ...] — scan the prototype over them
+            def one(carry, layer_params):
+                return module.trunk._apply_one(layer_params, carry), None
+
+            out, _ = jax.lax.scan(one, h, chunk_params)
+            return out
+
+        def last_fn(last_params, y, aux):
+            with bind_params(module, last_params):
+                out = module._apply_seq(module.post, y)
+            return loss_fn(out, aux)
+
+        n_micro = self.n_micro
+        schedule = self.schedule
+
+        def step_fn(params, opt_state, x, aux):
+            first_params = {n: params[n] for n in self._pre_names}
+            last_params = {n: params[n] for n in self._post_names}
+            trunk_params = {
+                k[len("trunk."):]: v for k, v in params.items()
+                if k.startswith("trunk.")
+            }
+            mbs = jax.tree_util.tree_map(
+                lambda a: a.reshape(n_micro, a.shape[0] // n_micro,
+                                    *a.shape[1:]), x)
+            aux_mbs = jax.tree_util.tree_map(
+                lambda a: a.reshape(n_micro, a.shape[0] // n_micro,
+                                    *a.shape[1:]), aux)
+            if schedule.upper() in ("1F1B", "VPP"):
+                L = next(iter(trunk_params.values())).shape[0]
+                per_chunk = L // V
+                sp = {k: v.reshape(V, per_chunk, *v.shape[1:])
+                      for k, v in trunk_params.items()}
+                loss, dfirst, dstage, dlast = pipeline_1f1b_step(
+                    first_fn, stage_fn, last_fn,
+                    first_params, sp, last_params, mbs, aux_mbs,
+                    mesh=mesh, vpp=vpp)
+                grads = {}
+                for n in set(dfirst) | set(dlast):
+                    g = None
+                    if n in dfirst:
+                        g = dfirst[n]
+                    if n in dlast:  # tied params: sum both uses' grads
+                        g = dlast[n] if g is None else g + dlast[n]
+                    grads[n] = g
+                for k, v in dstage.items():
+                    grads[f"trunk.{k}"] = v.reshape(
+                        v.shape[0] * v.shape[1], *v.shape[2:])
+            else:  # F-then-B: autodiff through the GPipe forward
+                def loss_of(p):
+                    fpp = {n: p[n] for n in self._pre_names}
+                    lpp = {n: p[n] for n in self._post_names}
+                    tpp = {k[len("trunk."):]: v for k, v in p.items()
+                           if k.startswith("trunk.")}
+                    h0 = jax.vmap(lambda xm: first_fn(fpp, xm))(mbs)
+                    # stage slice leaves arrive [layers_per_stage, ...] —
+                    # exactly what stage_fn's layer scan consumes
+                    ys = pipeline_apply(
+                        stage_fn,
+                        {k: v.reshape(pp, v.shape[0] // pp, *v.shape[1:])
+                         for k, v in tpp.items()},
+                        h0, mesh=mesh, n_micro=n_micro)
+                    losses = jax.vmap(
+                        lambda y, a: last_fn(lpp, y, a))(ys, aux_mbs)
+                    return losses.mean()
+
+                loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params, new_state = self.optimizer.update(
+                grads, opt_state, params)
+            return new_params, new_state, loss
+
+        return step_fn
+
+    def run(self, x, aux):
+        from .sharding import mesh_context
+
+        with mesh_context(self.mesh):
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, x, aux)
+        return loss

@@ -318,8 +318,9 @@ class Poisson(Distribution):
         ).astype(jnp.float32)
 
     def log_prob(self, value):
-        lp = (value * jnp.log(self.rate) - self.rate
-              - jax.scipy.special.gammaln(value + 1.0))
+        v = jnp.where(value >= 0, value, 0.0)  # avoid nan grads off-support
+        lp = (v * jnp.log(self.rate) - self.rate
+              - jax.scipy.special.gammaln(v + 1.0))
         return jnp.where(value >= 0, lp, -jnp.inf)
 
     @property

@@ -220,3 +220,18 @@ def test_prefetch_to_device():
     out = list(io.prefetch_to_device(iter(dl)))
     assert len(out) == 2
     assert isinstance(out[0][0], jax.Array)
+
+
+def test_crash_between_commit_renames_recovers(tmp_path):
+    """Crash window inside _commit (old moved aside, new not yet in
+    place): the next load or save must restore the previous checkpoint
+    from '.old' instead of failing."""
+    path = str(tmp_path / "swap")
+    ckpt.save_state_dict({"w": jnp.ones((2, 2))}, path)
+    # simulate: commit got as far as renaming path -> path.old
+    os.rename(path, path + ".old")
+    assert not os.path.isdir(path)
+    assert ckpt.is_committed(path)  # triggers recovery
+    loaded = ckpt.load_state_dict(path)
+    np.testing.assert_allclose(np.asarray(loaded["w"]), 1.0)
+    assert not os.path.isdir(path + ".old")
