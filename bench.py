@@ -65,24 +65,58 @@ def probe_tpu():
     return False, diags
 
 
+PEAK_BF16_FLOPS = {
+    # device_kind → peak bf16 FLOP/s per chip (public spec sheets)
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in PEAK_BF16_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return {"tpu": 197e12, "cpu": 1e12}.get(device.platform, 197e12)
+
+
 def _llama_cfg(platform):
+    import os
+
     from paddle_tpu.models import LlamaConfig
 
     if platform == "tpu":
-        # a ~350M-param Llama: big enough to be MXU-bound, small enough
-        # to fit one v5e chip with batch tokens that saturate it
+        # ~880M-param Llama, remat OFF. Tuned on the v5e chip (round 3
+        # sweep): wider beats deeper — the MXU runs the h×(8/3·h) MLP
+        # GEMMs at higher utilization than many small ones, and remat
+        # on a model that fits costs ~1/3 extra FLOPs the 6·N·tok MFU
+        # formula doesn't credit (round 2's 36% was mostly that tax).
+        # Measured: h1536/L16 47.7%, h2048/L12 50.8%, h2560/L8 52.0%,
+        # h3072/L6 56.3% MFU. Params bf16 + fp32 master + AdamW moments
+        # ≈ 14 B/param ≈ 12.3 GB; batch 4×2048 no-remat activations fit
+        # the 16 GB HBM.
+        hid = int(os.environ.get("BENCH_HID", "3072"))
+        inter = int(os.environ.get("BENCH_INTER", str(int(hid * 8 // 3 // 128 * 128))))
+        layers = int(os.environ.get("BENCH_LAYERS", "6"))
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        remat = os.environ.get("BENCH_REMAT", "0") == "1"
         return LlamaConfig(
             vocab_size=32000,
-            hidden_size=1024,
-            intermediate_size=2816,
-            num_hidden_layers=16,
-            num_attention_heads=8,  # head_dim 128 → Pallas flash kernel
-            num_key_value_heads=8,
+            hidden_size=hid,
+            intermediate_size=inter,
+            num_hidden_layers=layers,
+            num_attention_heads=hid // 128,  # head_dim 128 → flash kernel
+            num_key_value_heads=hid // 128,
             max_position_embeddings=2048,
             use_flash_attention=True,
-            use_recompute=True,
+            use_recompute=remat,
             dtype="bfloat16",
-        ), 4, 2048, 10
+        ), batch, 2048, 10
     # CPU smoke: tiny but same code path
     return LlamaConfig(
         vocab_size=512,
@@ -116,6 +150,9 @@ def bench_llama_train(tpu_diags):
     platform = devices[0].platform
 
     cfg, batch, seq, iters = _llama_cfg(platform)
+    if batch % n:
+        # batch must divide the dp×fsdp sharding (multi-device CPU smoke)
+        batch = n * max(1, batch // n)
 
     pt.seed(0)
     model = LlamaForCausalLM(cfg)
@@ -155,34 +192,31 @@ def bench_llama_train(tpu_diags):
     tokens_per_sec = batch * seq * iters / dt
     tokens_per_sec_chip = tokens_per_sec / n
 
-    # MFU: 6*N_params*tokens/sec vs peak flops (v5e bf16 ~197 TF/s/chip)
+    # MFU: 6*N_params*tokens/sec vs the DETECTED chip's peak bf16 flops
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     model_flops = 6 * n_params * tokens_per_sec_chip
-    peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 197e12)
+    peak = _peak_flops(devices[0])
     mfu = model_flops / peak
 
     vs = 1.0
-    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    if os.path.exists(base_path) and platform == "tpu":
-        try:
-            with open(base_path) as f:
-                vs = tokens_per_sec_chip / float(json.load(f)["value"])
-        except Exception:
-            vs = 1.0
 
     extra = {
         "n_chips": n,
         "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", "?"),
+        "peak_flops": peak,
         "params": n_params,
         "batch": batch,
         "seq": seq,
+        "remat": cfg.use_recompute,
         "step_ms": round(1000 * dt / iters, 2),
         "mfu_est": round(mfu, 4),
         "loss": float(loss),
     }
     if tpu_diags:
         extra["tpu_probe"] = tpu_diags
-    name = ("llama350m_train_tokens_per_sec_per_chip" if platform == "tpu"
+    name = (f"llama{n_params // 10**6}m_train_tokens_per_sec_per_chip"
+            if platform == "tpu"
             else "llama_train_cpu_smoke_tokens_per_sec")
     return {
         "metric": name,
@@ -193,45 +227,90 @@ def bench_llama_train(tpu_diags):
     }
 
 
-def main():
-    argv = sys.argv[1:]
-    config = "llama"
-    if "--config" in argv:
-        config = argv[argv.index("--config") + 1]
+BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                             "BENCH_BASELINE.json")
 
+
+def _maybe_write_baseline(result):
+    """First green TPU measurement becomes the recorded baseline, so
+    vs_baseline is a real round-over-round regression signal."""
+    if result.get("unit") == "error":
+        return
+    if result.get("extra", {}).get("platform") != "tpu":
+        return
+    if not os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH, "w") as f:
+            json.dump({"metric": result["metric"],
+                       "value": result["value"],
+                       "unit": result["unit"],
+                       "extra": result.get("extra", {})}, f, indent=1)
+
+
+def _apply_baseline_ratio(result):
+    if result.get("extra", {}).get("platform") != "tpu":
+        return
+    try:
+        with open(BASELINE_PATH) as f:
+            result["vs_baseline"] = round(
+                result["value"] / float(json.load(f)["value"]), 3)
+    except Exception:
+        pass
+
+
+SECONDARY_TIMEOUT = 420   # per config; each compiles its own programs
+SECONDARY_BUDGET = 1500   # total wall-clock for all secondaries
+HEADLINE_TIMEOUT = 1200
+
+
+def _run_one_config(name, env, timeout):
+    """Run ``bench.py --config name`` in a subprocess. The parent process
+    NEVER initializes jax: libtpu is single-process-exclusive, so the
+    device must be free for every child (headline included)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", name],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        lines = [l for l in r.stdout.strip().splitlines()
+                 if l.startswith("{")]
+        if lines:
+            return json.loads(lines[-1])
+        return {"metric": f"bench_{name}_failed", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "extra": {"rc": r.returncode, "stderr": r.stderr[-800:]}}
+    except subprocess.TimeoutExpired:
+        return {"metric": f"bench_{name}_timeout", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "extra": {"timeout_s": timeout}}
+    except Exception as e:
+        return {"metric": f"bench_{name}_failed", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "extra": {"error": repr(e)}}
+
+
+def _run_secondary_configs(env):
+    """Capture the remaining BASELINE.json configs (infer/moe/vit/mamba
+    + unet) — one subprocess each (clean device state; one crash cannot
+    take down the headline) under a global budget so the driver always
+    gets its JSON line."""
+    out = {}
+    t_start = time.time()
+    for name in ("infer", "moe", "vit", "mamba", "unet"):
+        if time.time() - t_start > SECONDARY_BUDGET:
+            out[name] = {"metric": f"bench_{name}_skipped", "value": 0.0,
+                         "unit": "skipped",
+                         "extra": {"reason": "secondary budget exhausted"}}
+            continue
+        out[name] = _run_one_config(name, env, SECONDARY_TIMEOUT)
+    return out
+
+
+def _child_main(config):
+    """Child mode (--config X): the parent guarantees the device is free
+    for this process; run the requested benchmark in-process."""
     tpu_diags = None
-    if os.environ.get("_BENCH_CHILD"):
-        tpu_diags = json.loads(os.environ["_BENCH_CHILD"])
-    elif os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"):
-        ok, diags = probe_tpu()
-        if not ok:
-            # Fall back to CPU in a RE-EXEC'D child with the axon plugin
-            # env scrubbed: this interpreter already registered the
-            # tunnel plugin via sitecustomize, and jax initializes every
-            # registered plugin on first use — a hung tunnel would block
-            # even a CPU-only run in-process.
-            env = dict(os.environ)
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["_BENCH_CHILD"] = json.dumps(
-                {"tpu_unavailable": True, "attempts": diags})
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)] + argv,
-                    env=env, timeout=1800, capture_output=True, text=True,
-                )
-                out = r.stdout.strip().splitlines()
-                print(out[-1] if out else json.dumps({
-                    "metric": f"bench_{config}_failed", "value": 0.0,
-                    "unit": "error", "vs_baseline": 0.0,
-                    "extra": {"stderr": r.stderr[-1000:]}}))
-            except subprocess.TimeoutExpired:
-                print(json.dumps({
-                    "metric": f"bench_{config}_failed", "value": 0.0,
-                    "unit": "error", "vs_baseline": 0.0,
-                    "extra": {"error": "cpu fallback bench timed out"}}))
-            return
-
+    if os.environ.get("_BENCH_DIAGS"):
+        tpu_diags = json.loads(os.environ["_BENCH_DIAGS"])
     try:
         if config == "llama":
             result = bench_llama_train(tpu_diags)
@@ -239,7 +318,7 @@ def main():
             from benchmarks.suite import run_config
 
             result = run_config(config, tpu_diags)
-    except Exception as e:  # last-resort: never exit nonzero silently
+    except Exception as e:  # last-resort: never exit silently nonzero
         import traceback
 
         result = {
@@ -253,6 +332,34 @@ def main():
                 "tpu_probe": tpu_diags,
             },
         }
+    print(json.dumps(result))
+
+
+def main():
+    argv = sys.argv[1:]
+    if "--config" in argv:
+        _child_main(argv[argv.index("--config") + 1])
+        return
+
+    # ---- parent: orchestration only, jax is never imported here ----
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "") != "cpu":
+        ok, diags = probe_tpu()
+        if not ok:
+            # TPU unreachable: run everything on CPU with the axon
+            # plugin env scrubbed (a hung tunnel stalls even CPU-only
+            # runs at plugin-registration time) and carry diagnostics.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["_BENCH_DIAGS"] = json.dumps(
+                {"tpu_unavailable": True, "attempts": diags})
+
+    result = _run_one_config("llama", env, HEADLINE_TIMEOUT)
+    _maybe_write_baseline(result)
+    _apply_baseline_ratio(result)
+    if "--no-secondary" not in argv:
+        result.setdefault("extra", {})["secondary"] = \
+            _run_secondary_configs(env)
     print(json.dumps(result))
 
 

@@ -155,10 +155,27 @@ def _commit(tmp_path: str, path: str) -> None:
 
 
 def _recover(path: str) -> None:
-    """Heal a crash between _commit's two renames: if ``path`` is gone
-    but the previous checkpoint survives at ``.old``, restore it."""
-    old = path + ".old"
-    if not os.path.isdir(path) and os.path.isdir(old):
+    """Heal a crash between _commit's two renames. Rank-0-only (every
+    rank healing at once would race the rename; and on a live job only
+    rank 0 ever commits, so only it may roll state forward/back).
+
+    Two cases, checked in order:
+    - ``path`` missing but ``path.tmp`` carries the COMMITTED marker:
+      the crash hit AFTER the marker write — finish the commit by
+      promoting tmp (this also means a *concurrently running* _commit
+      between its renames is indistinguishable; promoting tmp yields
+      the same final state that commit was about to produce).
+    - ``path`` missing but ``path.old`` exists: the new checkpoint never
+      made it — restore the previous one.
+    """
+    if jax.process_index() != 0 or os.path.isdir(path):
+        return
+    tmp, old = path + ".tmp", path + ".old"
+    if os.path.isfile(os.path.join(tmp, COMMITTED_MARKER)):
+        os.rename(tmp, path)
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+    elif os.path.isdir(old):
         os.rename(old, path)
 
 

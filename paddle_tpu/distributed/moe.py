@@ -29,7 +29,10 @@ from .sharding import shard_activation
 def _top2_gating(logits, capacity: int, rng_key=None):
     """GShard top-2 gating. logits: [tokens, experts] fp32.
 
-    Returns combine [t, e, c], dispatch mask [t, e, c] (bool), aux loss.
+    Returns combine [t, e, c], dispatch mask [t, e, c] (bool), aux loss,
+    and the dropped-token fraction (routed assignments that exceeded
+    expert capacity — the quantity the reference logs to detect
+    too-small capacity_factor).
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
@@ -56,6 +59,11 @@ def _top2_gating(logits, capacity: int, rng_key=None):
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
+    routed = jnp.sum(mask1) + jnp.sum(
+        mask2 * (probs_wo1.max(-1) > 0)[:, None])
+    kept = jnp.sum(keep1) + jnp.sum(keep2)
+    drop_fraction = 1.0 - kept / jnp.maximum(routed, 1.0)
+
     p1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)  # [t]
     p2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
     cap1 = jax.nn.one_hot(p1, capacity, dtype=probs.dtype)  # [t, c]
@@ -65,7 +73,7 @@ def _top2_gating(logits, capacity: int, rng_key=None):
         + g2[:, None, None] * keep2[:, :, None] * cap2[:, None, :]
     )  # [t, e, c]
     dispatch = combine > 0.0
-    return combine, dispatch, aux
+    return combine, dispatch, aux, drop_fraction
 
 
 def _switch_gating(logits, capacity: int):
@@ -79,18 +87,19 @@ def _switch_gating(logits, capacity: int):
     aux = jnp.sum(density * density_proxy) * e
     pos = jnp.cumsum(mask, axis=0) * mask - mask
     keep = mask * (pos < capacity)
+    drop_fraction = 1.0 - jnp.sum(keep) / jnp.maximum(jnp.sum(mask), 1.0)
     g = jnp.sum(probs * keep, axis=-1)
     p = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
     cap = jax.nn.one_hot(p, capacity, dtype=probs.dtype)
     combine = g[:, None, None] * keep[:, :, None] * cap[:, None, :]
-    return combine, combine > 0.0, aux
+    return combine, combine > 0.0, aux, drop_fraction
 
 
 class ExpertFFN(Layer):
     """Batched expert FFN: weights [E, in, hidden], [E, hidden, in] with
     the expert dim sharded over ``expert_axis``."""
 
-    def __init__(self, num_experts, d_model, d_hidden, expert_axis="fsdp",
+    def __init__(self, num_experts, d_model, d_hidden, expert_axis="ep",
                  activation="gelu", init_std=0.02):
         super().__init__()
         init = I.Normal(0.0, init_std)
@@ -133,7 +142,7 @@ class MoELayer(Layer):
         gate: str = "gshard",
         top_k: int = 2,
         capacity_factor: float = 1.25,
-        expert_axis: str = "fsdp",
+        expert_axis: str = "ep",
         aux_loss_weight: float = 1e-2,
     ):
         super().__init__()
@@ -147,10 +156,12 @@ class MoELayer(Layer):
             (d_model, num_experts),
             default_initializer=I.Normal(0.0, 0.02),
         )
+        self.expert_axis = expert_axis
         self.experts = ExpertFFN(
             num_experts, d_model, d_hidden or 4 * d_model, expert_axis
         )
         self.last_aux_loss = 0.0
+        self.last_drop_fraction = 0.0  # scalar jnp: routed-but-dropped share
 
     def capacity(self, tokens: int) -> int:
         cap = int(self.capacity_factor * tokens * self.top_k / self.num_experts)
@@ -164,18 +175,95 @@ class MoELayer(Layer):
                   self.gate_weight.value.astype(jnp.float32))
         cap = self.capacity(tokens)
         if self.gate_type == "switch":
-            combine, dispatch, aux = _switch_gating(logits, cap)
+            combine, dispatch, aux, dropped = _switch_gating(logits, cap)
         else:
-            combine, dispatch, aux = _top2_gating(logits, cap)
+            combine, dispatch, aux, dropped = _top2_gating(logits, cap)
         combine = combine.astype(x.dtype)
         # dispatch: [t, e, c] x [t, m] -> [e, c, m]; GSPMD inserts the
         # token→expert all-to-all here (expert dim sharded)
         expert_in = jnp.einsum(
             "tec,tm->ecm", dispatch.astype(x.dtype), xf
         )
-        expert_in = shard_activation(expert_in, "fsdp", None, None)
+        expert_in = shard_activation(expert_in, self.expert_axis, None, None)
         expert_out = self.experts(expert_in)
-        expert_out = shard_activation(expert_out, "fsdp", None, None)
+        expert_out = shard_activation(expert_out, self.expert_axis, None, None)
         y = jnp.einsum("tec,ecm->tm", combine, expert_out)
         self.last_aux_loss = aux * self.aux_loss_weight
+        self.last_drop_fraction = dropped
+        return y.reshape(b, s, m), self.last_aux_loss
+
+
+def _dropless_topk_gating(logits, top_k: int):
+    """Top-k gating with NO capacity clamp: every routed token is
+    processed. Returns (expert_idx [t, k], gates [t, k], aux)."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, expert_idx = jax.lax.top_k(probs, top_k)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    # load-balance aux (GShard form on the top-1 assignment)
+    mask1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=probs.dtype)
+    aux = jnp.sum(jnp.mean(mask1, 0) * jnp.mean(probs, 0)) * e
+    return expert_idx, gates, aux
+
+
+def dropless_moe_apply(x, expert_idx, gates, w1, b1, w2, b2, act):
+    """MegaBlocks-style dropless dispatch, TPU-native form: sort the
+    (token, expert) assignments by expert and run ONE grouped matmul per
+    projection via ``jax.lax.ragged_dot`` — XLA's grouped-GEMM primitive
+    tiles the ragged group dim onto the MXU without materializing
+    one-hot dispatch tensors or dropping overflow tokens.
+
+    x: [t, m]; expert_idx/gates: [t, k]; w1: [E, m, h]; w2: [E, h, m].
+    Parity: the reference's dropless/"no-token-dropping" MoE modes
+    (incubate moe capacity_factor=None paths).
+    """
+    t, k = expert_idx.shape
+    E = w1.shape[0]
+    flat_e = expert_idx.reshape(-1)             # [t*k]
+    order = jnp.argsort(flat_e)                 # stable
+    inv = jnp.argsort(order)
+    xs = jnp.repeat(x, k, axis=0)[order]        # [t*k, m] sorted by expert
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    h = jax.lax.ragged_dot(xs, w1, group_sizes)
+    h = h + jnp.repeat(b1, group_sizes, axis=0,
+                       total_repeat_length=t * k)
+    h = act(h)
+    y = jax.lax.ragged_dot(h, w2, group_sizes)
+    y = y + jnp.repeat(b2, group_sizes, axis=0,
+                       total_repeat_length=t * k)
+    y = y[inv].reshape(t, k, -1)                # unsort, [t, k, m]
+    return jnp.sum(y * gates[..., None].astype(y.dtype), axis=1)
+
+
+class DroplessMoELayer(MoELayer):
+    """MoELayer with exact (no-drop) routing via grouped matmuls.
+
+    Tradeoff vs the capacity path: no token is ever dropped and no
+    [t, e, c] dispatch tensors exist, but the grouped matmul keeps the
+    expert weights unsharded along the expert dim (ragged_dot's group
+    dim cannot shard under GSPMD), so use the capacity path when
+    ep_degree > 1. last_drop_fraction is always 0 here by construction.
+    """
+
+    def __init__(self, *args, **kwargs):
+        # ragged_dot's group dim cannot shard under GSPMD: expert weights
+        # stay REPLICATED (spec None on the expert dim), never "ep" —
+        # otherwise every layer call would all-gather the one tensor EP
+        # exists to shard. Use the capacity MoELayer for ep_degree > 1.
+        kwargs["expert_axis"] = None
+        super().__init__(*args, **kwargs)
+
+    def forward(self, x):
+        b, s, m = x.shape
+        xf = x.reshape(b * s, m)
+        logits = (xf.astype(jnp.float32) @
+                  self.gate_weight.value.astype(jnp.float32))
+        expert_idx, gates, aux = _dropless_topk_gating(logits, self.top_k)
+        y = dropless_moe_apply(
+            xf, expert_idx, gates,
+            self.experts.w1.value, self.experts.b1.value,
+            self.experts.w2.value, self.experts.b2.value,
+            self.experts.act)
+        self.last_aux_loss = aux * self.aux_loss_weight
+        self.last_drop_fraction = jnp.zeros(())
         return y.reshape(b, s, m), self.last_aux_loss

@@ -704,9 +704,10 @@ class PipelineTrainStep:
         pre_names = self._seq_param_names(module.pre)
         post_names = self._seq_param_names(module.post)
         trunk_p = module.trunk.stage_params()
+        all_params = dict(module.named_parameters())
         self.params = {}
         for n in pre_names | post_names:
-            self.params[n] = dict(module.named_parameters())[n].value
+            self.params[n] = all_params[n].value
         for k, v in trunk_p.items():
             self.params[f"trunk.{k}"] = v
         self._pre_names, self._post_names = pre_names, post_names
@@ -750,6 +751,15 @@ class PipelineTrainStep:
         schedule = self.schedule
 
         def step_fn(params, opt_state, x, aux):
+            from .sharding import suppress_constraints
+
+            # GSPMD activation hints inside the model body cannot apply
+            # to pp-varying values in the manual shard_map region — trace
+            # the whole step with hints off
+            with suppress_constraints():
+                return _step_body(params, opt_state, x, aux)
+
+        def _step_body(params, opt_state, x, aux):
             first_params = {n: params[n] for n in self._pre_names}
             last_params = {n: params[n] for n in self._post_names}
             trunk_params = {

@@ -223,12 +223,33 @@ def _filter_spec_for_mesh(spec_entries, mesh: Mesh):
     return tuple(keep(e) for e in spec_entries)
 
 
+_suppress_var: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_suppress_constraints", default=False
+)
+
+
+@contextlib.contextmanager
+def suppress_constraints():
+    """Disable shard_activation hints while tracing — needed inside
+    manual-axis shard_map regions (the 1F1B pipeline): a GSPMD
+    with_sharding_constraint cannot be applied to a pp-varying value
+    against a mesh whose pp axis is Auto-typed. Constraints are hints;
+    GSPMD still propagates shardings from the operands without them."""
+    tok = _suppress_var.set(True)
+    try:
+        yield
+    finally:
+        _suppress_var.reset(tok)
+
+
 def shard_activation(x, *spec_entries):
     """with_sharding_constraint against the ambient mesh; no-op when no
-    mesh is active (single-device eager use). Axis names absent from the
-    mesh are dropped, so the same model code runs under any topology."""
+    mesh is active (single-device eager use) or when constraints are
+    suppressed (inside manual-axis pipeline bodies). Axis names absent
+    from the mesh are dropped, so the same model code runs under any
+    topology."""
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or _suppress_var.get():
         return x
     spec = _filter_spec_for_mesh(spec_entries, mesh)
     return jax.lax.with_sharding_constraint(

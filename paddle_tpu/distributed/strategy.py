@@ -23,7 +23,8 @@ class HybridConfig:
     pp_degree: int = 1  # pipeline parallel
     sharding_degree: int = 1  # ZeRO/FSDP axis
     sep_degree: int = 1  # Ulysses-style sequence/segment parallel
-    ep_degree: int = 1  # expert parallel (MoE); reuses sharding×sep devices
+    ep_degree: int = 1  # expert parallel (MoE) — dedicated "ep" mesh axis,
+    # composable with fsdp (EP×FSDP)
     cp_degree: int = 1  # ring-attention context parallel (alias onto sep axis
     # when both requested is unsupported)
 
@@ -33,6 +34,7 @@ class HybridConfig:
             * self.mp_degree
             * self.pp_degree
             * self.sharding_degree
+            * self.ep_degree
             * self.sep_degree
             * self.cp_degree
         )

@@ -24,7 +24,7 @@ from jax.sharding import Mesh
 
 from .strategy import DistributedStrategy
 
-AXIS_ORDER = ("dp", "pp", "fsdp", "sep", "tp")
+AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sep", "tp")
 
 _global_hcg: Optional["HybridCommunicateGroup"] = None
 
@@ -58,6 +58,7 @@ class HybridCommunicateGroup:
         tp: int = None,
         pp: int = None,
         fsdp: int = None,
+        ep: int = None,
         sep: int = None,
         rank: int = 0,
     ):
@@ -68,25 +69,30 @@ class HybridCommunicateGroup:
         self._tp = tp if tp is not None else h.mp_degree
         self._pp = pp if pp is not None else h.pp_degree
         self._fsdp = fsdp if fsdp is not None else h.sharding_degree
+        self._ep = ep if ep is not None else h.ep_degree
         self._sep = sep if sep is not None else h.sep_degree
 
         if devices is None:
             devices = jax.devices()
-        need = self._dp * self._pp * self._fsdp * self._sep * self._tp
+        need = (self._dp * self._pp * self._fsdp * self._ep
+                * self._sep * self._tp)
         if need == 0:
             raise ValueError("degrees must be >= 1")
         if len(devices) < need:
             raise ValueError(
                 f"need {need} devices for "
-                f"dp{self._dp}×pp{self._pp}×fsdp{self._fsdp}×sep{self._sep}"
+                f"dp{self._dp}×pp{self._pp}×fsdp{self._fsdp}"
+                f"×ep{self._ep}×sep{self._sep}"
                 f"×tp{self._tp}, have {len(devices)}"
             )
         if len(devices) > need and self._dp == h.dp_degree and dp is None:
             # absorb extra devices into dp (parity: launch auto-degree)
-            self._dp = len(devices) // (self._pp * self._fsdp * self._sep * self._tp)
-            need = self._dp * self._pp * self._fsdp * self._sep * self._tp
+            self._dp = len(devices) // (
+                self._pp * self._fsdp * self._ep * self._sep * self._tp)
+            need = (self._dp * self._pp * self._fsdp * self._ep
+                    * self._sep * self._tp)
         grid = np.array(devices[:need]).reshape(
-            self._dp, self._pp, self._fsdp, self._sep, self._tp
+            self._dp, self._pp, self._fsdp, self._ep, self._sep, self._tp
         )
         self.mesh = Mesh(grid, AXIS_ORDER)
         self.global_rank = rank
@@ -97,13 +103,14 @@ class HybridCommunicateGroup:
     # SPMD execution all coordinates exist simultaneously; these queries
     # serve host-side logic (data sharding, checkpoint naming, logging).
     def _coord(self) -> Tuple[int, ...]:
-        shape = (self._dp, self._pp, self._fsdp, self._sep, self._tp)
+        shape = (self._dp, self._pp, self._fsdp, self._ep,
+                 self._sep, self._tp)
         return tuple(np.unravel_index(self.global_rank % self.nranks, shape))
 
     def topology(self):
         return {
             "dp": self._dp, "pp": self._pp, "fsdp": self._fsdp,
-            "sep": self._sep, "tp": self._tp,
+            "ep": self._ep, "sep": self._sep, "tp": self._tp,
         }
 
     # fleet-parity queries ---------------------------------------------
@@ -125,27 +132,34 @@ class HybridCommunicateGroup:
     def get_sharding_parallel_rank(self):
         return self._coord()[2]
 
+    def get_expert_parallel_world_size(self):
+        return self._ep
+
+    def get_expert_parallel_rank(self):
+        return self._coord()[3]
+
     def get_sep_parallel_world_size(self):
         return self._sep
 
     def get_sep_parallel_rank(self):
-        return self._coord()[3]
+        return self._coord()[4]
 
     def get_model_parallel_world_size(self):
         return self._tp
 
     def get_model_parallel_rank(self):
-        return self._coord()[4]
+        return self._coord()[5]
 
     def _group(self, axis: str) -> CommGroup:
         sizes = self.topology()
-        coord = dict(zip(("dp", "pp", "fsdp", "sep", "tp"), self._coord()))
+        coord = dict(zip(AXIS_ORDER, self._coord()))
         size = sizes[axis]
         rank = coord[axis]
         # enumerate global ranks in this slice
-        shape = (self._dp, self._pp, self._fsdp, self._sep, self._tp)
-        idx = [coord[a] for a in ("dp", "pp", "fsdp", "sep", "tp")]
-        axis_i = ("dp", "pp", "fsdp", "sep", "tp").index(axis)
+        shape = (self._dp, self._pp, self._fsdp, self._ep,
+                 self._sep, self._tp)
+        idx = [coord[a] for a in AXIS_ORDER]
+        axis_i = AXIS_ORDER.index(axis)
         ranks = []
         for j in range(size):
             idx2 = list(idx)
@@ -168,6 +182,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_group(self):
         return self._group("sep")
 
+    def get_expert_parallel_group(self):
+        return self._group("ep")
+
     # is_first/last stage for PP scheduling
     @property
     def is_first_stage(self):
@@ -183,6 +200,7 @@ def build_mesh(
     dp: int = 1,
     pp: int = 1,
     fsdp: int = 1,
+    ep: int = 1,
     sep: int = 1,
     tp: int = 1,
     devices=None,
@@ -190,8 +208,8 @@ def build_mesh(
     """Direct mesh construction for code that doesn't need the HCG shim."""
     if devices is None:
         devices = jax.devices()
-    need = dp * pp * fsdp * sep * tp
-    grid = np.array(devices[:need]).reshape(dp, pp, fsdp, sep, tp)
+    need = dp * pp * fsdp * ep * sep * tp
+    grid = np.array(devices[:need]).reshape(dp, pp, fsdp, ep, sep, tp)
     return Mesh(grid, AXIS_ORDER)
 
 

@@ -107,6 +107,7 @@ class ContinuousBatchingEngine:
                 cfg.max_slots, cfg.max_len, dtype=cfg.cache_dtype)
 
         self._decode_c = None
+        self._decode_nc = None
         self._prefill_c = None
         self._insert_c = None
         self._scatter_c = None
@@ -136,14 +137,23 @@ class ContinuousBatchingEngine:
         return self.cfg.max_len
 
     def _prefill(self):
-        # one jitted fn serves every bucket: jit specializes per shape
+        # one jitted fn serves every bucket: jit specializes per shape.
+        # Samples the first token IN-JIT so only a scalar crosses to the
+        # host — never the [1, bucket, vocab] logits tensor.
         if self._prefill_c is None:
-            def fn(params, ids, caches):
+            def fn(params, ids, caches, last_idx, key):
                 pos = jnp.broadcast_to(
                     jnp.arange(ids.shape[1])[None, :], ids.shape)
-                return functional_call(self.model, params, ids,
-                                       position_ids=pos, kv_caches=caches,
-                                       cache_index=0)
+                logits, filled = functional_call(
+                    self.model, params, ids, position_ids=pos,
+                    kv_caches=caches, cache_index=0)
+                last = logits[0, last_idx]
+                if self.cfg.greedy:
+                    first = jnp.argmax(last)
+                else:
+                    first = jax.random.categorical(
+                        key, last / self.cfg.temperature)
+                return first, filled
             self._prefill_c = jax.jit(fn)
         return self._prefill_c
 
@@ -221,6 +231,57 @@ class ContinuousBatchingEngine:
             self._decode_c = jax.jit(fn, donate_argnums=(2,))
         return self._decode_c
 
+    def _decode_n(self):
+        """K decode steps fused into one device program (lax.scan): the
+        sampled token feeds the next step ON DEVICE; the host syncs once
+        per K tokens instead of per token. K is FIXED at
+        ``cfg.decode_chunk``-or-caller's max_chunk so exactly one program
+        ever compiles; per-slot ``budget`` (a traced vector) freezes a
+        slot once it has produced its remaining tokens — its length stops
+        advancing, so overflow steps rewrite the same in-allocation cache
+        position with discarded garbage. Inactive slots likewise never
+        advance (their writes land in the slot's own row / the paged sink
+        page, both overwritten or freed at admission)."""
+        if self._decode_nc is None:
+            paged = self.cfg.paged
+
+            def fn(params, toks, caches, lens, active, budget, bt, key, K):
+                def one(carry, k):
+                    toks, caches, lens = carry
+                    if paged:
+                        state = PagedState(block_tables=bt, seq_lens=lens)
+                        kv = [(c, state) for c in caches]
+                    else:
+                        kv = caches
+                    logits, new_kv = functional_call(
+                        self.model, params, toks, position_ids=lens[:, None],
+                        kv_caches=kv, cache_index=lens)
+                    logits = logits[:, -1, :]
+                    if self.cfg.greedy:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    else:
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(key, k),
+                            logits / self.cfg.temperature, axis=-1)
+                    nxt = nxt.astype(toks.dtype)
+                    if paged:
+                        new_caches = [c for c, _ in new_kv]
+                    else:
+                        new_caches = new_kv
+                    advance = active & (k < budget)
+                    new_lens = lens + advance.astype(lens.dtype)
+                    new_toks = jnp.where(advance[:, None], nxt[:, None],
+                                         toks)
+                    return (new_toks, new_caches, new_lens), nxt
+
+                (toks, caches, lens), toks_all = jax.lax.scan(
+                    one, (toks, caches, lens), jnp.arange(K))
+                return toks_all, caches, lens
+
+            self._decode_nc = jax.jit(
+                fn, static_argnums=(8,), donate_argnums=(2,))
+        return self._decode_nc
+
     # ---------------- scheduling ----------------
     def _admit(self):
         while self._queue and self._free_slots():
@@ -246,8 +307,10 @@ class ContinuousBatchingEngine:
             padded[0, :n] = req.prompt
             one_caches = self.model.init_kv_caches(
                 1, bucket, dtype=self.cfg.cache_dtype)
-            logits, filled = self._prefill()(
-                self.params, jnp.asarray(padded, jnp.int32), one_caches)
+            self._key, sub = jax.random.split(self._key)
+            first_dev, filled = self._prefill()(
+                self.params, jnp.asarray(padded, jnp.int32), one_caches,
+                n - 1, sub)
             if self.cfg.paged:
                 self.layer_caches = self._scatter_paged()(
                     self.layer_caches, filled,
@@ -255,12 +318,7 @@ class ContinuousBatchingEngine:
             else:
                 self.caches = self._insert_contig()(
                     self.caches, filled, slot)
-            if self.cfg.greedy:
-                first = int(jnp.argmax(logits[0, n - 1]))
-            else:
-                self._key, sub = jax.random.split(self._key)
-                first = int(jax.random.categorical(
-                    sub, logits[0, n - 1] / self.cfg.temperature))
+            first = int(first_dev)  # scalar transfer, not [bucket, vocab]
             req.ttft_ms = (time.perf_counter() - req._submit_t) * 1e3
             req.output.append(first)
             req.slot = slot
@@ -314,12 +372,69 @@ class ContinuousBatchingEngine:
             self._maybe_finish(slot, tok)
         return True
 
+    def _slot_budgets(self) -> np.ndarray:
+        """Per-slot remaining token budget (max_new_tokens and max_len
+        caps) — frozen slots stop advancing inside the fixed-K chunk."""
+        budget = np.zeros((self.cfg.max_slots,), np.int32)
+        for slot in range(self.cfg.max_slots):
+            if not self.active[slot]:
+                continue
+            req = self._slot_req[slot]
+            budget[slot] = max(0, min(
+                req.max_new_tokens - len(req.output),
+                self.cfg.max_len - 1 - int(self.seq_lens[slot])))
+        return budget
+
+    def step_chunk(self, max_chunk: int = 8) -> bool:
+        """Admit, then run ``max_chunk`` decode steps in ONE device
+        program — the host reads tokens back once per chunk instead of
+        per token (the per-token device→host sync was the round-2 decode
+        bottleneck). K is fixed, so exactly one decode program compiles
+        for the engine's lifetime; per-slot budgets freeze finished slots
+        device-side and the host discards EOS/budget overshoot."""
+        self._admit()
+        if not self.active.any():
+            return bool(self._queue)
+        K = max_chunk
+        budget = self._slot_budgets()
+        self._key, sub = jax.random.split(self._key)
+        toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
+        lens = jnp.asarray(self.seq_lens, jnp.int32)
+        act = jnp.asarray(self.active)
+        bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
+              else jnp.zeros((1,), jnp.int32))
+        caches = self.layer_caches if self.cfg.paged else self.caches
+        toks_all, caches, _ = self._decode_n()(
+            self.params, toks, caches, lens, act, jnp.asarray(budget),
+            bt, sub, K)
+        if self.cfg.paged:
+            self.layer_caches = caches
+        else:
+            self.caches = caches
+        toks_np = np.asarray(toks_all)  # ONE sync for K tokens
+        for k in range(K):
+            for slot in range(self.cfg.max_slots):
+                if not self.active[slot] or k >= budget[slot]:
+                    continue
+                tok = int(toks_np[k, slot])
+                self._slot_req[slot].output.append(tok)
+                self.seq_lens[slot] += 1
+                self.last_tok[slot] = tok
+                self._maybe_finish(slot, tok)
+        return True
+
     def run(self, prompts: Sequence, max_new_tokens: int = 32,
-            eos_token_id: Optional[int] = None) -> List[Request]:
+            eos_token_id: Optional[int] = None,
+            max_chunk: int = 8) -> List[Request]:
         """Submit all prompts, drive until completion, return Requests
-        in submission order (each carries .output and .ttft_ms)."""
+        in submission order (each carries .output and .ttft_ms).
+
+        Drives ``step_chunk`` so decode syncs the host once per
+        ``max_chunk`` tokens; admission (prefill) happens between chunks
+        while the previous chunk's tokens are being consumed."""
         rids = [self.add_request(p, max_new_tokens, eos_token_id)
                 for p in prompts]
-        while self.step() or self._queue or self.active.any():
+        while self.step_chunk(max_chunk) or self._queue or \
+                self.active.any():
             pass
         return [self._finished[r] for r in rids]

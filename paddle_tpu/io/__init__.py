@@ -164,10 +164,39 @@ def default_collate_fn(batch):
     return np.stack([np.asarray(b) for b in batch])
 
 
+# --- process-worker plumbing (module-level: fork children resolve these
+# by reference; also keeps them picklable if a spawn context is ever used) ---
+_worker_state = {}
+
+
+def _proc_worker_init(dataset, collate_fn):
+    # Workers are pure-numpy sample loaders and must stay that way: fork
+    # children inherit the parent's already-initialized jax backend, so
+    # touching jax in a worker is undefined (the env vars below only
+    # protect a worker whose first jax import happens post-fork).
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _worker_state["dataset"] = dataset
+    _worker_state["collate"] = collate_fn
+
+
+def _proc_load_batch(idxs):
+    ds = _worker_state["dataset"]
+    return _worker_state["collate"]([ds[i] for i in idxs])
+
+
 class DataLoader:
     """Parity: paddle.io.DataLoader. num_workers>0 uses a thread pool for
-    sample loading (python workloads here are numpy-light; full process
-    workers can be layered on later without API change)."""
+    sample loading by default (numpy-heavy transforms release the GIL);
+    ``use_process_workers=True`` switches to real OS processes (fork
+    context — workers inherit the dataset and run pure-Python/numpy
+    sample loading only, never touching the device runtime), the
+    reference's multiprocess DataLoader semantics for Python-bound
+    decode pipelines (PIL/augmentation) that a thread pool cannot
+    parallelize. Fork (not spawn) so scripts run from stdin/REPL work —
+    spawn would re-import an unimportable __main__."""
 
     def __init__(
         self,
@@ -179,12 +208,14 @@ class DataLoader:
         collate_fn: Optional[Callable] = None,
         num_workers: int = 0,
         prefetch_factor: int = 2,
+        use_process_workers: bool = False,
         **kw,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_process_workers = use_process_workers
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -218,18 +249,33 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self._load_batch(idxs)
             return
-        # threaded prefetch pipeline
-        from concurrent.futures import ThreadPoolExecutor
+        # prefetch pipeline over a worker pool (threads or processes)
+        if self.use_process_workers:
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pool_cm = ProcessPoolExecutor(
+                max_workers=self.num_workers,
+                mp_context=mp.get_context("fork"),
+                initializer=_proc_worker_init,
+                initargs=(self.dataset, self.collate_fn),
+            )
+            submit = _proc_load_batch
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool_cm = ThreadPoolExecutor(max_workers=self.num_workers)
+            submit = self._load_batch
+
+        with pool_cm as pool:
             pending: "queue.Queue" = queue.Queue()
             it = iter(self.batch_sampler)
             depth = self.num_workers * self.prefetch_factor
             for idxs in itertools.islice(it, depth):
-                pending.put(pool.submit(self._load_batch, idxs))
+                pending.put(pool.submit(submit, idxs))
             for idxs in it:
                 yield pending.get().result()
-                pending.put(pool.submit(self._load_batch, idxs))
+                pending.put(pool.submit(submit, idxs))
             while not pending.empty():
                 yield pending.get().result()
 

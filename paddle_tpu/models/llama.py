@@ -347,3 +347,60 @@ class LlamaForCausalLM(Layer):
             )
             for _ in range(cfg.num_hidden_layers)
         ]
+
+
+class LlamaPipeBlock(Layer):
+    """Single-activation decoder layer for the SPMD pipeline trunk:
+    recomputes the (tiny, XLA-constant-folded) rope tables internally so
+    the pipelined inter-stage activation is just the hidden states —
+    parity with fleet's LlamaForCausalLMPipe per-stage blocks, which
+    likewise rebuild rotary tables per stage rather than shipping them."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.block = LlamaDecoderLayer(config)
+
+    def forward(self, x):
+        cfg = self.config
+        cos, sin = rope_frequencies(
+            cfg.head_dim, x.shape[1], cfg.rope_theta)
+        return self.block(x, cos, sin)
+
+
+def llama_pipeline_module(config: LlamaConfig, num_stages: int):
+    """Build the flagship model as a PipelineModule (parity:
+    PaddleNLP LlamaForCausalLMPipe): tied/untied embedding + L decoder
+    blocks (the homogeneous trunk) + final norm + lm head. Drive with
+    ``distributed.pipeline.PipelineTrainStep`` under a pp mesh; the loss
+    head runs on the last stage inside the 1F1B schedule."""
+    from ..distributed.pipeline import (
+        LayerDesc,
+        PipelineModule,
+        SharedLayerDesc,
+    )
+    from ..nn.layer.norm import RMSNorm as _RMSNorm
+
+    init = I.Normal(0.0, config.initializer_range)
+    if config.tie_word_embeddings:
+        embed = SharedLayerDesc(
+            "embed", VocabParallelEmbedding, config.vocab_size,
+            config.hidden_size, weight_attr=init)
+        head = SharedLayerDesc(
+            "embed", VocabParallelEmbedding, config.vocab_size,
+            config.hidden_size, weight_attr=init,
+            forward_func=lambda layer, x: x @ layer.weight.value.T)
+    else:
+        embed = LayerDesc(VocabParallelEmbedding, config.vocab_size,
+                          config.hidden_size, weight_attr=init)
+        head = LayerDesc(ColumnParallelLinear, config.hidden_size,
+                         config.vocab_size, weight_attr=init,
+                         has_bias=False)
+    descs = (
+        [embed]
+        + [LayerDesc(LlamaPipeBlock, config)
+           for _ in range(config.num_hidden_layers)]
+        + [LayerDesc(_RMSNorm, config.hidden_size, config.rms_norm_eps),
+           head]
+    )
+    return PipelineModule(descs, num_stages=num_stages)

@@ -156,24 +156,37 @@ class Profiler:
         self.stop()
 
     # ------------------------------------------------------------------
-    def summary(self, sorted_by: str = "ms") -> str:
-        """Step-time table (device-op tables live in the exported trace,
-        viewable in Perfetto/TensorBoard)."""
+    def summary(self, sorted_by: str = "ms", op_top: int = 20) -> str:
+        """Step-time table + per-op DEVICE-time table parsed from the
+        exported trace (parity: paddle.profiler summary's operator/kernel
+        views; see profiler.xplane)."""
+        lines = []
         if not self._records:
-            return "no steps recorded"
-        times = [r.ms for r in self._records]
-        import numpy as np
+            lines.append("no steps recorded")
+        else:
+            times = [r.ms for r in self._records]
+            import numpy as np
 
-        lines = [
-            "step time summary (ms)",
-            f"  steps: {len(times)}",
-            f"  mean:  {np.mean(times):.2f}",
-            f"  p50:   {np.percentile(times, 50):.2f}",
-            f"  p90:   {np.percentile(times, 90):.2f}",
-            f"  min:   {np.min(times):.2f}",
-            f"  max:   {np.max(times):.2f}",
-            f"  trace dir: {self.log_dir}",
-        ]
+            lines += [
+                "step time summary (ms)",
+                f"  steps: {len(times)}",
+                f"  mean:  {np.mean(times):.2f}",
+                f"  p50:   {np.percentile(times, 50):.2f}",
+                f"  p90:   {np.percentile(times, 90):.2f}",
+                f"  min:   {np.min(times):.2f}",
+                f"  max:   {np.max(times):.2f}",
+                f"  trace dir: {self.log_dir}",
+            ]
+        if not self.timer_only:
+            from . import xplane
+
+            try:
+                ops = xplane.device_op_summary(self.log_dir)
+            except Exception as e:  # a torn trace must not kill summary
+                ops = None
+                lines.append(f"(trace parse failed: {e!r})")
+            if ops is not None:
+                lines.append(xplane.format_summary(ops, top=op_top))
         return "\n".join(lines)
 
 

@@ -1,0 +1,181 @@
+"""Device-op summary tables from the XLA trace.
+
+Parity: the reference's profiler statistics module
+(paddle/fluid/platform/profiler/ — ``ChromeTracingLogger`` +
+``StatisticsEngine`` building per-op/kernel device-time tables merged
+from the host and CUPTI timelines) surfaced via
+``paddle.profiler.Profiler.summary()``.
+
+TPU-native: ``jax.profiler`` already merges host + device into one
+exported trace (``*.trace.json.gz`` chrome format next to the
+``.xplane.pb``). This module aggregates that trace's DEVICE plane events
+into the tables the reference prints: per-op total device ms, count, %,
+and a category rollup (matmul/conv vs collective vs copy vs other) —
+the numbers MFU attribution needs ("what fraction of step time is
+attention vs collectives").
+
+CPU-backend traces carry no per-HLO-op device events (only runtime
+threads), so there the summary degrades gracefully with a note.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "allreduce", "allgather", "collectivepermute",
+    "send", "recv",
+)
+_MATMUL_MARKERS = ("dot", "conv", "matmul", "mxu", "gemm", "einsum")
+_COPY_MARKERS = ("copy", "transpose", "reshape", "bitcast", "dynamic-slice",
+                 "dynamic-update-slice", "concatenate", "pad", "slice")
+_INFEED_MARKERS = ("infeed", "outfeed", "host-transfer")
+
+
+def categorize(op_name: str) -> str:
+    n = op_name.lower()
+    if any(m in n for m in _COLLECTIVE_MARKERS):
+        return "collective"
+    if any(m in n for m in _MATMUL_MARKERS):
+        return "matmul/conv"
+    if any(m in n for m in _INFEED_MARKERS):
+        return "infeed/outfeed"
+    if any(m in n for m in _COPY_MARKERS):
+        return "copy/layout"
+    return "other"
+
+
+@dataclass
+class OpRow:
+    name: str
+    total_ms: float
+    count: int
+    category: str
+
+    @property
+    def avg_ms(self) -> float:
+        return self.total_ms / max(self.count, 1)
+
+
+@dataclass
+class DeviceOpSummary:
+    plane: str
+    rows: List[OpRow] = field(default_factory=list)
+
+    @property
+    def total_ms(self) -> float:
+        return sum(r.total_ms for r in self.rows)
+
+    def by_category(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for r in self.rows:
+            out[r.category] = out.get(r.category, 0.0) + r.total_ms
+        return out
+
+
+def latest_trace_file(log_dir: str) -> Optional[str]:
+    """Newest chrome-format trace under a jax.profiler log dir."""
+    pattern = os.path.join(log_dir, "plugins", "profile", "*",
+                           "*.trace.json.gz")
+    files = glob.glob(pattern)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def parse_trace(path: str):
+    """-> (process names {pid: name}, thread names {(pid,tid): name},
+    complete events list)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pids: Dict[int, str] = {}
+    tids: Dict[tuple, str] = {}
+    complete = []
+    for e in events:
+        ph = e.get("ph")
+        if ph == "M":
+            if e.get("name") == "process_name":
+                pids[e["pid"]] = e.get("args", {}).get("name", "")
+            elif e.get("name") == "thread_name":
+                tids[(e["pid"], e.get("tid"))] = e.get(
+                    "args", {}).get("name", "")
+        elif ph == "X":
+            complete.append(e)
+    return pids, tids, complete
+
+
+def device_op_summary(log_dir: str, top: int = 0
+                      ) -> Optional[DeviceOpSummary]:
+    """Aggregate the newest trace's device-plane op events.
+
+    Device planes are processes named ``/device:TPU:N`` (or GPU). Within
+    them, "XLA Ops"-style lines carry one complete event per executed HLO
+    op with its device duration — the exact payload the reference reads
+    from CUPTI. Returns None when no trace exists; a summary with empty
+    rows when a trace exists but carries no device plane (CPU backend).
+    """
+    path = latest_trace_file(log_dir)
+    if path is None:
+        return None
+    pids, tids, events = parse_trace(path)
+    dev_pids = {p for p, name in pids.items()
+                if name.startswith("/device:") and "CPU" not in name}
+    if not dev_pids:
+        return DeviceOpSummary(plane="(no device plane — CPU trace)")
+    # prefer XLA-op lines; fall back to every line on the device plane
+    op_keys = {k for k, name in tids.items()
+               if k[0] in dev_pids and "xla op" in name.lower()}
+    use_all = not op_keys
+    agg: Dict[str, OpRow] = {}
+    for e in events:
+        pid = e.get("pid")
+        if pid not in dev_pids:
+            continue
+        key = (pid, e.get("tid"))
+        if not use_all and key not in op_keys:
+            continue
+        tname = tids.get(key, "").lower()
+        if use_all and ("step" in tname or "framework" in tname):
+            continue  # step markers duplicate the op time underneath
+        name = e.get("name", "?")
+        dur_ms = float(e.get("dur", 0.0)) / 1e3  # chrome dur is in us
+        row = agg.get(name)
+        if row is None:
+            agg[name] = OpRow(name, dur_ms, 1, categorize(name))
+        else:
+            row.total_ms += dur_ms
+            row.count += 1
+    rows = sorted(agg.values(), key=lambda r: -r.total_ms)
+    if top:
+        rows = rows[:top]
+    plane = ", ".join(sorted(pids[p] for p in dev_pids))
+    return DeviceOpSummary(plane=plane, rows=rows)
+
+
+def format_summary(s: DeviceOpSummary, top: int = 20) -> str:
+    if not s.rows:
+        return f"device op summary: no device op events ({s.plane})"
+    total = s.total_ms
+    lines = [
+        f"device op summary — plane {s.plane}, total {total:.3f} ms",
+        f"{'op':48s} {'total ms':>10s} {'%':>6s} {'count':>7s} "
+        f"{'avg ms':>9s}  category",
+    ]
+    for r in s.rows[:top]:
+        pct = 100.0 * r.total_ms / total if total else 0.0
+        name = r.name if len(r.name) <= 48 else r.name[:45] + "..."
+        lines.append(
+            f"{name:48s} {r.total_ms:10.3f} {pct:6.1f} {r.count:7d} "
+            f"{r.avg_ms:9.4f}  {r.category}"
+        )
+    lines.append("category rollup:")
+    for cat, ms in sorted(s.by_category().items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * ms / total if total else 0.0
+        lines.append(f"  {cat:16s} {ms:10.3f} ms {pct:6.1f}%")
+    return "\n".join(lines)

@@ -235,3 +235,13 @@ def test_crash_between_commit_renames_recovers(tmp_path):
     loaded = ckpt.load_state_dict(path)
     np.testing.assert_allclose(np.asarray(loaded["w"]), 1.0)
     assert not os.path.isdir(path + ".old")
+
+
+def test_dataloader_process_workers():
+    """Real OS-process workers (fork context): order preserved, data
+    intact — the reference's multiprocess DataLoader semantics."""
+    ds = io.TensorDataset(np.arange(24, dtype=np.float32) * 3)
+    dl = io.DataLoader(ds, batch_size=4, num_workers=2,
+                       use_process_workers=True)
+    got = np.concatenate([b[0] for b in dl])
+    np.testing.assert_array_equal(got, np.arange(24) * 3)

@@ -32,7 +32,7 @@ def _strategy(**hybrid):
 def test_topology_queries():
     s = _strategy(dp_degree=2, mp_degree=2, sharding_degree=2)
     hcg = dist.HybridCommunicateGroup(s)
-    assert hcg.mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "sep": 1, "tp": 2}
+    assert hcg.mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sep": 1, "tp": 2}
     assert hcg.get_model_parallel_world_size() == 2
     assert hcg.get_data_parallel_world_size() == 2
     g = hcg.get_model_parallel_group()
