@@ -213,6 +213,35 @@ def bench_llama_train(tpu_diags):
         "mfu_est": round(mfu, 4),
         "loss": float(loss),
     }
+    if platform == "tpu":
+        # one profiled step → per-op device-time attribution for the MFU
+        # number (matmul vs collective vs copy); best-effort
+        try:
+            import tempfile
+
+            from paddle_tpu.profiler import xplane
+
+            tracedir = tempfile.mkdtemp(prefix="bench_trace_")
+            jax.profiler.start_trace(tracedir)
+            ts.run(data).block_until_ready()
+            jax.profiler.stop_trace()
+            ops = xplane.device_op_summary(tracedir)
+            if ops is not None and ops.rows:
+                total = ops.total_ms
+                extra["op_summary"] = {
+                    "total_device_ms": round(total, 2),
+                    "categories": {
+                        k: round(100.0 * v / total, 1)
+                        for k, v in ops.by_category().items()
+                    },
+                    "top_ops": [
+                        {"name": r.name[:60], "ms": round(r.total_ms, 2),
+                         "count": r.count}
+                        for r in ops.rows[:8]
+                    ],
+                }
+        except Exception as e:
+            extra["op_summary"] = {"error": repr(e)}
     if tpu_diags:
         extra["tpu_probe"] = tpu_diags
     name = (f"llama{n_params // 10**6}m_train_tokens_per_sec_per_chip"
@@ -231,30 +260,64 @@ BASELINE_PATH = os.path.join(os.path.dirname(__file__),
                              "BENCH_BASELINE.json")
 
 
+def _load_baseline():
+    try:
+        with open(BASELINE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 def _maybe_write_baseline(result):
-    """First green TPU measurement becomes the recorded baseline, so
-    vs_baseline is a real round-over-round regression signal."""
+    """First green TPU measurement (headline + any green secondaries)
+    becomes the recorded baseline, so vs_baseline is a real
+    round-over-round regression signal — per config, not just the
+    headline."""
     if result.get("unit") == "error":
         return
     if result.get("extra", {}).get("platform") != "tpu":
         return
-    if not os.path.exists(BASELINE_PATH):
+    base = _load_baseline() or {}
+    changed = False
+    if "value" not in base:
+        base.update({"metric": result["metric"],
+                     "value": result["value"],
+                     "unit": result["unit"],
+                     "extra": {k: v for k, v in
+                               result.get("extra", {}).items()
+                               if k != "secondary"}})
+        changed = True
+    secondary = result.get("extra", {}).get("secondary", {})
+    base_sec = base.setdefault("secondary", {})
+    for name, r in secondary.items():
+        if (name not in base_sec and r.get("unit") not in
+                ("error", "skipped") and
+                r.get("extra", {}).get("platform") == "tpu"):
+            base_sec[name] = {"metric": r["metric"], "value": r["value"],
+                              "unit": r["unit"]}
+            changed = True
+    if changed:
         with open(BASELINE_PATH, "w") as f:
-            json.dump({"metric": result["metric"],
-                       "value": result["value"],
-                       "unit": result["unit"],
-                       "extra": result.get("extra", {})}, f, indent=1)
+            json.dump(base, f, indent=1)
 
 
 def _apply_baseline_ratio(result):
-    if result.get("extra", {}).get("platform") != "tpu":
+    """Fill vs_baseline for the headline and each secondary from the
+    recorded first-green-run values (TPU only)."""
+    base = _load_baseline()
+    if base is None:
         return
-    try:
-        with open(BASELINE_PATH) as f:
+    if result.get("extra", {}).get("platform") == "tpu":
+        try:
             result["vs_baseline"] = round(
-                result["value"] / float(json.load(f)["value"]), 3)
-    except Exception:
-        pass
+                result["value"] / float(base["value"]), 3)
+        except Exception:
+            pass
+    for name, r in result.get("extra", {}).get("secondary", {}).items():
+        b = base.get("secondary", {}).get(name)
+        if (b and r.get("extra", {}).get("platform") == "tpu"
+                and r.get("value")):
+            r["vs_baseline"] = round(r["value"] / float(b["value"]), 3)
 
 
 SECONDARY_TIMEOUT = 420   # per config; each compiles its own programs
@@ -355,11 +418,11 @@ def main():
                 {"tpu_unavailable": True, "attempts": diags})
 
     result = _run_one_config("llama", env, HEADLINE_TIMEOUT)
-    _maybe_write_baseline(result)
-    _apply_baseline_ratio(result)
     if "--no-secondary" not in argv:
         result.setdefault("extra", {})["secondary"] = \
             _run_secondary_configs(env)
+    _maybe_write_baseline(result)
+    _apply_baseline_ratio(result)
     print(json.dumps(result))
 
 

@@ -342,6 +342,10 @@ def load_state_dict(
     """
     import jax.numpy as jnp
 
+    # rank 0 heals any crashed-commit state first; the barrier keeps the
+    # other ranks from racing the rename on a shared filesystem
+    _recover(path)
+    _barrier("load.recover")
     if not is_committed(path):
         raise FileNotFoundError(
             f"{path!r} is not a committed checkpoint (no "

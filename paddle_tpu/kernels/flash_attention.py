@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _reference_attention(q, k, v, causal=False, scale=None, bias=None):
+def _reference_attention(q, k, v, causal=False, scale=None, bias=None,
+                         window=0):
     b, sq, hq, d = q.shape
     hk = k.shape[2]
     if hq != hk:
@@ -32,6 +33,10 @@ def _reference_attention(q, k, v, causal=False, scale=None, bias=None):
     if causal:
         sk = k.shape[1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window:
+            mask = jnp.logical_and(
+                mask, jnp.triu(jnp.ones((sq, sk), bool),
+                               k=sk - sq - window + 1))
         logits = jnp.where(mask, logits, jnp.float32(-1e30))
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
@@ -72,12 +77,17 @@ def flash_attention(
     training: bool = True,
     scale: Optional[float] = None,
     segment_ids=None,
+    window_size: int = 0,
 ):
     """[batch, seq, heads, head_dim] attention. ``segment_ids`` gives the
     varlen/packed-sequence form (parity: flash_attn_varlen). Dropout
     applies only on the fallback path (flash+dropout is rare in practice;
     parity with paddle's flash_attn dropout is provided via the reference
     path)."""
+    if window_size and not causal:
+        # enforced up front so EVERY path (pallas, dense, segment,
+        # dropout) rejects it identically instead of silently ignoring
+        raise ValueError("window_size requires causal=True")
     if dropout_p > 0.0 and training:
         from ..nn import functional as F
 
@@ -89,6 +99,12 @@ def flash_attention(
                 seg_q = seg_kv = segment_ids
             attn_mask = (seg_q[:, None, :, None]
                          == seg_kv[:, None, None, :])
+        if window_size:
+            sq, sk = q.shape[1], k.shape[1]
+            q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+            band = (q_pos - jnp.arange(sk)[None, :]) < window_size
+            band = band[None, None]
+            attn_mask = band if attn_mask is None else (attn_mask & band)
         return F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
             is_causal=causal, scale=scale, training=training,
@@ -97,17 +113,20 @@ def flash_attention(
         try:
             return _pallas_flash_attention(q, k, v, causal=causal,
                                            scale=scale,
-                                           segment_ids=segment_ids)
+                                           segment_ids=segment_ids,
+                                           window=window_size)
         except Exception:
             pass
     if segment_ids is not None:
         return _segment_reference_attention(q, k, v, segment_ids,
-                                            causal=causal, scale=scale)
-    return _reference_attention(q, k, v, causal=causal, scale=scale)
+                                            causal=causal, scale=scale,
+                                            window=window_size)
+    return _reference_attention(q, k, v, causal=causal, scale=scale,
+                                window=window_size)
 
 
 def _segment_reference_attention(q, k, v, segment_ids, causal=False,
-                                 scale=None):
+                                 scale=None, window=0):
     if isinstance(segment_ids, (tuple, list)):
         seg_q, seg_kv = segment_ids
     else:
@@ -115,15 +134,15 @@ def _segment_reference_attention(q, k, v, segment_ids, causal=False,
     bias_mask = seg_q[:, None, :, None] == seg_kv[:, None, None, :]
     bias = jnp.where(bias_mask, 0.0, jnp.float32(-1e30))
     return _reference_attention(q, k, v, causal=causal, scale=scale,
-                                bias=bias)
+                                bias=bias, window=window)
 
 
 # ---------------------------------------------------------------------------
 # Pallas implementation
 # ---------------------------------------------------------------------------
 def _pallas_flash_attention(q, k, v, causal=False, scale=None,
-                            segment_ids=None):
+                            segment_ids=None, window=0):
     from .pallas_attention import mha as pallas_mha
 
     return pallas_mha(q, k, v, causal=causal, sm_scale=scale,
-                      segment_ids=segment_ids)
+                      segment_ids=segment_ids, window=window)

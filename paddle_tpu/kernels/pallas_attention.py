@@ -67,14 +67,34 @@ def _causal_i_min(j: int, q_block: int, k_block: int):
     return (j * k_block) // q_block
 
 
-def _block_mask(s, qb_idx, kb_idx, q_block, k_block, causal, q_seg, k_seg):
-    """Apply causal/segment masking to a [q_block, k_block] score tile.
+def _window_j_min(i: int, q_block: int, k_block: int, window: int):
+    """First kv block with any in-window element for q block i
+    (sliding window: only keys with q_pos − k_pos < window count; the
+    earliest relevant k_pos for this q block is i·q_block − window + 1).
+    """
+    lo = i * q_block - window + 1
+    return jnp.maximum(lo, 0) // k_block
+
+
+def _window_i_max(j: int, q_block: int, k_block: int, window: int):
+    """Last q block with any in-window element for kv block j (largest
+    relevant q_pos is (j+1)·k_block − 1 + window − 1)."""
+    return ((j + 1) * k_block - 1 + window - 1) // q_block
+
+
+def _block_mask(s, qb_idx, kb_idx, q_block, k_block, causal, q_seg, k_seg,
+                window=0):
+    """Apply causal/sliding-window/segment masking to a
+    [q_block, k_block] score tile.
 
     Only called where it can matter: causal masking only on
     diagonal-straddling blocks (callers prune/skip fully-masked blocks).
+    ``window`` > 0 (Mistral-style local attention, parity: flash_attn
+    window_size) additionally masks keys more than window−1 positions
+    behind the query.
     """
     mask = None
-    if causal:
+    if causal or window:
         q_pos = qb_idx * q_block + jax.lax.broadcasted_iota(
             jnp.int32, (q_block, k_block), 0
         )
@@ -82,6 +102,8 @@ def _block_mask(s, qb_idx, kb_idx, q_block, k_block, causal, q_seg, k_seg):
             jnp.int32, (q_block, k_block), 1
         )
         mask = q_pos >= k_pos
+        if window:
+            mask = jnp.logical_and(mask, q_pos - k_pos < window)
     if q_seg is not None:
         seg = q_seg == k_seg  # [q_block, 1] == [1, k_block] -> broadcast
         mask = seg if mask is None else jnp.logical_and(mask, seg)
@@ -94,7 +116,7 @@ def _block_mask(s, qb_idx, kb_idx, q_block, k_block, causal, q_seg, k_seg):
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
-                with_lse, with_segments):
+                with_lse, with_segments, window):
     if with_segments:
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref, *out_refs = refs
     else:
@@ -123,8 +145,9 @@ def _fwd_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
         ) * sm_scale
         q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
         k_seg = kseg_ref[...][:1, :] if kseg_ref is not None else None
-        if causal or q_seg is not None:
-            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+        if causal or window or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg,
+                            k_seg, window)
 
         m_prev = m_scratch[:, :1]  # [q_block, 1]
         l_prev = l_scratch[:, :1]
@@ -143,9 +166,14 @@ def _fwd_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
         m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
         l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
 
-    # pruned iterations (causal, fully above the diagonal) do no work; the
-    # kv index map clamps their block index so they issue no DMA either.
-    if causal:
+    # pruned iterations (causal: fully above the diagonal; window:
+    # fully behind the window) do no work; the kv index map clamps their
+    # block index so they issue no DMA either.
+    if causal and window:
+        pl.when(jnp.logical_and(
+            j <= _causal_j_max(i, q_block, k_block),
+            j >= _window_j_min(i, q_block, k_block, window)))(_step)
+    elif causal:
         pl.when(j <= _causal_j_max(i, q_block, k_block))(_step)
     else:
         _step()
@@ -161,7 +189,7 @@ def _fwd_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
 
 
 def _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
-                  return_lse=False):
+                  return_lse=False, window=0):
     """q: [g, rep, sq, d]; k, v: [g, sk, d]; g = batch * kv_heads.
 
     qseg: [g, sq, LANES] int32 or None; kseg: [g, sk] int32 or None.
@@ -176,6 +204,8 @@ def _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
     def kv_index(b, r, i, j):
         if causal:
             j = jnp.minimum(j, _causal_j_max(i, q_block, k_block))
+        if window:
+            j = jnp.maximum(j, _window_j_min(i, q_block, k_block, window))
         return (b, j, 0)
 
     q_spec = pl.BlockSpec((1, 1, q_block, d), lambda b, r, i, j: (b, r, i, 0))
@@ -204,7 +234,7 @@ def _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, q_block=q_block,
         k_block=k_block, n_kb=n_kb, with_lse=return_lse,
-        with_segments=qseg is not None,
+        with_segments=qseg is not None, window=window,
     )
     params = _params("parallel", "parallel", "parallel", "arbitrary")
     if not return_lse:
@@ -242,7 +272,7 @@ def _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
 # backward: dq pass (grid k-innermost, dq accumulates in VMEM scratch)
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
-                   with_segments):
+                   with_segments, window):
     if with_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
          kseg_ref, dq_ref, dq_scratch) = refs
@@ -271,8 +301,9 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal or q_seg is not None:
-            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+        if causal or window or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg,
+                            k_seg, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -284,7 +315,11 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
+    if causal and window:
+        pl.when(jnp.logical_and(
+            j <= _causal_j_max(i, q_block, k_block),
+            j >= _window_j_min(i, q_block, k_block, window)))(_step)
+    elif causal:
         pl.when(j <= _causal_j_max(i, q_block, k_block))(_step)
     else:
         _step()
@@ -299,7 +334,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
 # the GQA group-sum over rep happens in the same accumulator)
 # ---------------------------------------------------------------------------
 def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
-                    with_segments):
+                    with_segments, window):
     if with_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
          kseg_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
@@ -330,8 +365,9 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal or q_seg is not None:
-            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg, k_seg)
+        if causal or window or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg,
+                            k_seg, window)
         p = jnp.exp(s - lse)  # [q_block, k_block]
         # dv += p^T do
         dv_scratch[:] += jax.lax.dot_general(
@@ -348,7 +384,11 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
+    if causal and window:
+        pl.when(jnp.logical_and(
+            i >= _causal_i_min(j, q_block, k_block),
+            i <= _window_i_max(j, q_block, k_block, window)))(_step)
+    elif causal:
         pl.when(i >= _causal_i_min(j, q_block, k_block))(_step)
     else:
         _step()
@@ -360,7 +400,7 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
 
 
 def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
-                  q_block, k_block, dlse=None):
+                  q_block, k_block, dlse=None, window=0):
     g, rep, sq, d = q.shape
     sk = k.shape[1]
     n_qb = sq // q_block
@@ -380,6 +420,8 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
     def kv_index(b, r, i, j):
         if causal:
             j = jnp.minimum(j, _causal_j_max(i, q_block, k_block))
+        if window:
+            j = jnp.maximum(j, _window_j_min(i, q_block, k_block, window))
         return (b, j, 0)
 
     k_spec = pl.BlockSpec((1, k_block, d), kv_index)
@@ -396,7 +438,7 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             q_block=q_block, k_block=k_block, n_kb=n_kb,
-            with_segments=qseg is not None,
+            with_segments=qseg is not None, window=window,
         ),
         grid=(g, rep, n_qb, n_kb),
         in_specs=in_specs,
@@ -417,6 +459,8 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
     def q_index2(b, j, r, i):
         if causal:
             i = jnp.maximum(i, _causal_i_min(j, q_block, k_block))
+        if window:
+            i = jnp.minimum(i, _window_i_max(j, q_block, k_block, window))
         return (b, r, i, 0)
 
     q_spec2 = pl.BlockSpec((1, 1, q_block, d), q_index2)
@@ -436,7 +480,7 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             q_block=q_block, k_block=k_block, n_qb=n_qb, rep=rep,
-            with_segments=qseg is not None,
+            with_segments=qseg is not None, window=window,
         ),
         grid=(g, n_kb, rep, n_qb),
         in_specs=in_specs2,
@@ -464,48 +508,53 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
 # ---------------------------------------------------------------------------
 # custom VJP over the folded [g, rep, s, d] layout
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _mha_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _mha_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
+                window):
     return _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
-                         k_block)
+                         k_block, window=window)
 
 
-def _mha_folded_fwd(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+def _mha_folded_fwd(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
+                    window):
     o, lse = _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
-                           k_block, return_lse=True)
+                           k_block, return_lse=True, window=window)
     return o, (q, k, v, o, lse, qseg, kseg)
 
 
-def _mha_folded_bwd(sm_scale, causal, q_block, k_block, res, do):
+def _mha_folded_bwd(sm_scale, causal, q_block, k_block, window, res, do):
     q, k, v, o, lse, qseg, kseg = res
     dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale,
-                               causal, q_block, k_block)
+                               causal, q_block, k_block, window=window)
     return dq, dk, dv, None, None
 
 
 _mha_folded.defvjp(_mha_folded_fwd, _mha_folded_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _mha_lse_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _mha_lse_folded(q, k, v, qseg, kseg, sm_scale, causal, q_block, k_block,
+                    window):
     """Like _mha_folded but also returns logsumexp — the merge statistic
     ring/context-parallel attention needs to combine per-block results."""
     return _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
-                         k_block, return_lse=True)
+                         k_block, return_lse=True, window=window)
 
 
 def _mha_lse_folded_fwd(q, k, v, qseg, kseg, sm_scale, causal, q_block,
-                        k_block):
+                        k_block, window):
     o, lse = _mha_fwd_impl(q, k, v, qseg, kseg, sm_scale, causal, q_block,
-                           k_block, return_lse=True)
+                           k_block, return_lse=True, window=window)
     return (o, lse), (q, k, v, o, lse, qseg, kseg)
 
 
-def _mha_lse_folded_bwd(sm_scale, causal, q_block, k_block, res, cts):
+def _mha_lse_folded_bwd(sm_scale, causal, q_block, k_block, window, res,
+                        cts):
     q, k, v, o, lse, qseg, kseg = res
     do, dlse = cts
     dq, dk, dv = _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale,
-                               causal, q_block, k_block, dlse=dlse)
+                               causal, q_block, k_block, dlse=dlse,
+                               window=window)
     return dq, dk, dv, None, None
 
 
@@ -569,7 +618,8 @@ def _fold(q, k, v, segment_ids, q_block, k_block):
 
 def mha(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
         q_block: int = DEFAULT_Q_BLOCK, k_block: int = DEFAULT_K_BLOCK,
-        segment_ids: Optional[Union[jax.Array, SegmentIds]] = None):
+        segment_ids: Optional[Union[jax.Array, SegmentIds]] = None,
+        window: int = 0):
     """Flash attention over [batch, seq, heads, head_dim].
 
     GQA (kv_heads < q_heads) is handled inside the kernel's index maps —
@@ -581,9 +631,12 @@ def mha(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
     b, sq, hq, d = q.shape
     hk = k.shape[2]
     sm_scale = sm_scale if sm_scale is not None else d ** -0.5
+    if window and not causal:
+        raise ValueError("sliding window requires causal=True")
     qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
                                            q_block, k_block)
-    of = _mha_folded(qf, kf, vf, qseg, kseg, sm_scale, causal, qb, kb)
+    of = _mha_folded(qf, kf, vf, qseg, kseg, sm_scale, causal, qb, kb,
+                     window)
     of = of.reshape(b, hq, sq, of.shape[-1]).transpose(0, 2, 1, 3)
     return of[..., :d]  # drop lane padding for unaligned head_dim
 
@@ -592,7 +645,8 @@ def mha_with_lse(q, k, v, causal: bool = False,
                  sm_scale: Optional[float] = None,
                  q_block: int = DEFAULT_Q_BLOCK,
                  k_block: int = DEFAULT_K_BLOCK,
-                 segment_ids: Optional[Union[jax.Array, SegmentIds]] = None):
+                 segment_ids: Optional[Union[jax.Array, SegmentIds]] = None,
+                 window: int = 0):
     """Flash attention that also returns logsumexp [b, heads, sq] — the
     statistic ring/context-parallel callers need to merge per-block
     partial results (fully differentiable, incl. the lse output)."""
@@ -601,6 +655,6 @@ def mha_with_lse(q, k, v, causal: bool = False,
     qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
                                            q_block, k_block)
     of, lse = _mha_lse_folded(qf, kf, vf, qseg, kseg, sm_scale, causal,
-                              qb, kb)
+                              qb, kb, window)
     o = of.reshape(b, hq, sq, of.shape[-1]).transpose(0, 2, 1, 3)
     return o[..., :d], lse.reshape(b, hq, sq)

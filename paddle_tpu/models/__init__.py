@@ -6,5 +6,6 @@ from .llama import (  # noqa: F401
     LlamaModel,
 )
 from .mamba import MambaConfig, MambaForCausalLM  # noqa: F401
+from .rwkv import RWKVConfig, RWKVForCausalLM  # noqa: F401
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
 from .vit import ViT, ViTConfig  # noqa: F401

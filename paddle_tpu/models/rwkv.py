@@ -1,0 +1,240 @@
+"""RWKV (v4-style) — the linear-recurrence LM family named alongside
+Mamba in BASELINE.json ("Mamba-2 / RWKV: selective-scan /
+linear-recurrence Phi op").
+
+Parity: the reference implements WKV as a custom CUDA kernel
+(sequential per-channel recurrence with running-max stabilization).
+TPU-native inversion: the stabilized WKV recurrence is ASSOCIATIVE once
+the carry includes the segment length (the decay applied when composing
+two segments is w·len(right segment)), so it maps onto
+``jax.lax.associative_scan`` — a log-depth, MXU/VPU-friendly program XLA
+schedules without any sequential loop. Elements are (m, a, b, n):
+
+    m — running max exponent (stability), a — Σ e^{kᵢ−m}·vᵢ,
+    b — Σ e^{kᵢ−m}, n — segment length.
+
+    (m₁,a₁,b₁,n₁) ∘ (m₂,a₂,b₂,n₂):
+        M  = max(m₁ − w·n₂, m₂)          # left segment decays w per step
+        a  = a₁·e^{m₁−w·n₂−M} + a₂·e^{m₂−M}
+        b  = b₁·e^{m₁−w·n₂−M} + b₂·e^{m₂−M}
+        n  = n₁ + n₂
+
+The per-token "bonus" u (current token weighted e^{u+kₜ}) composes
+outside the scan, exactly as the reference kernel does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..nn import functional as F
+from ..nn.layer.common import LayerList
+
+
+@dataclass
+class RWKVConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    intermediate_size: int = 0  # 0 → 4*hidden
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("num_hidden_layers", 2)
+        return cls(**kw)
+
+
+def wkv_associative(k, v, w, u):
+    """Stabilized WKV over [batch, seq, dim].
+
+    k, v: [b, s, d]; w: [d] positive decay; u: [d] current-token bonus.
+    Returns [b, s, d]: for each t,
+        (Σ_{i<t} e^{−(t−1−i)·w + kᵢ}·vᵢ + e^{u+kₜ}·vₜ) /
+        (Σ_{i<t} e^{−(t−1−i)·w + kᵢ}      + e^{u+kₜ})
+    """
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    wf = w.astype(jnp.float32)[None, None]
+    uf = u.astype(jnp.float32)[None, None]
+
+    m0 = kf
+    a0 = vf
+    b0 = jnp.ones_like(kf)
+    n0 = jnp.ones_like(kf)
+
+    def combine(left, right):
+        m1, a1, b1, n1 = left
+        m2, a2, b2, n2 = right
+        m1d = m1 - wf * n2
+        M = jnp.maximum(m1d, m2)
+        e1 = jnp.exp(m1d - M)
+        e2 = jnp.exp(m2 - M)
+        return M, a1 * e1 + a2 * e2, b1 * e1 + b2 * e2, n1 + n2
+
+    m, a, b, _ = jax.lax.associative_scan(
+        combine, (m0, a0, b0, n0), axis=1)
+    # `a/b/m` at t include tokens 0..t with pure decay weighting; the WKV
+    # numerator needs tokens 0..t−1 decayed PLUS the t-th with bonus u.
+    # Shift the inclusive scan right by one step (applying one extra
+    # decay), then add the bonus term.
+    m_prev = jnp.concatenate(
+        [jnp.full_like(m[:, :1], -1e30), m[:, :-1] - wf], axis=1)
+    a_prev = jnp.concatenate([jnp.zeros_like(a[:, :1]), a[:, :-1]], axis=1)
+    b_prev = jnp.concatenate([jnp.zeros_like(b[:, :1]), b[:, :-1]], axis=1)
+
+    cur = uf + kf
+    M = jnp.maximum(m_prev, cur)
+    e_prev = jnp.exp(m_prev - M)
+    e_cur = jnp.exp(cur - M)
+    num = a_prev * e_prev + vf * e_cur
+    den = b_prev * e_prev + e_cur
+    return (num / jnp.maximum(den, 1e-30)).astype(v.dtype)
+
+
+def wkv_reference(k, v, w, u):
+    """Naive per-step recurrence (the reference CUDA kernel's math) —
+    the numeric oracle for the associative form."""
+    import numpy as np
+
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    w = np.asarray(w, np.float64)
+    u = np.asarray(u, np.float64)
+    bsz, s, d = k.shape
+    out = np.zeros_like(v)
+    for bi in range(bsz):
+        num = np.zeros(d)
+        den = np.zeros(d)
+        for t in range(s):
+            cur = np.exp(u + k[bi, t])
+            out[bi, t] = (num + cur * v[bi, t]) / (den + cur + 1e-30)
+            decay = np.exp(-w)
+            num = decay * (num + np.exp(k[bi, t]) * v[bi, t])
+            den = decay * (den + np.exp(k[bi, t]))
+    return out
+
+
+class RWKVTimeMix(Layer):
+    """Time mixing (the attention analog): token-shift interpolation +
+    WKV recurrence. Parity: RWKV v4 TimeMix."""
+
+    def __init__(self, config: RWKVConfig, layer_id: int):
+        super().__init__()
+        h = config.hidden_size
+        init = I.Normal(0.0, config.initializer_range)
+        ratio = layer_id / max(config.num_hidden_layers - 1, 1)
+        self.time_decay = self.create_parameter(
+            (h,), default_initializer=I.Constant(-1.0 - ratio))
+        self.time_first = self.create_parameter(
+            (h,), default_initializer=I.Constant(0.3))
+        for name in ("time_mix_k", "time_mix_v", "time_mix_r"):
+            setattr(self, name, self.create_parameter(
+                (h,), default_initializer=I.Constant(0.5)))
+        self.key = self.create_parameter((h, h), default_initializer=init)
+        self.value = self.create_parameter((h, h), default_initializer=init)
+        self.receptance = self.create_parameter(
+            (h, h), default_initializer=init)
+        self.output = self.create_parameter((h, h), default_initializer=init)
+
+    def forward(self, x):
+        # token shift: mix current with previous token
+        prev = jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+
+        def mix(p):
+            return x * p.value + prev * (1 - p.value)
+
+        k = mix(self.time_mix_k) @ self.key.value
+        v = mix(self.time_mix_v) @ self.value.value
+        r = jax.nn.sigmoid(mix(self.time_mix_r) @ self.receptance.value)
+        # softplus keeps the decay positive (stability contract of wkv)
+        w = jax.nn.softplus(self.time_decay.value)
+        wkv = wkv_associative(k, v, w, self.time_first.value)
+        return (r * wkv) @ self.output.value
+
+
+class RWKVChannelMix(Layer):
+    """Channel mixing (the FFN analog). Parity: RWKV v4 ChannelMix."""
+
+    def __init__(self, config: RWKVConfig):
+        super().__init__()
+        h, inter = config.hidden_size, config.intermediate_size
+        init = I.Normal(0.0, config.initializer_range)
+        self.time_mix_k = self.create_parameter(
+            (h,), default_initializer=I.Constant(0.5))
+        self.time_mix_r = self.create_parameter(
+            (h,), default_initializer=I.Constant(0.5))
+        self.key = self.create_parameter((h, inter),
+                                         default_initializer=init)
+        self.value = self.create_parameter((inter, h),
+                                           default_initializer=init)
+        self.receptance = self.create_parameter(
+            (h, h), default_initializer=init)
+
+    def forward(self, x):
+        prev = jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+        xk = x * self.time_mix_k.value + prev * (1 - self.time_mix_k.value)
+        xr = x * self.time_mix_r.value + prev * (1 - self.time_mix_r.value)
+        k = jnp.square(F.relu(xk @ self.key.value))
+        r = jax.nn.sigmoid(xr @ self.receptance.value)
+        return r * (k @ self.value.value)
+
+
+class RWKVBlock(Layer):
+    def __init__(self, config: RWKVConfig, layer_id: int):
+        super().__init__()
+        from ..nn.layer.norm import LayerNorm
+
+        self.ln1 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.ln2 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.att = RWKVTimeMix(config, layer_id)
+        self.ffn = RWKVChannelMix(config)
+
+    def forward(self, x):
+        x = x + self.att(self.ln1(x))
+        x = x + self.ffn(self.ln2(x))
+        return x
+
+
+class RWKVForCausalLM(Layer):
+    def __init__(self, config: RWKVConfig):
+        super().__init__()
+        from ..nn.layer.common import Embedding, Linear
+        from ..nn.layer.norm import LayerNorm
+
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.embeddings = Embedding(config.vocab_size, config.hidden_size,
+                                    weight_attr=init)
+        self.ln_pre = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.blocks = LayerList([
+            RWKVBlock(config, i) for i in range(config.num_hidden_layers)
+        ])
+        self.ln_out = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.head = Linear(config.hidden_size, config.vocab_size,
+                           weight_attr=init, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.ln_pre(self.embeddings(input_ids))
+        for blk in self.blocks:
+            h = blk(h)
+        logits = self.head(self.ln_out(h))
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits[:, :-1].reshape(-1, self.config.vocab_size),
+            labels[:, 1:].reshape(-1))
