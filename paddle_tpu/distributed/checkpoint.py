@@ -342,9 +342,11 @@ def load_state_dict(
     """
     import jax.numpy as jnp
 
-    # rank 0 heals any crashed-commit state first; the barrier keeps the
-    # other ranks from racing the rename on a shared filesystem
-    _recover(path)
+    # is_committed lets rank 0 heal any crashed-commit state; the
+    # barrier keeps the other ranks from racing the rename on a shared
+    # filesystem before they check the marker themselves
+    if jax.process_index() == 0:
+        is_committed(path)  # triggers _recover on rank 0
     _barrier("load.recover")
     if not is_committed(path):
         raise FileNotFoundError(

@@ -375,3 +375,114 @@ def kl_divergence(p: Distribution, q: Distribution):
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})"
     )
+
+
+# ---------------------------------------------------------------------------
+# Transforms (parity: paddle.distribution.transform — Transform,
+# AffineTransform, ExpTransform, SigmoidTransform, TanhTransform,
+# ChainTransform — and TransformedDistribution). Bijectors carry
+# forward/inverse and the log|det J| used for change-of-variables.
+# ---------------------------------------------------------------------------
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Parity: paddle.distribution.TransformedDistribution — base
+    distribution pushed through a bijector (or list composing left to
+    right)."""
+
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        if isinstance(transforms, (list, tuple)):
+            transforms = ChainTransform(transforms)
+        self.transform = transforms
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))

@@ -652,6 +652,8 @@ def mha_with_lse(q, k, v, causal: bool = False,
     partial results (fully differentiable, incl. the lse output)."""
     b, sq, hq, d = q.shape
     sm_scale = sm_scale if sm_scale is not None else d ** -0.5
+    if window and not causal:
+        raise ValueError("sliding window requires causal=True")
     qf, kf, vf, qseg, kseg, qb, kb = _fold(q, k, v, segment_ids,
                                            q_block, k_block)
     of, lse = _mha_lse_folded(qf, kf, vf, qseg, kseg, sm_scale, causal,

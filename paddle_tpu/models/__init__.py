@@ -1,3 +1,13 @@
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
 from .ernie_moe import ErnieMoEConfig, ErnieMoEForCausalLM  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import (  # noqa: F401

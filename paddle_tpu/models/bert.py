@@ -1,0 +1,248 @@
+"""BERT / ERNIE dense encoder family.
+
+Parity: PaddleNLP's `BertModel`/`ErnieModel` stack (transformers/
+bert/modeling.py, ernie/modeling.py) — the bidirectional encoder with
+token/position/segment embeddings, post-LN transformer blocks, a pooler,
+and the task heads paddle users reach for first:
+``BertForSequenceClassification``, ``BertForMaskedLM`` (ERNIE shares the
+same skeleton; its differences are pretraining data/objectives, not
+architecture — construct with ``BertConfig(type_vocab_size=...,
+act="relu")`` style knobs for the ERNIE variants).
+
+TPU-native notes: bidirectional attention means no causal mask — the
+flash kernel runs with causal=False and the whole [b, s, h] block is one
+MXU-friendly program; attention_mask (padding) lowers to the flash
+kernel's segment-id path, which skips fully-masked blocks instead of
+materializing [b, s, s] additive masks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..distributed.parallel_layers.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..kernels import flash_attention as fa
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, LayerList, Linear
+from ..nn.layer.norm import LayerNorm
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    num_labels: int = 2
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("hidden_dropout_prob", 0.0)
+        kw.setdefault("attention_probs_dropout_prob", 0.0)
+        return cls(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=init)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, weight_attr=init)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=init)
+
+    def forward(self, x, attention_mask=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape(
+            b, s, 3, cfg.num_attention_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        segment_ids = None
+        if attention_mask is not None:
+            # padding mask [b, s] (1 = real token) → flash segment ids:
+            # padding becomes a sentinel segment nothing attends across
+            segment_ids = jnp.where(attention_mask > 0, 0, 1).astype(
+                jnp.int32)
+        drop = cfg.attention_probs_dropout_prob if self.training else 0.0
+        if cfg.use_flash_attention and drop == 0.0:
+            out = fa.flash_attention(q, k, v, causal=False,
+                                     segment_ids=segment_ids,
+                                     training=self.training)
+        else:
+            mask = None
+            if attention_mask is not None:
+                mask = (attention_mask[:, None, None, :] > 0)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=drop,
+                training=self.training)
+        return self.out_proj(out.reshape(b, s, cfg.hidden_size))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (the BERT/ERNIE original)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(config.hidden_size,
+                                   config.layer_norm_eps)
+        self.fc_in = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=init)
+        self.fc_out = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, weight_attr=init)
+        self.ffn_norm = LayerNorm(config.hidden_size,
+                                  config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.attn_norm(
+            x + self.dropout(self.attention(x, attention_mask)))
+        h = self.fc_out(F.gelu(self.fc_in(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=init)
+
+    def forward(self, hidden):
+        return jnp.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertForSequenceClassification(Layer):
+    """Parity: paddlenlp BertForSequenceClassification — pooled [CLS]
+    → dropout → linear; returns loss when labels given."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(
+            config.hidden_size, config.num_labels,
+            weight_attr=I.Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels)
+
+
+class BertForMaskedLM(Layer):
+    """Parity: paddlenlp BertForMaskedLM — transform + tied decoder over
+    the word-embedding matrix; ignore_index=-100 masked loss."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        init = I.Normal(0.0, config.initializer_range)
+        self.transform = Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=init)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            (config.vocab_size,), is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        h, _ = self.bert(input_ids, token_type_ids,
+                         attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        w = self.bert.embeddings.word_embeddings.weight.value
+        logits = h @ w.T + self.decoder_bias.value
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits.reshape(-1, self.config.vocab_size),
+            labels.reshape(-1), ignore_index=-100)
+
+
+# ERNIE is architecturally this encoder; provide the paddle-named surface
+ErnieConfig = BertConfig
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification
+ErnieForMaskedLM = BertForMaskedLM

@@ -89,11 +89,12 @@ def wkv_associative(k, v, w, u):
     m, a, b, _ = jax.lax.associative_scan(
         combine, (m0, a0, b0, n0), axis=1)
     # `a/b/m` at t include tokens 0..t with pure decay weighting; the WKV
-    # numerator needs tokens 0..t−1 decayed PLUS the t-th with bonus u.
-    # Shift the inclusive scan right by one step (applying one extra
-    # decay), then add the bonus term.
+    # numerator needs tokens 0..t−1 plus the t-th with bonus u. The
+    # inclusive scan at t−1 is exactly Σ_{i<t} e^{−(t−1−i)w+kᵢ} — the
+    # canonical v4 statistic (the most recent past token is one decay
+    # step old) — so the shift adds no extra decay.
     m_prev = jnp.concatenate(
-        [jnp.full_like(m[:, :1], -1e30), m[:, :-1] - wf], axis=1)
+        [jnp.full_like(m[:, :1], -1e30), m[:, :-1]], axis=1)
     a_prev = jnp.concatenate([jnp.zeros_like(a[:, :1]), a[:, :-1]], axis=1)
     b_prev = jnp.concatenate([jnp.zeros_like(b[:, :1]), b[:, :-1]], axis=1)
 
@@ -123,9 +124,11 @@ def wkv_reference(k, v, w, u):
         for t in range(s):
             cur = np.exp(u + k[bi, t])
             out[bi, t] = (num + cur * v[bi, t]) / (den + cur + 1e-30)
+            # canonical v4 update: aₜ = e^{−w}·aₜ₋₁ + e^{kₜ}·vₜ — the new
+            # token enters undecayed; decay applies from the next step
             decay = np.exp(-w)
-            num = decay * (num + np.exp(k[bi, t]) * v[bi, t])
-            den = decay * (den + np.exp(k[bi, t]))
+            num = decay * num + np.exp(k[bi, t]) * v[bi, t]
+            den = decay * den + np.exp(k[bi, t])
     return out
 
 
