@@ -51,6 +51,8 @@ from .sharding import (  # noqa: F401
     place_params_on_mesh,
     sequence_parallel_constraint,
     shard_activation,
+    group_sharded_parallel,
+    recompute,
 )
 from .strategy import (  # noqa: F401
     AmpConfig,

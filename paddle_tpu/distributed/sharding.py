@@ -273,3 +273,39 @@ def place_params_on_mesh(model, mesh, strategy):
         spec = param_partition_spec(name, p.shape, p.spec, strategy)
         p.value = jax.device_put(p.value, NamedSharding(mesh, spec))
     return model
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: paddle.distributed.fleet.utils.recompute — run ``function``
+    without saving intermediate activations; recompute them in backward.
+    TPU-native: this IS ``jax.checkpoint`` (XLA rematerialization);
+    ``use_reentrant``/``preserve_rng_state`` knobs are meaningless under
+    functional RNG and accepted for signature parity."""
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    return jax.checkpoint(function)(*args, **kwargs)
+
+
+def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
+                           **kw):
+    """Parity: paddle.distributed.sharding.group_sharded_parallel.
+
+    level: "os" (ZeRO-1: optimizer state), "os_g" (ZeRO-2: +grads),
+    "p_g_os" (ZeRO-3: +params). The reference wraps model/optimizer in
+    GroupSharded* classes; here sharding is a property of the compiled
+    program, so this returns (model, optimizer, strategy) — hand the
+    strategy to ``TrainStep`` (or ``fleet.distributed_model``), which
+    emits the partition specs the level implies. ``scaler`` passes
+    through untouched (bf16 needs no loss scaling on TPU)."""
+    from .strategy import DistributedStrategy
+
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level)
+    if stage is None:
+        raise ValueError(
+            f"unknown group_sharded level {level!r}; one of os/os_g/p_g_os")
+    strategy = DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs.stage = stage
+    if scaler is not None:
+        return model, optimizer, strategy, scaler
+    return model, optimizer, strategy

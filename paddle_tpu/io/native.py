@@ -114,3 +114,33 @@ class TokenBinDataset:
             self.close()
         except Exception:
             pass
+
+
+_ckpt_lib = None
+_CKPT_SO = os.path.join(_CSRC, "libptckpt.so")
+
+
+def load_ckpt_writer():
+    """ctypes handle for the native parallel checkpoint chunk writer
+    (csrc/ckptio.cpp). Builds on first use; raises on failure — callers
+    fall back to the pure-python np.save loop."""
+    global _ckpt_lib
+    if _ckpt_lib is not None:
+        return _ckpt_lib
+    if not os.path.exists(_CKPT_SO):
+        subprocess.run(["make", "-C", _CSRC], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(_CKPT_SO)
+    lib.ptck_write_batch.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.ptck_write_batch.restype = ctypes.c_int
+    _ckpt_lib = lib
+    return lib

@@ -136,3 +136,154 @@ class TransformerEncoder(Layer):
         if self.norm is not None:
             out = self.norm(out)
         return out
+
+
+class TransformerDecoderLayer(Layer):
+    """Parity: paddle.nn.TransformerDecoderLayer — masked self-attention,
+    encoder-decoder cross-attention, FFN, each with pre-/post-LN."""
+
+    def __init__(
+        self,
+        d_model,
+        nhead,
+        dim_feedforward,
+        dropout=0.1,
+        activation="relu",
+        attn_dropout=None,
+        act_dropout=None,
+        normalize_before=False,
+    ):
+        super().__init__()
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.act_dropout = Dropout(
+            act_dropout if act_dropout is not None else dropout
+        )
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is not None:
+            tgt, new_cache = self.self_attn(tgt, attn_mask=tgt_mask,
+                                            cache=cache)
+        else:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+            new_cache = None
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.act_dropout(self.activation(
+            self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return (tgt, new_cache) if cache is not None else tgt
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer_fn, num_layers, norm=None):
+        super().__init__()
+        from .common import LayerList
+
+        if callable(decoder_layer_fn) and not isinstance(
+                decoder_layer_fn, Layer):
+            self.layers = LayerList(
+                [decoder_layer_fn() for _ in range(num_layers)])
+        else:
+            raise TypeError(
+                "pass a factory callable: TransformerDecoder(lambda: "
+                "TransformerDecoderLayer(...), num_layers)")
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        """``cache``: optional list of per-layer (k, v) self-attention
+        caches (parity: paddle TransformerDecoder incremental decode) —
+        returns (out, new_caches) when given."""
+        out = tgt
+        new_caches = [] if cache is not None else None
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, c = layer(out, memory, tgt_mask=tgt_mask,
+                               memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(c)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return (out, new_caches) if cache is not None else out
+
+
+class Transformer(Layer):
+    """Parity: paddle.nn.Transformer — the full encoder-decoder seq2seq
+    stack. ``generate_square_subsequent_mask`` matches paddle's helper."""
+
+    def __init__(
+        self,
+        d_model=512,
+        nhead=8,
+        num_encoder_layers=6,
+        num_decoder_layers=6,
+        dim_feedforward=2048,
+        dropout=0.1,
+        activation="relu",
+        attn_dropout=None,
+        act_dropout=None,
+        normalize_before=False,
+    ):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before),
+            num_encoder_layers,
+            norm=LayerNorm(d_model) if normalize_before else None)
+        self.decoder = TransformerDecoder(
+            lambda: TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before),
+            num_decoder_layers,
+            norm=LayerNorm(d_model) if normalize_before else None)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        """Additive float [length, length] causal mask (0 = attend,
+        -inf = masked) — paddle's convention; scaled_dot_product_attention
+        also accepts boolean masks, so both styles work downstream."""
+        allow = jnp.tril(jnp.ones((length, length), bool))
+        return jnp.where(allow, 0.0, -jnp.inf).astype(jnp.float32)

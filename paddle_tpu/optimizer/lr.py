@@ -157,3 +157,166 @@ def resolve_lr(learning_rate):
     if isinstance(learning_rate, LRScheduler):
         return learning_rate.base_lr, learning_rate.lr_at
     return float(learning_rate), None
+
+
+class MultiStepDecay(LRScheduler):
+    """Parity: paddle.optimizer.lr.MultiStepDecay — gamma applied at each
+    milestone epoch."""
+
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1):
+        self.milestones = sorted(int(m) for m in milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step)
+        n = jnp.sum(jnp.asarray(self.milestones) <= step)
+        return self.base_lr * self.gamma ** n
+
+
+class NaturalExpDecay(LRScheduler):
+    """lr = base * e^(-gamma * epoch)."""
+
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(
+            -self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    """lr = base / (1 + gamma * epoch)."""
+
+    def __init__(self, learning_rate, gamma, last_epoch=-1):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr / (
+            1.0 + self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class LambdaDecay(LRScheduler):
+    """lr = base * lr_lambda(epoch). The lambda must be jnp-traceable for
+    in-jit use; plain python lambdas work for the stateful API."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr = base * Π_{e≤epoch} lr_lambda(e) — stateful-only (the product
+    has no closed form for arbitrary lambdas)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1):
+        self.lr_lambda = lr_lambda
+        self._factor = 1.0
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return jnp.asarray(self.base_lr * self._factor, jnp.float32)
+
+    def step(self, epoch=None):
+        prev = self.last_epoch
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        if self.last_epoch > 0:
+            for e in range(max(prev, 0) + 1, self.last_epoch + 1):
+                self._factor *= float(self.lr_lambda(e))
+        self.last_lr = float(self.lr_at(self.last_epoch))
+
+
+class OneCycleLR(LRScheduler):
+    """Parity: paddle.optimizer.lr.OneCycleLR — warm up to max_learning_rate
+    then anneal to max/divide_factor/end-scale (cosine phase shape)."""
+
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=None, phase_pct=0.3, last_epoch=-1):
+        self.max_lr = float(max_learning_rate)
+        self.total_steps = int(total_steps)
+        self.initial_lr = self.max_lr / divide_factor
+        self.end_lr = (end_learning_rate if end_learning_rate is not None
+                       else self.initial_lr / 1e4)
+        self.up_steps = max(int(phase_pct * total_steps), 1)
+        super().__init__(self.initial_lr, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.clip(jnp.asarray(step, jnp.float32), 0,
+                        self.total_steps)
+        up = step / self.up_steps
+        lr_up = self.initial_lr + (self.max_lr - self.initial_lr) * \
+            0.5 * (1 - jnp.cos(jnp.pi * jnp.clip(up, 0, 1)))
+        down = (step - self.up_steps) / max(
+            self.total_steps - self.up_steps, 1)
+        lr_down = self.end_lr + (self.max_lr - self.end_lr) * \
+            0.5 * (1 + jnp.cos(jnp.pi * jnp.clip(down, 0, 1)))
+        return jnp.where(step < self.up_steps, lr_up, lr_down)
+
+
+class CyclicLR(LRScheduler):
+    """Parity: paddle.optimizer.lr.CyclicLR (triangular mode)."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None, last_epoch=-1):
+        self.max_lr = float(max_learning_rate)
+        self.up = int(step_size_up)
+        self.down = int(step_size_down or step_size_up)
+        super().__init__(base_learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        cycle_len = self.up + self.down
+        pos = jnp.mod(jnp.asarray(step, jnp.float32), cycle_len)
+        frac = jnp.where(pos < self.up, pos / self.up,
+                         1.0 - (pos - self.up) / self.down)
+        return self.base_lr + (self.max_lr - self.base_lr) * frac
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Parity: paddle.optimizer.lr.ReduceOnPlateau — metric-driven decay
+    (stateful-only by nature; call ``step(metrics=loss)``)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, cooldown=0, min_lr=0.0, last_epoch=-1):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._lr = float(learning_rate)
+        self._best = None
+        self._bad = 0
+        self._cool = 0
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def _is_better(self, metric):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return metric < self._best - self.threshold
+        return metric > self._best + self.threshold
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        if metrics is not None:
+            m = float(metrics)
+            if self._is_better(m):
+                self._best = m
+                self._bad = 0
+            elif self._cool > 0:
+                self._cool -= 1
+            else:
+                self._bad += 1
+                if self._bad > self.patience:
+                    self._lr = max(self._lr * self.factor, self.min_lr)
+                    self._bad = 0
+                    self._cool = self.cooldown
+        self.last_lr = float(self._lr)

@@ -247,3 +247,139 @@ index_select = _alias(lambda x, index, axis=0: jnp.take(x, index, axis=axis))
 masked_select = _alias(lambda x, mask: x[mask])
 numel = _alias(jnp.size)
 diag = _alias(jnp.diag)
+
+
+# ---------------------------------------------------------------------------
+# round-3 widening of the paddle tensor surface
+# (parity: python/paddle/tensor/{math,manipulation,search,stat}.py)
+# ---------------------------------------------------------------------------
+bincount = _alias(jnp.bincount)
+kron = _alias(jnp.kron)
+trace = _alias(jnp.trace)
+diagonal = _alias(jnp.diagonal)
+meshgrid = _alias(jnp.meshgrid)
+logsumexp = _alias(jax.scipy.special.logsumexp)
+nanmean = _alias(jnp.nanmean)
+nansum = _alias(jnp.nansum)
+amax = _alias(jnp.max)
+amin = _alias(jnp.min)
+diff = _alias(jnp.diff)
+searchsorted = _alias(
+    lambda sorted_sequence, values, right=False: jnp.searchsorted(
+        sorted_sequence, values, side="right" if right else "left"))
+bucketize = _alias(
+    lambda x, sorted_sequence, right=False: jnp.searchsorted(
+        sorted_sequence, x, side="right" if right else "left"))
+histogram = _alias(
+    lambda x, bins=100, min=0, max=0: jnp.histogram(  # noqa: A002
+        x, bins=bins,
+        range=None if (min == 0 and max == 0) else (min, max))[0])
+lerp = _alias(lambda x, y, weight: x + weight * (y - x))
+addmm = _alias(
+    lambda input, x, y, beta=1.0, alpha=1.0: beta * input  # noqa: A002
+    + alpha * (x @ y))
+logaddexp = _alias(jnp.logaddexp)
+heaviside = _alias(jnp.heaviside)
+rad2deg = _alias(jnp.rad2deg)
+deg2rad = _alias(jnp.deg2rad)
+frac = _alias(lambda x: x - jnp.trunc(x))
+trunc = _alias(jnp.trunc)
+expm1 = _alias(jnp.expm1)
+log1p = _alias(jnp.log1p)
+log2 = _alias(jnp.log2)
+log10 = _alias(jnp.log10)
+atan2 = _alias(jnp.arctan2)
+hypot = _alias(jnp.hypot)
+copysign = _alias(jnp.copysign)
+nextafter = _alias(jnp.nextafter)
+gcd = _alias(jnp.gcd)
+lcm = _alias(jnp.lcm)
+isclose = _alias(jnp.isclose)
+allclose = _alias(jnp.allclose)
+inner = _alias(jnp.inner)
+cross = _alias(jnp.cross)
+clone = _alias(jnp.copy)
+rot90 = _alias(jnp.rot90)
+vander = _alias(lambda x, n=None, increasing=False: jnp.vander(
+    x, N=n, increasing=increasing))
+
+
+def nonzero(x, as_tuple=False):
+    """Paddle semantics: one [N, ndim] int64 tensor of coordinates
+    (jnp.nonzero's tuple-of-arrays only with as_tuple=True). Dynamic
+    output size — eager-only, like the reference's CPU path."""
+    res = jnp.nonzero(_v(x))
+    if as_tuple:
+        return res
+    return jnp.stack(res, axis=-1).astype(jnp.int64)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(_v(x), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(_v(x), jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Paddle semantics: (values, indices) of the most frequent element
+    along ``axis``. Static-shape formulation: each position's count is
+    how many elements along the axis equal it; argmax of counts over the
+    SORTED axis picks the modal value (ties → a smallest-value run)."""
+    x = _v(x)
+    if axis % x.ndim != x.ndim - 1:
+        moved = jnp.moveaxis(x, axis, -1)
+        values, idx = mode(moved, axis=-1)
+        if keepdim:
+            values = jnp.expand_dims(values, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return values, idx
+    sorted_x = jnp.sort(x, axis=-1)
+    counts = jnp.sum(
+        (sorted_x[..., :, None] == sorted_x[..., None, :]), axis=-1)
+    best = jnp.argmax(counts, axis=-1)
+    values = jnp.take_along_axis(sorted_x, best[..., None], axis=-1)[..., 0]
+    # index of an occurrence of the modal value in the ORIGINAL order
+    idx = jnp.argmax(x == values[..., None], axis=-1)
+    if keepdim:
+        values = values[..., None]
+        idx = idx[..., None]
+    return values, idx
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """jnp.unique under jit needs static sizes; eager paddle semantics
+    here (host-side op, like the reference's CPU fallback)."""
+    import numpy as np
+
+    res = np.unique(np.asarray(_v(x)), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unbind(x, axis=0):
+    x = _v(x)
+    return [jnp.squeeze(s, axis) for s in
+            jnp.split(x, x.shape[axis], axis)]
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(_v(x), chunks, axis)
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(_v(mask), value, _v(x))
+
+
+def logcumsumexp(x, axis=None):
+    x = _v(x)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    m = jnp.max(x, axis=axis, keepdims=True)
+    return m + jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis))

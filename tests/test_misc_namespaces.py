@@ -1,5 +1,6 @@
 """linalg / fft / distribution / jit / quantization surfaces."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -183,3 +184,73 @@ def test_distribution_support_guards():
     ]:
         assert float(dist_.log_prob(jnp.asarray(bad))) == float("-inf")
         assert np.isfinite(float(dist_.log_prob(jnp.asarray(good))))
+
+
+def test_round3_tensor_surface():
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert pt.trace(x).item() == 0 + 5 + 10
+    np.testing.assert_allclose(np.asarray(pt.diagonal(x)), [0, 5, 10])
+    np.testing.assert_allclose(
+        float(pt.logsumexp(x)), float(jnp.log(jnp.sum(jnp.exp(x)))),
+        rtol=1e-6)
+    assert pt.unbind(x, 0)[1].shape == (4,)
+    assert [c.shape for c in pt.chunk(x, 2, axis=1)] == [(3, 2), (3, 2)]
+    np.testing.assert_allclose(
+        np.asarray(pt.masked_fill(x, x > 5, -1.0))[2], [-1, -1, -1, -1])
+    np.testing.assert_allclose(float(pt.median(x)), 5.5)
+    v, i = pt.mode(jnp.asarray([[1, 2, 2, 3], [7, 7, 1, 1]]))
+    np.testing.assert_array_equal(np.asarray(v), [2, 1])
+    assert np.asarray(jnp.asarray([[1, 2, 2, 3]]))[0, int(i[0])] == 2
+    u, counts = pt.unique(jnp.asarray([3, 1, 3, 2, 1]),
+                          return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(counts), [2, 1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(pt.searchsorted(jnp.asarray([1.0, 3.0, 5.0]),
+                                   jnp.asarray([2.0, 5.0]))), [1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(pt.searchsorted(jnp.asarray([1.0, 3.0, 5.0]),
+                                   jnp.asarray([5.0]), right=True)), [3])
+    np.testing.assert_allclose(
+        np.asarray(pt.lerp(jnp.zeros(3), jnp.ones(3), 0.25)), 0.25)
+    # logcumsumexp matches the log of cumsum of exp
+    a = jnp.asarray([0.1, 2.0, -1.0])
+    np.testing.assert_allclose(
+        np.asarray(pt.logcumsumexp(a)),
+        np.log(np.cumsum(np.exp(np.asarray(a)))), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pt.addmm(jnp.ones((2, 2)), jnp.eye(2), jnp.eye(2),
+                            beta=2.0, alpha=3.0)),
+        2.0 * np.ones((2, 2)) + 3.0 * np.eye(2))
+    assert pt.histogram(jnp.asarray([0.0, 0.5, 1.0]), bins=2).sum() == 3
+    nz = pt.nonzero(jnp.asarray([[1, 0], [0, 2]]))
+    np.testing.assert_array_equal(np.asarray(nz), [[0, 0], [1, 1]])
+    rows, cols = pt.nonzero(jnp.asarray([[1, 0], [0, 2]]), as_tuple=True)
+    np.testing.assert_array_equal(np.asarray(rows), [0, 1])
+
+
+def test_group_sharded_and_recompute_api():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn
+
+    model = nn.Linear(4, 4)
+    m, o, strategy = dist.group_sharded_parallel(model, object(),
+                                                 level="os_g")
+    assert strategy.sharding and strategy.sharding_configs.stage == 2
+    with pytest.raises(ValueError):
+        dist.group_sharded_parallel(model, object(), level="bogus")
+
+    calls = []
+
+    def f(a):
+        calls.append(1)
+        return jnp.sin(a) * a
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(8),
+                    jnp.float32)
+    y, vjp = jax.vjp(lambda a: dist.recompute(f, a), x)
+    ref, ref_vjp = jax.vjp(f, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vjp(jnp.ones(8))[0]),
+                               np.asarray(ref_vjp(jnp.ones(8))[0]),
+                               rtol=1e-6)

@@ -4,6 +4,7 @@ model: upstream test/legacy_test/test_adamw_op.py etc.)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import nn, optimizer as opt
@@ -140,3 +141,71 @@ def test_optimizer_eager_step():
     o.step()
     w_after = np.asarray(model.weight.value)
     assert not np.allclose(w_before, w_after)
+
+
+def test_extended_lr_schedulers():
+    """Round-3 scheduler zoo additions (parity: paddle.optimizer.lr)."""
+    from paddle_tpu.optimizer import lr as L
+
+    ms = L.MultiStepDecay(0.1, milestones=[3, 6], gamma=0.1)
+    vals = []
+    for _ in range(8):
+        vals.append(ms.get_lr())
+        ms.step()
+    np.testing.assert_allclose(vals[0], 0.1)
+    np.testing.assert_allclose(vals[4], 0.01, rtol=1e-6)
+    np.testing.assert_allclose(vals[7], 0.001, rtol=1e-6)
+
+    ne = L.NaturalExpDecay(1.0, gamma=0.5)
+    np.testing.assert_allclose(float(ne.lr_at(2)), np.exp(-1.0), rtol=1e-6)
+
+    it = L.InverseTimeDecay(1.0, gamma=1.0)
+    np.testing.assert_allclose(float(it.lr_at(3)), 0.25, rtol=1e-6)
+
+    lam = L.LambdaDecay(0.5, lambda e: 0.95 ** e)
+    np.testing.assert_allclose(float(lam.lr_at(2)), 0.5 * 0.95**2,
+                               rtol=1e-6)
+
+    mult = L.MultiplicativeDecay(1.0, lambda e: 0.9)
+    for _ in range(3):
+        mult.step()
+    np.testing.assert_allclose(mult.get_lr(), 0.9**3, rtol=1e-5)
+
+    oc = L.OneCycleLR(max_learning_rate=1.0, total_steps=100,
+                      divide_factor=10.0, phase_pct=0.3)
+    assert float(oc.lr_at(0)) == pytest.approx(0.1, rel=1e-5)
+    assert float(oc.lr_at(30)) == pytest.approx(1.0, rel=1e-4)
+    assert float(oc.lr_at(100)) < 0.01  # annealed to the end lr
+
+    cy = L.CyclicLR(0.1, 1.0, step_size_up=10)
+    assert float(cy.lr_at(0)) == pytest.approx(0.1, rel=1e-6)
+    assert float(cy.lr_at(10)) == pytest.approx(1.0, rel=1e-6)
+    assert float(cy.lr_at(20)) == pytest.approx(0.1, rel=1e-6)
+    assert float(cy.lr_at(25)) == pytest.approx(0.55, rel=1e-5)
+
+    rp = L.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    rp.step(metrics=1.0)
+    rp.step(metrics=1.0)  # no improvement (1)
+    rp.step(metrics=1.0)  # no improvement (2) > patience → decay
+    assert rp.get_lr() == pytest.approx(0.5)
+    rp.step(metrics=0.2)  # improvement resets
+    assert rp.get_lr() == pytest.approx(0.5)
+
+
+def test_scheduler_drives_optimizer_in_jit():
+    """The functional lr_at path must work on-device inside the train
+    step (no host sync)."""
+    from paddle_tpu.optimizer import lr as L
+
+    sched = L.OneCycleLR(max_learning_rate=0.1, total_steps=50)
+    o = opt.SGD(learning_rate=sched)
+    params = {"w": jnp.ones((4,))}
+    state = o.init(params)
+    g = {"w": jnp.ones((4,))}
+
+    @jax.jit
+    def step(params, state):
+        return o.update(g, state, params)
+
+    p1, s1 = step(params, state)
+    assert bool(jnp.all(jnp.isfinite(p1["w"])))
