@@ -45,7 +45,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import initializer as I
 from ..core.module import Layer
@@ -711,6 +711,30 @@ class PipelineTrainStep:
         for k, v in trunk_p.items():
             self.params[f"trunk.{k}"] = v
         self._pre_names, self._post_names = pre_names, post_names
+        # pp × tp/fsdp composition: place every param according to its
+        # logical spec over the mesh's non-pp axes BEFORE jit — the
+        # shard_map handles the pp axis manually, GSPMD propagates the
+        # rest through it (the trunk's stacked leading dim carries the
+        # "pp" spec entry from PipelineLayer, so trunk weights live
+        # pre-sharded per stage too)
+        from .sharding import _filter_spec_for_mesh
+
+        trunk_param_objs = {
+            f"trunk.{flat}": module.trunk._parameters[flat]
+            for flat, _ in module.trunk._stacked_names
+        }
+        self.param_shardings = {}
+        for n in self.params:
+            obj = all_params.get(n)
+            if obj is None:
+                obj = trunk_param_objs.get(n)
+            spec = getattr(obj, "spec", None)
+            if spec is None:
+                spec = (None,) * jnp.ndim(self.params[n])
+            spec = _filter_spec_for_mesh(tuple(spec), mesh)
+            sh = NamedSharding(mesh, P(*spec))
+            self.param_shardings[n] = sh
+            self.params[n] = jax.device_put(self.params[n], sh)
         self.opt_state = optimizer.init(self.params)
         self._step = jax.jit(self._make_step())
 

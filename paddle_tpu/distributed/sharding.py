@@ -306,6 +306,6 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     strategy = DistributedStrategy()
     strategy.sharding = True
     strategy.sharding_configs.stage = stage
-    if scaler is not None:
-        return model, optimizer, strategy, scaler
-    return model, optimizer, strategy
+    # fixed arity regardless of scaler — a conditional return shape is a
+    # porting trap (scaler is None when not supplied)
+    return model, optimizer, strategy, scaler

@@ -123,13 +123,20 @@ _CKPT_SO = os.path.join(_CSRC, "libptckpt.so")
 def load_ckpt_writer():
     """ctypes handle for the native parallel checkpoint chunk writer
     (csrc/ckptio.cpp). Builds on first use; raises on failure — callers
-    fall back to the pure-python np.save loop."""
+    fall back to the pure-python np.save loop. Build failure is cached
+    so periodic saves don't re-spawn a doomed make each time."""
     global _ckpt_lib
+    if _ckpt_lib is False:
+        raise OSError("native checkpoint writer unavailable (cached)")
     if _ckpt_lib is not None:
         return _ckpt_lib
     if not os.path.exists(_CKPT_SO):
-        subprocess.run(["make", "-C", _CSRC], check=True,
-                       capture_output=True)
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True)
+        except Exception:
+            _ckpt_lib = False
+            raise
     lib = ctypes.CDLL(_CKPT_SO)
     lib.ptck_write_batch.argtypes = [
         ctypes.c_int,

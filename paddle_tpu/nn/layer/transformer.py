@@ -42,16 +42,32 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def compute_kv(self, key, value):
+        """Precompute projected K/V [b, s, h, d] — paddle's StaticCache
+        for cross-attention: project the encoder memory ONCE instead of
+        per decode step."""
+        b = key.shape[0]
+        k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads,
+                                     self.head_dim)
+        v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads,
+                                       self.head_dim)
+        return k, v
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None, static_cache=None):
         """cache: optional (k_prev, v_prev) with layout [b, s, h, d]
         (parity: paddle MHA Cache for incremental decoding) — current k/v
-        are appended and the updated cache returned alongside the output."""
+        are appended and the updated cache returned alongside the output.
+        static_cache: precomputed (k, v) from ``compute_kv`` (paddle's
+        StaticCache) — key/value projections are skipped entirely."""
         key = query if key is None else key
         value = query if value is None else value
         b, sq, _ = query.shape
         q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
-        k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
-        v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+        if static_cache is not None:
+            k, v = static_cache
+        else:
+            k, v = self.compute_kv(key, value)
         if cache is not None:
             k_prev, v_prev = cache
             k = jnp.concatenate([k_prev, k], axis=1)
@@ -171,8 +187,13 @@ class TransformerDecoderLayer(Layer):
         )
         self.activation = getattr(F, activation)
 
+    def gen_static_cache(self, memory):
+        """Precompute the cross-attention K/V for ``memory`` (paddle's
+        StaticCache) — call once per sequence, pass to every step."""
+        return self.cross_attn.compute_kv(memory, memory)
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
-                cache=None):
+                cache=None, static_cache=None):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
@@ -189,7 +210,8 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask,
+                              static_cache=static_cache)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -221,21 +243,29 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_static_cache(self, memory):
+        """Per-layer precomputed cross-attention K/V (StaticCache)."""
+        return [layer.gen_static_cache(memory) for layer in self.layers]
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
-                cache=None):
+                cache=None, static_cache=None):
         """``cache``: optional list of per-layer (k, v) self-attention
         caches (parity: paddle TransformerDecoder incremental decode) —
-        returns (out, new_caches) when given."""
+        returns (out, new_caches) when given. ``static_cache``: per-layer
+        precomputed cross-attention K/V from ``gen_static_cache`` so the
+        encoder memory is projected once per sequence, not per step."""
         out = tgt
         new_caches = [] if cache is not None else None
         for i, layer in enumerate(self.layers):
+            sc = static_cache[i] if static_cache is not None else None
             if cache is not None:
                 out, c = layer(out, memory, tgt_mask=tgt_mask,
-                               memory_mask=memory_mask, cache=cache[i])
+                               memory_mask=memory_mask, cache=cache[i],
+                               static_cache=sc)
                 new_caches.append(c)
             else:
                 out = layer(out, memory, tgt_mask=tgt_mask,
-                            memory_mask=memory_mask)
+                            memory_mask=memory_mask, static_cache=sc)
         if self.norm is not None:
             out = self.norm(out)
         return (out, new_caches) if cache is not None else out
@@ -261,18 +291,18 @@ class Transformer(Layer):
         super().__init__()
         self.d_model = d_model
         self.nhead = nhead
+        # paddle constructs the final encoder/decoder LayerNorms
+        # unconditionally (both pre- and post-LN configs)
         self.encoder = TransformerEncoder(
             lambda: TransformerEncoderLayer(
                 d_model, nhead, dim_feedforward, dropout, activation,
                 attn_dropout, act_dropout, normalize_before),
-            num_encoder_layers,
-            norm=LayerNorm(d_model) if normalize_before else None)
+            num_encoder_layers, norm=LayerNorm(d_model))
         self.decoder = TransformerDecoder(
             lambda: TransformerDecoderLayer(
                 d_model, nhead, dim_feedforward, dropout, activation,
                 attn_dropout, act_dropout, normalize_before),
-            num_decoder_layers,
-            norm=LayerNorm(d_model) if normalize_before else None)
+            num_decoder_layers, norm=LayerNorm(d_model))
 
     def forward(self, src, tgt, src_mask=None, tgt_mask=None,
                 memory_mask=None):

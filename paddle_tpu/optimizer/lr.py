@@ -278,14 +278,19 @@ class CyclicLR(LRScheduler):
 
 class ReduceOnPlateau(LRScheduler):
     """Parity: paddle.optimizer.lr.ReduceOnPlateau — metric-driven decay
-    (stateful-only by nature; call ``step(metrics=loss)``)."""
+    (stateful-only by nature; call ``step(metrics=loss)``). Matches the
+    reference's semantics: relative threshold by default
+    (threshold_mode="rel") and a cooldown that ticks down every epoch
+    while active, suppressing bad-epoch counting."""
 
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
-                 threshold=1e-4, cooldown=0, min_lr=0.0, last_epoch=-1):
+                 threshold=1e-4, threshold_mode="rel", cooldown=0,
+                 min_lr=0.0, last_epoch=-1):
         self.mode = mode
         self.factor = factor
         self.patience = patience
         self.threshold = threshold
+        self.threshold_mode = threshold_mode
         self.cooldown = cooldown
         self.min_lr = min_lr
         self._lr = float(learning_rate)
@@ -300,9 +305,13 @@ class ReduceOnPlateau(LRScheduler):
     def _is_better(self, metric):
         if self._best is None:
             return True
+        if self.threshold_mode == "rel":
+            margin = abs(self._best) * self.threshold
+        else:
+            margin = self.threshold
         if self.mode == "min":
-            return metric < self._best - self.threshold
-        return metric > self._best + self.threshold
+            return metric < self._best - margin
+        return metric > self._best + margin
 
     def step(self, metrics=None, epoch=None):
         self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
@@ -311,12 +320,14 @@ class ReduceOnPlateau(LRScheduler):
             if self._is_better(m):
                 self._best = m
                 self._bad = 0
-            elif self._cool > 0:
-                self._cool -= 1
             else:
                 self._bad += 1
-                if self._bad > self.patience:
-                    self._lr = max(self._lr * self.factor, self.min_lr)
-                    self._bad = 0
-                    self._cool = self.cooldown
+            if self._cool > 0:
+                # cooldown ticks EVERY epoch and suppresses bad counting
+                self._cool -= 1
+                self._bad = 0
+            elif self._bad > self.patience:
+                self._lr = max(self._lr * self.factor, self.min_lr)
+                self._bad = 0
+                self._cool = self.cooldown
         self.last_lr = float(self._lr)

@@ -311,7 +311,7 @@ def nonzero(x, as_tuple=False):
     res = jnp.nonzero(_v(x))
     if as_tuple:
         return res
-    return jnp.stack(res, axis=-1).astype(jnp.int64)
+    return jnp.stack(res, axis=-1)
 
 
 def median(x, axis=None, keepdim=False):

@@ -234,9 +234,10 @@ def test_group_sharded_and_recompute_api():
     from paddle_tpu import nn
 
     model = nn.Linear(4, 4)
-    m, o, strategy = dist.group_sharded_parallel(model, object(),
-                                                 level="os_g")
+    m, o, strategy, scaler = dist.group_sharded_parallel(model, object(),
+                                                         level="os_g")
     assert strategy.sharding and strategy.sharding_configs.stage == 2
+    assert scaler is None  # fixed arity: scaler slot present regardless
     with pytest.raises(ValueError):
         dist.group_sharded_parallel(model, object(), level="bogus")
 

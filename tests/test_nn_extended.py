@@ -313,13 +313,18 @@ def test_transformer_decoder_and_seq2seq():
     # paddle-convention mask: additive float 0/-inf
     assert tmask.dtype == jnp.float32
     assert float(tmask[0, 1]) == float("-inf") and float(tmask[1, 0]) == 0.0
-    # incremental decode cache threaded through the WHOLE decoder stack
+    # incremental decode cache threaded through the WHOLE decoder stack,
+    # with the cross-attention K/V precomputed once (StaticCache)
     memory = model.encoder(src)
+    static = model.decoder.gen_static_cache(memory)
+    assert static[0][0].shape == (2, 10, 4, 8)
     k0 = jnp.zeros((2, 0, 4, 8), jnp.float32)
     caches = [(k0, k0) for _ in model.decoder.layers]
-    y1, caches = model.decoder(tgt[:, :1], memory, cache=caches)
+    y1, caches = model.decoder(tgt[:, :1], memory, cache=caches,
+                               static_cache=static)
     assert caches[0][0].shape == (2, 1, 4, 8)
-    y2, caches = model.decoder(tgt[:, 1:2], memory, cache=caches)
+    y2, caches = model.decoder(tgt[:, 1:2], memory, cache=caches,
+                               static_cache=static)
     assert caches[1][0].shape == (2, 2, 4, 8)
     # incremental outputs match the full (masked) forward
     full = model.decoder(tgt[:, :2], memory,

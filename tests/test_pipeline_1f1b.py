@@ -309,3 +309,44 @@ def test_llama_pipeline_module_trains():
     assert traj["1F1B"][-1] < traj["1F1B"][0]
     np.testing.assert_allclose(traj["1F1B"], traj["F-then-B"],
                                rtol=2e-4, atol=1e-5)
+
+
+def test_llama_pipeline_pp_x_tp_composition():
+    """pp × tp on one mesh: trunk stacked over pp (manual axis in
+    shard_map) with Column/RowParallel weights sharded over tp (GSPMD
+    auto axis). Loss trajectory must match the pp-only run exactly."""
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.distributed.pipeline import PipelineTrainStep
+    from paddle_tpu.distributed.strategy import DistributedStrategy
+    from paddle_tpu.models.llama import LlamaConfig, llama_pipeline_module
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, tie_word_embeddings=True,
+                           use_flash_attention=False)
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)))
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)))
+
+    def loss_fn(logits, labels):
+        return nn.functional.cross_entropy(
+            logits.reshape(-1, cfg.vocab_size), labels.reshape(-1))
+
+    traj = {}
+    for axes in ({"pp": 2}, {"pp": 2, "tp": 2}):
+        pt.seed(0)
+        m = llama_pipeline_module(cfg, num_stages=2)
+        mesh = dist.build_mesh(**axes)
+        st = DistributedStrategy()
+        st.pipeline_configs.schedule_mode = "1F1B"
+        st.pipeline_configs.accumulate_steps = 2
+        ts = PipelineTrainStep(m, opt.AdamW(learning_rate=1e-3), mesh,
+                               st, loss_fn)
+        if "tp" in axes:
+            # attention qkv weights must genuinely shard over tp
+            sharded = [n for n, sh in ts.param_shardings.items()
+                       if "tp" in str(sh.spec)]
+            assert sharded, "no parameter sharded over tp"
+        traj[tuple(axes)] = [float(ts.run(ids, labels)) for _ in range(4)]
+    np.testing.assert_allclose(traj[("pp",)], traj[("pp", "tp")],
+                               rtol=2e-4, atol=1e-5)
