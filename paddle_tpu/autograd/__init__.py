@@ -39,9 +39,15 @@ def value_and_grad(model: Layer, loss_fn: Callable = None):
 
 
 def backward(model: Layer, loss_fn: Callable, *inputs, rngs=None):
-    """Eager one-shot: compute loss and grads w.r.t. trainable params."""
+    """Eager one-shot: compute loss and grads w.r.t. trainable params.
+    Also populates each Parameter's ``.grad`` (parity: loss.backward()
+    filling EagerParamBase.grad), which closure-driven optimizers (LBFGS)
+    read back."""
     params = extract_params(model, trainable_only=True)
     loss, grads = value_and_grad(model, loss_fn)(params, *inputs, rngs=rngs)
+    for p in model.parameters():
+        if p.name in grads:
+            p.grad = grads[p.name]
     return loss, grads
 
 

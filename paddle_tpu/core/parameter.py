@@ -43,6 +43,7 @@ class Parameter:
         "no_sync",
         "init_fn",
         "optimize_attr",
+        "grad",
     )
 
     def __init__(
@@ -65,6 +66,8 @@ class Parameter:
         self.no_sync = False
         self.init_fn = init_fn
         self.optimize_attr = {"learning_rate": 1.0}
+        # populated by autograd.backward (parity: EagerParamBase.grad)
+        self.grad = None
 
     # ---- array protocol -------------------------------------------------
     def __jax_array__(self):

@@ -719,15 +719,11 @@ class PipelineTrainStep:
         # pre-sharded per stage too)
         from .sharding import _filter_spec_for_mesh
 
-        trunk_param_objs = {
-            f"trunk.{flat}": module.trunk._parameters[flat]
-            for flat, _ in module.trunk._stacked_names
-        }
         self.param_shardings = {}
         for n in self.params:
+            # trunk params appear in named_parameters() under the same
+            # "trunk.<flat>" keys stage_params() uses
             obj = all_params.get(n)
-            if obj is None:
-                obj = trunk_param_objs.get(n)
             spec = getattr(obj, "spec", None)
             if spec is None:
                 spec = (None,) * jnp.ndim(self.params[n])

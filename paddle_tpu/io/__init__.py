@@ -75,6 +75,137 @@ def random_split(dataset, lengths, generator_seed: int = 0):
     return out
 
 
+class ComposeDataset(Dataset):
+    """Parity: paddle.io.ComposeDataset — zip same-length datasets into
+    one whose samples are the concatenated fields."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "ComposeDataset needs at least one dataset"
+        n = len(self.datasets[0])
+        assert all(len(d) == n for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (tuple, list)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+
+class ConcatDataset(Dataset):
+    """Parity: paddle.io.ConcatDataset — datasets end-to-end."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        lo = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = self.cum[lo - 1] if lo else 0
+        return self.datasets[lo][idx - prev]
+
+    def __len__(self):
+        return self.cum[-1] if self.cum else 0
+
+
+class ChainDataset(IterableDataset):
+    """Parity: paddle.io.ChainDataset — chain iterable datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Sampler:
+    """Parity: paddle.io.Sampler base."""
+
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator  # int seed or None
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        seed = self.generator if isinstance(self.generator, int) else None
+        rng = np.random.default_rng(seed)
+        if self.replacement:
+            return iter(rng.integers(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, np.float64)
+        assert self.weights.ndim == 1 and (self.weights >= 0).all()
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = np.random.default_rng()
+        return iter(
+            rng.choice(
+                len(self.weights), self.num_samples,
+                replace=self.replacement, p=p,
+            ).tolist()
+        )
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    """Parity: paddle.io.get_worker_info — None in the main process; in a
+    process worker, identifies the worker so IterableDatasets can shard
+    their stream."""
+    return _worker_state.get("worker_info")
+
+
 class BatchSampler:
     def __init__(self, dataset=None, sampler=None, shuffle: bool = False,
                  batch_size: int = 1, drop_last: bool = False, seed: int = 0):
@@ -169,7 +300,7 @@ def default_collate_fn(batch):
 _worker_state = {}
 
 
-def _proc_worker_init(dataset, collate_fn):
+def _proc_worker_init(dataset, collate_fn, id_counter=None, num_workers=1):
     # Workers are pure-numpy sample loaders and must stay that way: fork
     # children inherit the parent's already-initialized jax backend, so
     # touching jax in a worker is undefined (the env vars below only
@@ -180,6 +311,16 @@ def _proc_worker_init(dataset, collate_fn):
     _os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     _worker_state["dataset"] = dataset
     _worker_state["collate"] = collate_fn
+    if id_counter is not None:
+        # fork-inherited shared counter: atomic handout, no feeder-thread
+        # race (an mp.Queue flushed by a background thread can look empty
+        # to an early worker and hand out duplicate ids)
+        with id_counter.get_lock():
+            wid = id_counter.value
+            id_counter.value += 1
+        _worker_state["worker_info"] = WorkerInfo(
+            id=wid, num_workers=num_workers, dataset=dataset
+        )
 
 
 def _proc_load_batch(idxs):
@@ -254,11 +395,14 @@ class DataLoader:
             import multiprocessing as mp
             from concurrent.futures import ProcessPoolExecutor
 
+            ctx = mp.get_context("fork")
+            id_counter = ctx.Value("i", 0)
             pool_cm = ProcessPoolExecutor(
                 max_workers=self.num_workers,
-                mp_context=mp.get_context("fork"),
+                mp_context=ctx,
                 initializer=_proc_worker_init,
-                initargs=(self.dataset, self.collate_fn),
+                initargs=(self.dataset, self.collate_fn, id_counter,
+                          self.num_workers),
             )
             submit = _proc_load_batch
         else:

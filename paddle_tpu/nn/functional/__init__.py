@@ -606,3 +606,100 @@ def cosine_similarity(x1, x2, axis=-1, eps=1e-8):
     n1 = jnp.linalg.norm(x1, axis=axis)
     n2 = jnp.linalg.norm(x2, axis=axis)
     return dot / jnp.maximum(n1 * n2, eps)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Parity: paddle.nn.functional.ctc_loss (reference: the warpctc op,
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h, built from the vendored
+    third_party warpctc — SURVEY §2.3). ``log_probs`` are UNNORMALIZED
+    logits of shape [max_time, batch, num_classes]; softmax is applied
+    internally, matching warpctc.
+
+    TPU design: warpctc's hand-scheduled CUDA alpha/beta kernels become a
+    single ``lax.scan`` over time of the log-semiring alpha recursion on
+    the extended (blank-interleaved) label sequence — static shapes,
+    batch-vectorized, masked for variable time/label lengths. The backward
+    pass is jax autodiff through the scan, which reproduces the classic
+    beta-recursion gradient without a hand-written kernel.
+    """
+    lp = jax.nn.log_softmax(_f32up(_v(log_probs)), axis=-1)
+    labels = _v(labels)
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+    T, B, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+    # extended sequence: [blank, l0, blank, l1, ..., blank]
+    s_idx = jnp.arange(S)
+    lab_pos = jnp.clip((s_idx - 1) // 2, 0, L - 1)
+    is_label = (s_idx % 2) == 1
+    ext = jnp.where(is_label[None, :], labels[:, lab_pos], blank)  # [B, S]
+
+    # skip transition s-2 -> s allowed iff ext[s] is a label differing
+    # from ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    skip_ok = is_label[None, :] & (ext != ext_m2) & (s_idx[None, :] >= 2)
+
+    # per-step emission log-probs for every extended position: [T, B, S]
+    emit = jnp.take_along_axis(
+        lp, jnp.broadcast_to(ext[None], (T, B, S)), axis=2
+    )
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    if S > 1:
+        # first label only reachable if the sequence is non-empty
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(label_lengths > 0, emit[0, :, 1], neg_inf)
+        )
+
+    def _shift(a, k):
+        return jnp.pad(a, ((0, 0), (k, 0)), constant_values=neg_inf)[:, :S]
+
+    def step(alpha, xs):
+        emit_t, t = xs
+        a1 = alpha
+        a2 = _shift(alpha, 1)
+        a3 = jnp.where(skip_ok, _shift(alpha, 2), neg_inf)
+        stacked = jnp.stack([a1, a2, a3])
+        m = jnp.max(stacked, axis=0)
+        new = m + jnp.log(
+            jnp.sum(jnp.exp(stacked - m[None]), axis=0)
+        ) + emit_t
+        new = jnp.where(jnp.isfinite(m), new, neg_inf)
+        # freeze alpha once past each sequence's input length
+        alpha = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return alpha, None
+
+    alpha, _ = lax.scan(step, alpha0, (emit[1:], jnp.arange(1, T)))
+
+    last = 2 * label_lengths  # final blank position in the extended seq
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1
+        )[:, 0],
+        neg_inf,
+    )
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths, 1).astype(loss.dtype)
+    if reduction == "mean":
+        # paddle: divide each loss by its label length, then mean
+        return jnp.mean(
+            loss / jnp.maximum(label_lengths, 1).astype(loss.dtype)
+        )
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

@@ -139,3 +139,171 @@ def _reduce(loss, reduction):
     if reduction == "sum":
         return jnp.sum(loss)
     return loss
+
+
+class CTCLoss(Layer):
+    """Parity: paddle.nn.CTCLoss (warpctc-backed upstream; here a
+    lax.scan log-semiring recursion — see functional.ctc_loss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from .. import functional as F
+
+        return F.ctc_loss(
+            log_probs, labels, input_lengths, label_lengths,
+            blank=self.blank, reduction=self.reduction,
+            norm_by_times=norm_by_times,
+        )
+
+
+class BCELoss(Layer):
+    """Parity: paddle.nn.BCELoss (input are probabilities)."""
+
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        x = jnp.clip(input, 1e-12, 1.0 - 1e-12)
+        loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+        if self.weight is not None:
+            loss = loss * self.weight
+        return _reduce(loss, self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input1, input2, label):
+        import jax.numpy as jnp
+
+        from .. import functional as F
+
+        cos = F.cosine_similarity(input1, input2, axis=1)
+        loss = jnp.where(
+            label > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin)
+        )
+        return _reduce(loss, self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.margin, self.p, self.epsilon = margin, p, epsilon
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        import jax.numpy as jnp
+
+        def dist(a, b):
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(a - b) + self.epsilon, self.p),
+                        axis=-1),
+                1.0 / self.p,
+            )
+
+        d_pos = dist(input, positive)
+        d_neg = dist(input, negative)
+        if self.swap:
+            d_neg = jnp.minimum(d_neg, dist(positive, negative))
+        loss = jnp.maximum(0.0, d_pos - d_neg + self.margin)
+        return _reduce(loss, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax
+
+        # softplus(-y*x): stable for large |x| (log1p(exp(.)) overflows)
+        loss = jax.nn.softplus(-label * input)
+        return _reduce(loss, self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean"):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        loss = jnp.where(
+            label > 0, input, jnp.maximum(0.0, self.margin - input)
+        )
+        return _reduce(loss, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean"):
+        super().__init__()
+        self.log_input, self.full, self.epsilon = log_input, full, epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        if self.log_input:
+            loss = jnp.exp(input) - label * input
+        else:
+            loss = input - label * jnp.log(input + self.epsilon)
+        if self.full:
+            # Stirling approximation for label! (label > 1 only)
+            stirling = (label * jnp.log(label) - label
+                        + 0.5 * jnp.log(2.0 * jnp.pi * label))
+            loss = loss + jnp.where(label > 1, stirling, 0.0)
+        return _reduce(loss, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean"):
+        super().__init__()
+        self.full, self.epsilon = full, epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):  # noqa: A002
+        import jax.numpy as jnp
+
+        var = jnp.maximum(variance, self.epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+        if self.full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
+        return _reduce(loss, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        import jax.numpy as jnp
+
+        from .. import functional as F
+
+        import jax
+
+        loss = -(label * jax.nn.log_sigmoid(input)
+                 + (1 - label) * jax.nn.log_sigmoid(-input))
+        if self.weight is not None:
+            loss = loss * self.weight
+        return _reduce(jnp.mean(loss, axis=-1), self.reduction)

@@ -6,17 +6,27 @@ from .clip import (  # noqa: F401
     ClipGradByNorm,
     ClipGradByValue,
 )
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
+    ASGD,
     SGD,
+    Adadelta,
     Adagrad,
     Adam,
+    Adamax,
     AdamW,
     Lamb,
     Momentum,
+    NAdam,
     Optimizer,
+    RAdam,
+    RMSProp,
+    Rprop,
 )
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Lamb",
+    "RMSProp", "Adamax", "Adadelta", "NAdam", "RAdam", "ASGD", "Rprop",
+    "LBFGS",
     "lr", "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
 ]

@@ -1,0 +1,222 @@
+"""L-BFGS (parity: paddle.optimizer.LBFGS, python/paddle/optimizer/lbfgs.py
+— itself the torch-style closure API: ``opt.step(closure)`` re-evaluates
+the loss, with history_size curvature pairs and an optional strong-Wolfe
+line search).
+
+TPU design note: L-BFGS is a host-driven outer loop by nature (data-
+dependent convergence tests, variable-length line search), so unlike the
+first-order optimizers it is NOT a jittable pytree update. The inner
+pieces — closure evaluation and the two-loop recursion — run on device;
+the control flow stays in Python, which matches how the reference drives
+it from the Python layer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.parameter import Parameter
+
+
+def _flatten(tensors):
+    return jnp.concatenate([jnp.ravel(t.astype(jnp.float32)) for t in tensors])
+
+
+class LBFGS:
+    def __init__(
+        self,
+        learning_rate: float = 1.0,
+        max_iter: int = 20,
+        max_eval: Optional[int] = None,
+        tolerance_grad: float = 1e-7,
+        tolerance_change: float = 1e-9,
+        history_size: int = 100,
+        line_search_fn: Optional[str] = None,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.lr = float(learning_rate)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._parameter_list: List[Parameter] = (
+            list(parameters) if parameters is not None else []
+        )
+        # persistent state across step() calls (torch/paddle parity)
+        self._state = {
+            "func_evals": 0, "n_iter": 0,
+            "old_sks": [], "old_yks": [], "ro": [],
+            "d": None, "t": None, "prev_flat_grad": None, "H_diag": 1.0,
+        }
+
+    # -- parameter plumbing -------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _gather(self):
+        return [jnp.asarray(p.value) for p in self._params()]
+
+    def _scatter(self, flat):
+        i = 0
+        for p in self._params():
+            n = int(jnp.size(p.value))
+            chunk = flat[i:i + n].reshape(p.value.shape).astype(p.value.dtype)
+            p.value = chunk
+            i += n
+
+    def _eval(self, closure, flat_x):
+        """Set params to flat_x, run closure, return (loss, flat_grad)."""
+        self._scatter(flat_x)
+        loss = closure()
+        grads = [jnp.asarray(p.grad) if p.grad is not None
+                 else jnp.zeros_like(jnp.asarray(p.value))
+                 for p in self._params()]
+        self._state["func_evals"] += 1
+        return float(loss), _flatten(grads)
+
+    # -- strong Wolfe (cubic-interpolation zoom, torch _strong_wolfe) -------
+    def _strong_wolfe(self, closure, x, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        d_norm = float(jnp.max(jnp.abs(d)))
+        g_prev, f_prev, t_prev = g, f, 0.0
+        ls_iter = 0
+        # bracket phase
+        f_new, g_new = self._eval(closure, x + t * d)
+        gtd_new = float(g_new @ d)
+        bracket = None
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+                bracket = (t_prev, t, f_prev, f_new, g_prev, g_new)
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                return f_new, g_new, t, ls_iter
+            if gtd_new >= 0:
+                bracket = (t_prev, t, f_prev, f_new, g_prev, g_new)
+                break
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = min(10 * t, t * 2 ** 1)  # expand
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+        if bracket is None:
+            return f_new, g_new, t, ls_iter
+        lo_t, hi_t, lo_f, hi_f, lo_g, hi_g = bracket
+        if lo_f > hi_f:
+            lo_t, hi_t, lo_f, hi_f, lo_g, hi_g = \
+                hi_t, lo_t, hi_f, lo_f, hi_g, lo_g
+        # zoom phase (bisection with safeguard; cubic omitted — bisection
+        # converges a step or two slower but to the same point)
+        while ls_iter < max_ls:
+            if abs(hi_t - lo_t) * d_norm < self.tolerance_change:
+                break
+            t = 0.5 * (lo_t + hi_t)
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+            if f_new > (f + c1 * t * gtd) or f_new >= lo_f:
+                hi_t, hi_f, hi_g = t, f_new, g_new
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    return f_new, g_new, t, ls_iter
+                if gtd_new * (hi_t - lo_t) >= 0:
+                    hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+                lo_t, lo_f, lo_g = t, f_new, g_new
+        return lo_f, lo_g, lo_t, ls_iter
+
+    # -- main ---------------------------------------------------------------
+    def step(self, closure: Callable[[], jax.Array]):
+        """One L-BFGS optimization step (up to max_iter inner iterations).
+        ``closure`` must recompute the loss AND refresh ``p.grad`` on every
+        call (use paddle_tpu.autograd.backward or set grads manually)."""
+        st = self._state
+        x0 = _flatten(self._gather())
+        loss, flat_grad = self._eval(closure, x0)
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+            return jnp.asarray(loss)
+
+        x = x0
+        n_inner = 0
+        while n_inner < self.max_iter:
+            n_inner += 1
+            st["n_iter"] += 1
+            # direction via two-loop recursion
+            if st["prev_flat_grad"] is None:
+                d = -flat_grad
+                st["H_diag"] = 1.0
+            else:
+                y = flat_grad - st["prev_flat_grad"]
+                s = st["d"] * st["t"]
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(st["old_sks"]) >= self.history_size:
+                        st["old_sks"].pop(0)
+                        st["old_yks"].pop(0)
+                        st["ro"].pop(0)
+                    st["old_sks"].append(s)
+                    st["old_yks"].append(y)
+                    st["ro"].append(1.0 / ys)
+                    st["H_diag"] = ys / float(y @ y)
+                q = -flat_grad
+                alphas = []
+                for s_i, y_i, ro_i in zip(reversed(st["old_sks"]),
+                                          reversed(st["old_yks"]),
+                                          reversed(st["ro"])):
+                    alpha = ro_i * float(s_i @ q)
+                    alphas.append(alpha)
+                    q = q - alpha * y_i
+                d = q * st["H_diag"]
+                for (s_i, y_i, ro_i), alpha in zip(
+                        zip(st["old_sks"], st["old_yks"], st["ro"]),
+                        reversed(alphas)):
+                    beta = ro_i * float(y_i @ d)
+                    d = d + s_i * (alpha - beta)
+            st["prev_flat_grad"] = flat_grad
+
+            gtd = float(flat_grad @ d)
+            if gtd > -self.tolerance_change:
+                break
+            t = (min(1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))) * self.lr
+                 if st["n_iter"] == 1 else self.lr)
+
+            if self.line_search_fn == "strong_wolfe":
+                loss, flat_grad, t, _ = self._strong_wolfe(
+                    closure, x, t, d, loss, flat_grad, gtd)
+                x = x + t * d
+                self._scatter(x)
+            else:
+                x = x + t * d
+                loss, flat_grad = self._eval(closure, x)
+            st["d"], st["t"] = d, t
+
+            if st["func_evals"] >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            if float(jnp.max(jnp.abs(t * d))) <= self.tolerance_change:
+                break
+        return jnp.asarray(loss)
+
+    # paddle Optimizer surface used by schedulers/trainers ------------------
+    def get_lr(self):
+        return self.lr
+
+    def clear_grad(self):
+        for p in self._params():
+            p.grad = None
+
+    def state_dict(self):
+        return {"lr": self.lr, "state": dict(self._state)}
+
+    def set_state_dict(self, d):
+        self.lr = d.get("lr", self.lr)
+        self._state.update(d.get("state", {}))

@@ -306,12 +306,16 @@ class ReduceOnPlateau(LRScheduler):
         if self._best is None:
             return True
         if self.threshold_mode == "rel":
-            margin = abs(self._best) * self.threshold
-        else:
-            margin = self.threshold
+            # reference semantics (paddle/torch ReduceOnPlateau): the
+            # dynamic threshold scales best by (1 -/+ threshold) — NOT an
+            # abs() margin, which would flip direction for negative
+            # metrics (log-likelihoods)
+            if self.mode == "min":
+                return metric < self._best * (1.0 - self.threshold)
+            return metric > self._best * (1.0 + self.threshold)
         if self.mode == "min":
-            return metric < self._best - margin
-        return metric > self._best + margin
+            return metric < self._best - self.threshold
+        return metric > self._best + self.threshold
 
     def step(self, metrics=None, epoch=None):
         self.last_epoch = epoch if epoch is not None else self.last_epoch + 1

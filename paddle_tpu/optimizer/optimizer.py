@@ -328,3 +328,239 @@ class Lamb(Optimizer):
             (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0
         )
         return pf - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class RMSProp(Optimizer):
+    """Parity: paddle.optimizer.RMSProp (rho/epsilon/momentum/centered —
+    phi rmsprop_kernel semantics)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _init_slot(self, p):
+        s = {
+            "mean_square": jnp.zeros(p.shape, jnp.float32),
+            "momentum": jnp.zeros(p.shape, jnp.float32),
+        }
+        if self.centered:
+            s["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
+        return s
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        ms = self.rho * slots["mean_square"] + (1 - self.rho) * jnp.square(gf)
+        out = {"mean_square": ms}
+        if self.centered:
+            mg = self.rho * slots["mean_grad"] + (1 - self.rho) * gf
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * slots["momentum"] + lr * gf / denom
+        out["momentum"] = mom
+        return pf - mom, out
+
+
+class Adamax(Optimizer):
+    """Parity: paddle.optimizer.Adamax (infinity-norm Adam variant)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {
+            "moment": jnp.zeros(p.shape, jnp.float32),
+            "inf_norm": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        m = self.beta1 * slots["moment"] + (1 - self.beta1) * gf
+        u = jnp.maximum(self.beta2 * slots["inf_norm"], jnp.abs(gf))
+        stepf = step.astype(jnp.float32)
+        lr_t = lr / (1 - jnp.power(self.beta1, stepf))
+        return (pf - lr_t * m / (u + self.epsilon),
+                {"moment": m, "inf_norm": u})
+
+
+class Adadelta(Optimizer):
+    """Parity: paddle.optimizer.Adadelta (accumulated grad/update RMS)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 multi_precision=True, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _init_slot(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
+            "avg_squared_update": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        g2 = self.rho * slots["avg_squared_grad"] \
+            + (1 - self.rho) * jnp.square(gf)
+        upd = gf * jnp.sqrt(
+            (slots["avg_squared_update"] + self.epsilon)
+            / (g2 + self.epsilon)
+        )
+        u2 = self.rho * slots["avg_squared_update"] \
+            + (1 - self.rho) * jnp.square(upd)
+        return pf - lr * upd, {
+            "avg_squared_grad": g2, "avg_squared_update": u2,
+        }
+
+
+class NAdam(Optimizer):
+    """Parity: paddle.optimizer.NAdam (Nesterov-momentum Adam with the
+    momentum_decay schedule mu_t = beta1*(1 - 0.5*0.96^(t*psi)))."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.momentum_decay = momentum_decay
+
+    def _init_slot(self, p):
+        return {
+            "moment1": jnp.zeros(p.shape, jnp.float32),
+            "moment2": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        stepf = step.astype(jnp.float32)
+        psi = self.momentum_decay
+        mu_t = self.beta1 * (1 - 0.5 * jnp.power(0.96, stepf * psi))
+        mu_t1 = self.beta1 * (1 - 0.5 * jnp.power(0.96, (stepf + 1) * psi))
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * gf
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(gf)
+        mu_prod = slots.get("mu_prod", jnp.ones((), jnp.float32)) * mu_t
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * gf / (1 - mu_prod))
+        vhat = v / (1 - jnp.power(self.beta2, stepf))
+        new = pf - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return new, {"moment1": m, "moment2": v, "mu_prod": mu_prod}
+
+    def init(self, params):
+        state = super().init(params)
+        for name in state["slots"]:
+            state["slots"][name]["mu_prod"] = jnp.ones((), jnp.float32)
+        return state
+
+
+class RAdam(Optimizer):
+    """Parity: paddle.optimizer.RAdam (rectified Adam: SGD-with-momentum
+    warmup until the variance-rectification term rho_t > 5)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {
+            "moment1": jnp.zeros(p.shape, jnp.float32),
+            "moment2": jnp.zeros(p.shape, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * gf
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(gf)
+        stepf = step.astype(jnp.float32)
+        beta2_t = jnp.power(self.beta2, stepf)
+        rho_inf = 2.0 / (1.0 - self.beta2) - 1.0
+        rho_t = rho_inf - 2.0 * stepf * beta2_t / (1.0 - beta2_t)
+        mhat = m / (1 - jnp.power(self.beta1, stepf))
+        r = jnp.sqrt(
+            jnp.maximum(
+                (rho_t - 4) * (rho_t - 2) * rho_inf
+                / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8),
+                0.0,
+            )
+        )
+        vhat = jnp.sqrt(v / (1 - beta2_t)) + self.epsilon
+        adam_step = lr * r * mhat / vhat
+        sgd_step = lr * mhat
+        new = pf - jnp.where(rho_t > 5.0, adam_step, sgd_step)
+        return new, {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    """Parity: paddle.optimizer.ASGD (averaged SGD over a window of the
+    last ``n`` gradients; phi asgd_kernel keeps a running sum ``d`` and a
+    per-index history ``y``. TPU design: the ring-buffer of n historical
+    grads is memory-hostile; we keep the running-mean recurrence
+    d_t = d_{t-1} - y_old/n + g/n with an exponential window, which paddle
+    itself reduces to when n >= t)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, **kw)
+        self.batch_num = max(1, int(batch_num))
+
+    def _init_slot(self, p):
+        return {"d": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        if decay:
+            gf = gf + decay * pf
+        n = jnp.minimum(step.astype(jnp.float32), float(self.batch_num))
+        d = slots["d"] + (gf - slots["d"]) / n
+        return pf - lr * d, {"d": d}
+
+
+class Rprop(Optimizer):
+    """Parity: paddle.optimizer.Rprop (sign-based resilient prop; per-weight
+    step sizes grown/shrunk by the grad-sign agreement)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, **kw):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip,
+                         multi_precision, **kw)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_neg, self.eta_pos = etas
+
+    def _init_slot(self, p):
+        return {
+            "prev_grad": jnp.zeros(p.shape, jnp.float32),
+            "lrs": jnp.full(p.shape, self.base_lr, jnp.float32),
+        }
+
+    def _apply(self, lr, step, name, pf, gf, slots, decay):
+        sign = jnp.sign(gf * slots["prev_grad"])
+        factor = jnp.where(
+            sign > 0, self.eta_pos, jnp.where(sign < 0, self.eta_neg, 1.0)
+        )
+        lrs = jnp.clip(slots["lrs"] * factor, self.lr_min, self.lr_max)
+        # on sign flip: zero the grad (skip the update, classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, gf)
+        new = pf - lrs * jnp.sign(g_eff)
+        return new, {"prev_grad": g_eff, "lrs": lrs}

@@ -1,0 +1,131 @@
+"""paddle_tpu.signal — STFT / ISTFT (parity: python/paddle/signal.py,
+backed upstream by the frame/overlap_add phi kernels).
+
+TPU design: framing is a gather with a static [num_frames, n_fft] index
+grid and overlap-add is a scatter-add (``.at[].add``) — both XLA-native,
+jit/grad-friendly, no Python loops. FFTs go through jnp.fft (XLA Fft HLO).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Parity: paddle.signal.frame — slide a window of ``frame_length``
+    every ``hop_length`` samples. Returns [..., frame_length, num_frames]
+    for axis=-1 (paddle layout)."""
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    if axis == 0:
+        # [seq, ...] → operate on the front axis
+        moved = jnp.moveaxis(x, 0, -1)
+        out = frame(moved, frame_length, hop_length, axis=-1)
+        # [..., frame_length, num_frames] → [num_frames, frame_length, ...]
+        return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    frames = x[..., idx]                     # [..., num_frames, frame_length]
+    return jnp.swapaxes(frames, -1, -2)      # [..., frame_length, num_frames]
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Parity: paddle.signal.overlap_add — inverse of ``frame``.
+    x: [..., frame_length, num_frames] for axis=-1."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+    if axis == 0:
+        moved = jnp.moveaxis(jnp.moveaxis(x, 1, -1), 0, -1)
+        return jnp.moveaxis(
+            overlap_add(moved, hop_length, axis=-1), -1, 0
+        )
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    idx = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])          # [nf, fl]
+    frames = jnp.swapaxes(x, -1, -2)                     # [..., nf, fl]
+    batch_shape = frames.shape[:-2]
+    flat = frames.reshape((-1,) + frames.shape[-2:])
+    out = jnp.zeros((flat.shape[0], out_len), flat.dtype)
+    out = out.at[:, idx].add(flat)
+    return out.reshape(batch_shape + (out_len,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Parity: paddle.signal.stft. x: real or complex [..., seq_len].
+    Returns complex [..., n_fft//2+1 (onesided) or n_fft, num_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    is_complex = jnp.iscomplexobj(x)
+    if is_complex and onesided:
+        raise ValueError(
+            "stft: onesided=True is only valid for real input (parity: "
+            "paddle.signal.stft asserts the same)")
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:  # center-pad the window to n_fft (paddle/torch)
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)  # [..., n_fft, nf]
+    frames = jnp.swapaxes(frames, -1, -2) * window  # [..., nf, n_fft]
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Parity: paddle.signal.istft — least-squares inverse via windowed
+    overlap-add normalized by the window-square envelope."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+
+    spec = jnp.swapaxes(x, -1, -2)  # [..., num_frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window
+    y = overlap_add(jnp.swapaxes(frames, -1, -2), hop_length, axis=-1)
+
+    # window-square envelope for the least-squares normalization
+    num_frames = x.shape[-1]
+    wsq = jnp.square(window)
+    env = overlap_add(
+        jnp.broadcast_to(wsq[:, None], (n_fft, num_frames)),
+        hop_length, axis=-1,
+    )
+    y = y / jnp.where(env > 1e-11, env, 1.0)
+
+    if center:
+        y = y[..., n_fft // 2:]
+    if length is not None:
+        y = y[..., :length]
+    else:
+        # drop the trailing center pad (paddle default: full OLA minus pad)
+        if center:
+            y = y[..., : y.shape[-1] - n_fft // 2]
+    return y

@@ -1,0 +1,494 @@
+"""Round-3 surface widening: CTC loss, the extended loss zoo, and the
+extended optimizer zoo — numeric parity vs torch (CPU) where torch
+implements the same formula, vs hand-rolled numpy otherwise.
+
+Parity model: upstream test/legacy_test/test_warpctc_op.py,
+test_ctc_loss.py, test_rmsprop_op.py, test_adamax_op.py,
+test_adadelta_op.py, test_nadam_op.py, test_radam_op.py,
+test_rprop_op.py, test_asgd_op.py, and the paddle.nn loss tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt
+import paddle_tpu.nn.functional as F
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+class TestCTC:
+    def _case(self, seed=0, T=12, B=4, C=6, L=5):
+        rng = np.random.default_rng(seed)
+        logits = rng.normal(size=(T, B, C)).astype(np.float32)
+        labels = rng.integers(1, C, (B, L))
+        ilen = np.array([T, T - 2, T, 7], np.int64)[:B]
+        llen = np.array([L, 3, 4, 0], np.int64)[:B]
+        return logits, labels, ilen, llen
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_vs_torch(self, reduction):
+        logits, labels, ilen, llen = self._case()
+        ours = F.ctc_loss(
+            jnp.asarray(logits), jnp.asarray(labels), ilen, llen,
+            blank=0, reduction=reduction,
+        )
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), dim=-1),
+            torch.tensor(labels), torch.tensor(ilen), torch.tensor(llen),
+            blank=0, reduction=reduction if reduction != "none" else "none",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours), ref.numpy(), rtol=2e-4, atol=2e-4
+        )
+
+    def test_grad_vs_torch(self):
+        logits, labels, ilen, llen = self._case(seed=3)
+        g = jax.grad(
+            lambda x: F.ctc_loss(
+                x, jnp.asarray(labels), ilen, llen, reduction="mean"
+            )
+        )(jnp.asarray(logits))
+        lt = torch.tensor(logits, requires_grad=True)
+        torch.nn.functional.ctc_loss(
+            torch.log_softmax(lt, -1), torch.tensor(labels),
+            torch.tensor(ilen), torch.tensor(llen), reduction="mean",
+        ).backward()
+        np.testing.assert_allclose(
+            np.asarray(g), lt.grad.numpy(), rtol=1e-3, atol=1e-4
+        )
+
+    def test_nonblank_zero(self):
+        """blank can be any class id, not just 0."""
+        logits, labels, ilen, llen = self._case(seed=1)
+        labels = np.where(labels == 5, 1, labels)  # keep 5 free for blank
+        ours = F.ctc_loss(
+            jnp.asarray(logits), jnp.asarray(labels), ilen, llen,
+            blank=5, reduction="mean",
+        )
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), dim=-1),
+            torch.tensor(labels), torch.tensor(ilen), torch.tensor(llen),
+            blank=5, reduction="mean",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours), ref.numpy(), rtol=2e-4, atol=2e-4
+        )
+
+    def test_layer_and_jit(self):
+        logits, labels, ilen, llen = self._case(seed=2)
+        layer = nn.CTCLoss(blank=0, reduction="sum")
+        eager = layer(jnp.asarray(logits), jnp.asarray(labels), ilen, llen)
+        jitted = jax.jit(
+            lambda x: layer(x, jnp.asarray(labels), ilen, llen)
+        )(jnp.asarray(logits))
+        np.testing.assert_allclose(
+            np.asarray(eager), np.asarray(jitted), rtol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# loss zoo vs torch
+# ---------------------------------------------------------------------------
+class TestLossZoo:
+    def setup_method(self, _):
+        rng = np.random.default_rng(7)
+        self.x = rng.normal(size=(8, 5)).astype(np.float32)
+        self.y = rng.normal(size=(8, 5)).astype(np.float32)
+        self.rng = rng
+
+    def _cmp(self, ours, theirs, **tol):
+        tol.setdefault("rtol", 1e-5)
+        tol.setdefault("atol", 1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.numpy(), **tol
+        )
+
+    def test_bce(self):
+        p = 1.0 / (1.0 + np.exp(-self.x))
+        t = (self.y > 0).astype(np.float32)
+        self._cmp(
+            nn.BCELoss()(jnp.asarray(p), jnp.asarray(t)),
+            torch.nn.BCELoss()(torch.tensor(p), torch.tensor(t)),
+        )
+
+    def test_cosine_embedding(self):
+        lab = np.where(self.rng.random(8) > 0.5, 1, -1).astype(np.int64)
+        self._cmp(
+            nn.CosineEmbeddingLoss(margin=0.2)(
+                jnp.asarray(self.x), jnp.asarray(self.y), jnp.asarray(lab)
+            ),
+            torch.nn.CosineEmbeddingLoss(margin=0.2)(
+                torch.tensor(self.x), torch.tensor(self.y),
+                torch.tensor(lab),
+            ),
+        )
+
+    def test_triplet_margin(self):
+        z = self.rng.normal(size=(8, 5)).astype(np.float32)
+        self._cmp(
+            nn.TripletMarginLoss(margin=1.0)(
+                jnp.asarray(self.x), jnp.asarray(self.y), jnp.asarray(z)
+            ),
+            torch.nn.TripletMarginLoss(margin=1.0)(
+                torch.tensor(self.x), torch.tensor(self.y), torch.tensor(z)
+            ),
+            rtol=1e-4,
+        )
+
+    def test_soft_margin(self):
+        lab = np.where(self.y > 0, 1.0, -1.0).astype(np.float32)
+        self._cmp(
+            nn.SoftMarginLoss()(jnp.asarray(self.x), jnp.asarray(lab)),
+            torch.nn.SoftMarginLoss()(
+                torch.tensor(self.x), torch.tensor(lab)
+            ),
+        )
+
+    def test_hinge_embedding(self):
+        lab = np.where(self.y > 0, 1.0, -1.0).astype(np.float32)
+        self._cmp(
+            nn.HingeEmbeddingLoss(margin=1.0)(
+                jnp.asarray(self.x), jnp.asarray(lab)
+            ),
+            torch.nn.HingeEmbeddingLoss(margin=1.0)(
+                torch.tensor(self.x), torch.tensor(lab)
+            ),
+        )
+
+    @pytest.mark.parametrize("log_input,full", [(True, False), (False, True)])
+    def test_poisson_nll(self, log_input, full):
+        t = np.abs(self.y) * 3
+        self._cmp(
+            nn.PoissonNLLLoss(log_input=log_input, full=full)(
+                jnp.asarray(self.x), jnp.asarray(t)
+            ),
+            torch.nn.PoissonNLLLoss(
+                log_input=log_input, full=full, eps=1e-8
+            )(torch.tensor(self.x), torch.tensor(t)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_gaussian_nll(self):
+        var = np.abs(self.y) + 0.1
+        self._cmp(
+            nn.GaussianNLLLoss(full=True)(
+                jnp.asarray(self.x), jnp.asarray(self.y), jnp.asarray(var)
+            ),
+            torch.nn.GaussianNLLLoss(full=True)(
+                torch.tensor(self.x), torch.tensor(self.y),
+                torch.tensor(var),
+            ),
+        )
+
+    def test_multilabel_soft_margin(self):
+        t = (self.y > 0).astype(np.float32)
+        self._cmp(
+            nn.MultiLabelSoftMarginLoss()(
+                jnp.asarray(self.x), jnp.asarray(t)
+            ),
+            torch.nn.MultiLabelSoftMarginLoss()(
+                torch.tensor(self.x), torch.tensor(t)
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# optimizer zoo
+# ---------------------------------------------------------------------------
+def _run_ours(o, w0, grads_seq):
+    params = {"w": jnp.asarray(w0)}
+    state = o.init(params)
+    for g in grads_seq:
+        params, state = o.update({"w": jnp.asarray(g)}, state, params)
+    return np.asarray(params["w"])
+
+
+def _run_torch(cls, w0, grads_seq, **kw):
+    w = torch.tensor(w0.copy(), requires_grad=True)
+    o = cls([w], **kw)
+    for g in grads_seq:
+        w.grad = torch.tensor(g)
+        o.step()
+    return w.detach().numpy()
+
+
+@pytest.fixture
+def grads_seq():
+    rng = np.random.default_rng(11)
+    return [rng.normal(size=(6, 4)).astype(np.float32) for _ in range(5)]
+
+
+@pytest.fixture
+def w0():
+    return np.random.default_rng(5).normal(size=(6, 4)).astype(np.float32)
+
+
+class TestOptimizerZoo:
+    def test_adamax_vs_torch(self, w0, grads_seq):
+        ours = _run_ours(
+            opt.Adamax(learning_rate=0.01, multi_precision=False),
+            w0, grads_seq,
+        )
+        ref = _run_torch(torch.optim.Adamax, w0, grads_seq, lr=0.01)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_adadelta_vs_torch(self, w0, grads_seq):
+        ours = _run_ours(
+            opt.Adadelta(learning_rate=0.5, rho=0.9, epsilon=1e-6,
+                         multi_precision=False),
+            w0, grads_seq,
+        )
+        ref = _run_torch(torch.optim.Adadelta, w0, grads_seq,
+                         lr=0.5, rho=0.9, eps=1e-6)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_nadam_vs_torch(self, w0, grads_seq):
+        ours = _run_ours(
+            opt.NAdam(learning_rate=0.01, multi_precision=False),
+            w0, grads_seq,
+        )
+        ref = _run_torch(torch.optim.NAdam, w0, grads_seq, lr=0.01)
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+    def test_radam_vs_torch(self, w0, grads_seq):
+        ours = _run_ours(
+            opt.RAdam(learning_rate=0.01, multi_precision=False),
+            w0, grads_seq,
+        )
+        ref = _run_torch(torch.optim.RAdam, w0, grads_seq, lr=0.01)
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+    def test_rprop_vs_torch(self, w0, grads_seq):
+        ours = _run_ours(
+            opt.Rprop(learning_rate=0.01, multi_precision=False),
+            w0, grads_seq,
+        )
+        ref = _run_torch(torch.optim.Rprop, w0, grads_seq, lr=0.01)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_rmsprop_vs_numpy(self, w0, grads_seq):
+        """paddle semantics: denom = sqrt(ms + eps) (torch uses
+        sqrt(ms) + eps, so compare against numpy, not torch)."""
+        rho, eps, lr, mom = 0.95, 1e-6, 0.01, 0.9
+        ours = _run_ours(
+            opt.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                        momentum=mom, multi_precision=False),
+            w0, grads_seq,
+        )
+        w = w0.copy().astype(np.float64)
+        ms = np.zeros_like(w)
+        v = np.zeros_like(w)
+        for g in grads_seq:
+            g = g.astype(np.float64)
+            ms = rho * ms + (1 - rho) * g * g
+            v = mom * v + lr * g / np.sqrt(ms + eps)
+            w = w - v
+        np.testing.assert_allclose(ours, w, rtol=1e-4, atol=1e-5)
+
+    def test_rmsprop_centered(self, w0, grads_seq):
+        rho, eps, lr = 0.9, 1e-6, 0.01
+        ours = _run_ours(
+            opt.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                        centered=True, multi_precision=False),
+            w0, grads_seq,
+        )
+        w = w0.copy().astype(np.float64)
+        ms = np.zeros_like(w)
+        mg = np.zeros_like(w)
+        v = np.zeros_like(w)
+        for g in grads_seq:
+            g = g.astype(np.float64)
+            ms = rho * ms + (1 - rho) * g * g
+            mg = rho * mg + (1 - rho) * g
+            v = lr * g / np.sqrt(ms - mg * mg + eps)
+            w = w - v
+        np.testing.assert_allclose(ours, w, rtol=1e-4, atol=1e-5)
+
+    def test_asgd_window_mean(self, w0):
+        """ASGD with batch_num=n: d converges to the running mean of the
+        last grads; with a constant grad, the update equals plain SGD."""
+        g = np.full((6, 4), 0.5, np.float32)
+        ours = _run_ours(
+            opt.ASGD(learning_rate=0.1, batch_num=4,
+                     multi_precision=False),
+            w0, [g] * 3,
+        )
+        np.testing.assert_allclose(ours, w0 - 3 * 0.1 * 0.5, rtol=1e-5)
+
+    def test_all_converge_quadratic(self):
+        """every optimizer shrinks f(w)=||w||^2 (integration smoke)."""
+        for cls, kw in [
+            (opt.RMSProp, {}), (opt.Adamax, {}), (opt.Adadelta,
+                                                  {"learning_rate": 1.0}),
+            (opt.NAdam, {}), (opt.RAdam, {}), (opt.ASGD, {}),
+            (opt.Rprop, {}),
+        ]:
+            o = cls(multi_precision=False, **kw)
+            params = {"w": jnp.ones((8,), jnp.float32)}
+            state = o.init(params)
+            for _ in range(50):
+                g = {"w": 2.0 * params["w"]}
+                params, state = o.update(g, state, params)
+            assert float(jnp.sum(params["w"] ** 2)) < 8.0, cls.__name__
+
+    def test_eager_step_api(self, w0):
+        """paddle-style: opt(parameters=...), backward, step."""
+        lin = nn.Linear(4, 2)
+        o = opt.RMSProp(learning_rate=0.01,
+                        parameters=lin.parameters())
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(3, 4)).astype(np.float32))
+
+        from paddle_tpu.core.functional import extract_params, functional_call
+
+        params = extract_params(lin)
+        grads = jax.grad(
+            lambda p: jnp.sum(functional_call(lin, p, x) ** 2)
+        )(params)
+        before = np.asarray(lin.weight.value).copy()
+        o.set_gradients(grads)
+        o.step()
+        assert not np.allclose(before, np.asarray(lin.weight.value))
+
+
+# ---------------------------------------------------------------------------
+# io: samplers + dataset combinators
+# ---------------------------------------------------------------------------
+class TestIoSamplers:
+    def test_sequence_and_random_sampler(self):
+        from paddle_tpu import io
+
+        ds = io.TensorDataset(np.arange(10))
+        assert list(io.SequenceSampler(ds)) == list(range(10))
+        idx = list(io.RandomSampler(ds, generator=0))
+        assert sorted(idx) == list(range(10))
+        idx2 = list(io.RandomSampler(ds, replacement=True, num_samples=30))
+        assert len(idx2) == 30 and max(idx2) < 10
+
+    def test_weighted_sampler(self):
+        from paddle_tpu import io
+
+        s = io.WeightedRandomSampler([0.0, 0.0, 1.0], num_samples=20)
+        assert list(s) == [2] * 20
+
+    def test_sampler_drives_batch_sampler(self):
+        from paddle_tpu import io
+
+        ds = io.TensorDataset(np.arange(8))
+        bs = io.BatchSampler(
+            sampler=io.SequenceSampler(ds), batch_size=3
+        )
+        assert list(bs) == [[0, 1, 2], [3, 4, 5], [6, 7]]
+
+    def test_concat_compose_chain(self):
+        from paddle_tpu import io
+
+        a = io.TensorDataset(np.arange(3))
+        b = io.TensorDataset(np.arange(10, 14))
+        cat = io.ConcatDataset([a, b])
+        assert len(cat) == 7
+        assert int(cat[3][0]) == 10 and int(cat[-1][0]) == 13
+
+        comp = io.ComposeDataset([a, io.TensorDataset(np.arange(100, 103))])
+        assert len(comp) == 3
+        assert tuple(int(v) for v in comp[1]) == (1, 101)
+
+        class It(io.IterableDataset):
+            def __init__(self, vals):
+                self.vals = vals
+
+            def __iter__(self):
+                return iter(self.vals)
+
+        ch = io.ChainDataset([It([1, 2]), It([3])])
+        assert list(ch) == [1, 2, 3]
+
+    def test_worker_info_main_process(self):
+        from paddle_tpu import io
+
+        assert io.get_worker_info() is None
+
+
+# ---------------------------------------------------------------------------
+# LBFGS
+# ---------------------------------------------------------------------------
+class TestLBFGS:
+    def _quad_setup(self):
+        rng = np.random.default_rng(3)
+        A = rng.normal(size=(6, 6)).astype(np.float32)
+        A = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+        b = rng.normal(size=(6,)).astype(np.float32)
+        w0 = rng.normal(size=(6,)).astype(np.float32)
+        return A, b, w0
+
+    def test_parity_vs_torch_no_linesearch(self):
+        A, b, w0 = self._quad_setup()
+        from paddle_tpu.core.parameter import Parameter
+
+        p = Parameter(jnp.asarray(w0.copy()), name="w")
+        o = opt.LBFGS(learning_rate=0.5, max_iter=4, parameters=[p])
+        Aj, bj = jnp.asarray(A), jnp.asarray(b)
+
+        def closure():
+            w = p.value
+            loss = 0.5 * w @ Aj @ w - bj @ w
+            p.grad = Aj @ w - bj
+            return loss
+
+        o.step(closure)
+        ours = np.asarray(p.value)
+
+        wt = torch.tensor(w0.copy(), requires_grad=True)
+        ot = torch.optim.LBFGS([wt], lr=0.5, max_iter=4)
+        At, bt = torch.tensor(A), torch.tensor(b)
+
+        def tclosure():
+            ot.zero_grad()
+            loss = 0.5 * wt @ At @ wt - bt @ wt
+            loss.backward()
+            return loss
+
+        ot.step(tclosure)
+        np.testing.assert_allclose(ours, wt.detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_strong_wolfe_converges_rosenbrock(self):
+        from paddle_tpu.core.parameter import Parameter
+
+        p = Parameter(jnp.asarray(np.array([-1.2, 1.0], np.float32)),
+                      name="w")
+        o = opt.LBFGS(learning_rate=1.0, max_iter=100,
+                      line_search_fn="strong_wolfe", parameters=[p])
+
+        def rosen(w):
+            return (1 - w[0]) ** 2 + 100.0 * (w[1] - w[0] ** 2) ** 2
+
+        def closure():
+            loss, g = jax.value_and_grad(rosen)(p.value)
+            p.grad = g
+            return loss
+
+        for _ in range(8):
+            o.step(closure)
+        w = np.asarray(p.value)
+        assert float(rosen(jnp.asarray(w))) < 1e-4, w
+
+    def test_backward_populates_param_grad(self):
+        lin = nn.Linear(3, 2)
+        from paddle_tpu import autograd
+
+        x = jnp.ones((4, 3))
+        loss, grads = autograd.backward(
+            lin, lambda out: jnp.sum(out ** 2), x
+        )
+        assert lin.weight.grad is not None
+        np.testing.assert_allclose(
+            np.asarray(lin.weight.grad),
+            np.asarray(grads[lin.weight.name]),
+        )
