@@ -11,6 +11,7 @@ and the host-side runtime (trainer, data, checkpoint, launch, profiler).
 """
 
 from . import amp  # noqa: F401
+from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import flags  # noqa: F401

@@ -1,0 +1,14 @@
+"""paddle_tpu.audio (parity: python/paddle/audio/ — features + functional;
+the backends/datasets subpackages are file-IO utilities upstream and are
+served here by paddle_tpu.io + vision.datasets-style local loading)."""
+
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,107 @@
+"""paddle_tpu.audio.features (parity: python/paddle/audio/features/layers.py
+— Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+The STFT front-end is paddle_tpu.signal.stft (gather-framed, XLA Fft);
+the mel filterbank and DCT basis are precomputed numpy constants baked
+into the layer, so the device-side work per call is |STFT|^power followed
+by two matmuls — a shape XLA fuses into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.module import Layer
+from .. import signal as _signal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = jnp.asarray(
+            F.get_window(window, self.win_length, fftbins=True, dtype=dtype)
+        )
+
+    def forward(self, x):
+        spec = _signal.stft(
+            x, self.n_fft, self.hop_length, self.win_length, self.window,
+            center=self.center, pad_mode=self.pad_mode, onesided=True,
+        )
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center, pad_mode,
+            dtype,
+        )
+        self.n_mels = n_mels
+        self.fbank = jnp.asarray(
+            F.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+            )
+        )  # [n_mels, n_freq]
+
+    def forward(self, x):
+        spec = self.spectrogram(x)              # [..., n_freq, frames]
+        return jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self.mel_spectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self.mel_spectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, norm: str = "ortho",
+                 n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, "slaney", ref_value, amin,
+            top_db, dtype,
+        )
+        self.dct = jnp.asarray(
+            F.create_dct(n_mfcc, n_mels, norm, dtype)
+        )  # [n_mels, n_mfcc]
+
+    def forward(self, x):
+        logmel = self.log_mel(x)                 # [..., n_mels, frames]
+        return jnp.einsum("mk,...mt->...kt", self.dct, logmel)

@@ -164,6 +164,10 @@ class RandomSampler(Sampler):
         rng = np.random.default_rng(seed)
         if self.replacement:
             return iter(rng.integers(0, n, self.num_samples).tolist())
+        if self.num_samples > n:
+            raise ValueError(
+                f"RandomSampler: num_samples={self.num_samples} exceeds "
+                f"dataset size {n} without replacement")
         return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):

@@ -92,3 +92,133 @@ def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
         _v(a), _v(b), lower=not upper, trans=1 if transpose else 0,
         unit_diagonal=unitriangular,
     )
+
+
+def cholesky_solve(b, y, upper=False):
+    """Parity: paddle.linalg.cholesky_solve — solve A x = b given the
+    Cholesky factor y of A."""
+    import jax.scipy.linalg as jsl
+
+    return jsl.cho_solve((_v(y), not upper), _v(b))
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(_v(x))
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(_v(x), UPLO=UPLO)
+
+
+def lu(x, pivot=True):
+    """Parity: paddle.linalg.lu — packed LU plus pivots (1-based, paddle
+    convention matching the LAPACK getrf output)."""
+    import jax.scipy.linalg as jsl
+
+    lu_mat, piv = jsl.lu_factor(_v(x))
+    return lu_mat, piv + 1
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Parity: paddle.linalg.lu_unpack → (P, L, U). 2-D only (batched
+    unpack: vmap this)."""
+    lu_mat = _v(lu_data)
+    if lu_mat.ndim != 2:
+        raise ValueError("lu_unpack: 2-D input only; vmap for batches")
+    n = lu_mat.shape[-2]
+    m = lu_mat.shape[-1]
+    k = min(n, m)
+    L = jnp.tril(lu_mat[..., :k], -1) + jnp.eye(n, k, dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat[..., :k, :])
+    # pivots (1-based sequential row swaps) → permutation matrix
+    piv = jnp.asarray(lu_pivots) - 1
+    perm = jnp.arange(n)
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+    return P, L, U
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    """Parity: paddle.linalg.cov (ddof bool → 1 or 0)."""
+    return jnp.cov(
+        _v(x), rowvar=rowvar, ddof=1 if ddof else 0,
+        fweights=fweights, aweights=aweights,
+    )
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(_v(x), rowvar=rowvar)
+
+
+def multi_dot(tensors):
+    return jnp.linalg.multi_dot([_v(t) for t in tensors])
+
+
+def matrix_exp(x):
+    import jax.scipy.linalg as jsl
+
+    return jsl.expm(_v(x))
+
+
+def svdvals(x):
+    return jnp.linalg.svd(_v(x), compute_uv=False)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    x = _v(x)
+    if axis is None:
+        out = jnp.linalg.norm(x.reshape(-1), ord=p)
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(_v(x), ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def matrix_transpose(x):
+    return jnp.swapaxes(_v(x), -1, -2)
+
+
+def householder_product(x, tau):
+    """Parity: paddle.linalg.householder_product (LAPACK orgqr)."""
+    from jax.lax import linalg as lax_linalg
+
+    return lax_linalg.householder_product(_v(x), _v(tau))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """Parity: paddle.linalg.svd_lowrank — randomized range finder with
+    ``niter`` subspace iterations (Halko et al.), the same algorithm the
+    reference wraps. Deterministic: the projection uses a fixed-seed
+    gaussian (jax PRNG; no global RNG state to vary)."""
+    import jax
+
+    a = _v(x)
+    if M is not None:
+        a = a - _v(M)
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(Q, -1, -2) @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return Q @ u_b, s, jnp.swapaxes(vt, -1, -2)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Parity: paddle.linalg.pca_lowrank."""
+    a = _v(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    return svd_lowrank(a, q=q, niter=niter)

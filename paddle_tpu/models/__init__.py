@@ -17,5 +17,10 @@ from .llama import (  # noqa: F401
 )
 from .mamba import MambaConfig, MambaForCausalLM  # noqa: F401
 from .rwkv import RWKVConfig, RWKVForCausalLM  # noqa: F401
+from .t5 import (  # noqa: F401
+    T5Config,
+    T5ForConditionalGeneration,
+    T5Model,
+)
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
 from .vit import ViT, ViTConfig  # noqa: F401

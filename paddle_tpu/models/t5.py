@@ -1,0 +1,354 @@
+"""T5 encoder-decoder family.
+
+Parity: PaddleNLP `T5Model` / `T5ForConditionalGeneration`
+(paddlenlp/transformers/t5/modeling.py) — the relative-position-bias
+encoder-decoder with T5LayerNorm (RMS, no bias), no attention scaling
+(folded into init), tied input embeddings, and the v1.1 gated-gelu MLP
+variant behind ``feed_forward_proj``.
+
+TPU-native notes: the relative position bias makes self-attention a
+biased softmax, so it runs through the XLA SDPA path (additive bias
+fuses into the logits einsum); cross-attention carries no bias and is
+flash-eligible. The bias itself is computed ONCE per stack from a static
+bucket table (host-free: jnp ops on broadcasted iotas) and reused by
+every layer, exactly the reference's shared `relative_attention_bias`.
+Decoding re-uses the encoder output; the decoder is re-run per step on
+the growing prefix (AOT-bucketed decode lives in the inference engine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..core import initializer as I
+from ..core.module import Layer
+from ..distributed.parallel_layers.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..kernels import flash_attention as fa
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, LayerList
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    initializer_factor: float = 1.0
+    feed_forward_proj: str = "relu"   # or "gated-gelu" (t5 v1.1)
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.num_decoder_layers is None:
+            self.num_decoder_layers = self.num_layers
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("d_kv", 16)
+        kw.setdefault("d_ff", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("dropout_rate", 0.0)
+        return cls(**kw)
+
+
+class T5LayerNorm(Layer):
+    """RMS norm, no bias, no mean subtraction (the T5 original)."""
+
+    def __init__(self, hidden_size, eps=1e-6):
+        super().__init__()
+        from ..core.parameter import Parameter
+
+        self.weight = Parameter(jnp.ones((hidden_size,)), name="t5ln_w")
+        self.eps = eps
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.eps)
+
+
+def _relative_position_bucket(relative_position, bidirectional, num_buckets,
+                              max_distance):
+    """Static bucket table (reference: T5Attention._relative_position_bucket)
+    — pure jnp on iotas, shape [q, k] int32."""
+    rp = relative_position
+    ret = jnp.zeros_like(rp)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + jnp.where(rp > 0, num_buckets, 0)
+        rp = jnp.abs(rp)
+    else:
+        rp = -jnp.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    log_ratio = (
+        jnp.log(jnp.maximum(rp, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+    )
+    large = max_exact + (log_ratio * (num_buckets - max_exact)).astype(
+        jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, rp, large)
+
+
+class T5RelativeBias(Layer):
+    """The per-stack shared relative_attention_bias embedding."""
+
+    def __init__(self, config: T5Config, bidirectional: bool):
+        super().__init__()
+        from ..nn.layer.common import Embedding
+
+        self.embedding = Embedding(
+            config.relative_attention_num_buckets, config.num_heads,
+            weight_attr=I.Normal(
+                0.0, config.initializer_factor * config.d_model ** -0.5),
+        )
+        self.bidirectional = bidirectional
+        self.config = config
+
+    def forward(self, q_len, k_len):
+        cfg = self.config
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )
+        bias = self.embedding(buckets)            # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]  # [1, h, q, k]
+
+
+class T5Attention(Layer):
+    def __init__(self, config: T5Config, is_cross: bool = False):
+        super().__init__()
+        cfg = config
+        self.config = config
+        self.is_cross = is_cross
+        inner = cfg.num_heads * cfg.d_kv
+        init = I.Normal(0.0, cfg.initializer_factor * (
+            cfg.d_model * cfg.d_kv) ** -0.5)
+        init_o = I.Normal(0.0, cfg.initializer_factor * inner ** -0.5)
+        self.q = ColumnParallelLinear(cfg.d_model, inner, has_bias=False,
+                                      weight_attr=init)
+        self.k = ColumnParallelLinear(cfg.d_model, inner, has_bias=False,
+                                      weight_attr=init)
+        self.v = ColumnParallelLinear(cfg.d_model, inner, has_bias=False,
+                                      weight_attr=init)
+        self.o = RowParallelLinear(inner, cfg.d_model, has_bias=False,
+                                   weight_attr=init_o)
+
+    def forward(self, x, kv=None, position_bias=None, causal=False,
+                attention_mask=None):
+        cfg = self.config
+        b, sq, _ = x.shape
+        kv = x if kv is None else kv
+        sk = kv.shape[1]
+        q = self.q(x).reshape(b, sq, cfg.num_heads, cfg.d_kv)
+        k = self.k(kv).reshape(b, sk, cfg.num_heads, cfg.d_kv)
+        v = self.v(kv).reshape(b, sk, cfg.num_heads, cfg.d_kv)
+        drop = cfg.dropout_rate if self.training else 0.0
+        if position_bias is None and cfg.use_flash_attention \
+                and attention_mask is None and drop == 0.0:
+            # cross-attention: bias-free → flash path (T5 has no scaling,
+            # so pre-scale q by d_kv**0.5 to cancel the kernel's 1/sqrt(d))
+            out = fa.flash_attention(
+                q * (cfg.d_kv ** 0.5), k, v, causal=causal,
+                training=self.training)
+        else:
+            bias = position_bias
+            if attention_mask is not None:
+                pad = jnp.where(attention_mask[:, None, None, :] > 0,
+                                0.0, -1e30).astype(jnp.float32)
+                bias = pad if bias is None else bias + pad
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=bias, is_causal=causal, scale=1.0,
+                dropout_p=drop, training=self.training)
+        return self.o(out.reshape(b, sq, -1))
+
+
+class T5FF(Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        cfg = config
+        init_i = I.Normal(0.0, cfg.initializer_factor * cfg.d_model ** -0.5)
+        init_o = I.Normal(0.0, cfg.initializer_factor * cfg.d_ff ** -0.5)
+        self.gated = cfg.feed_forward_proj.startswith("gated")
+        self.wi = ColumnParallelLinear(cfg.d_model, cfg.d_ff,
+                                       has_bias=False, weight_attr=init_i)
+        if self.gated:
+            self.wi_1 = ColumnParallelLinear(
+                cfg.d_model, cfg.d_ff, has_bias=False, weight_attr=init_i)
+        self.wo = RowParallelLinear(cfg.d_ff, cfg.d_model, has_bias=False,
+                                    weight_attr=init_o)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, x):
+        if self.gated:
+            h = F.gelu(self.wi(x), approximate=True) * self.wi_1(x)
+        else:
+            h = F.relu(self.wi(x))
+        return self.wo(self.dropout(h))
+
+
+class T5Block(Layer):
+    def __init__(self, config: T5Config, is_decoder: bool):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.ln1 = T5LayerNorm(config.d_model, config.layer_norm_epsilon)
+        self.self_attn = T5Attention(config)
+        if is_decoder:
+            self.ln_cross = T5LayerNorm(config.d_model,
+                                        config.layer_norm_epsilon)
+            self.cross_attn = T5Attention(config, is_cross=True)
+        self.ln2 = T5LayerNorm(config.d_model, config.layer_norm_epsilon)
+        self.ff = T5FF(config)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, x, enc=None, position_bias=None,
+                attention_mask=None, enc_mask=None):
+        # attention_mask here is THIS stack's padding mask (encoder's for
+        # the encoder stack, decoder's for the decoder stack)
+        x = x + self.dropout(self.self_attn(
+            self.ln1(x), position_bias=position_bias,
+            causal=self.is_decoder, attention_mask=attention_mask))
+        if self.is_decoder and enc is not None:
+            x = x + self.dropout(self.cross_attn(
+                self.ln_cross(x), kv=enc, attention_mask=enc_mask))
+        return x + self.dropout(self.ff(self.ln2(x)))
+
+
+class T5Stack(Layer):
+    def __init__(self, config: T5Config, is_decoder: bool):
+        super().__init__()
+        n = config.num_decoder_layers if is_decoder else config.num_layers
+        self.is_decoder = is_decoder
+        self.relative_bias = T5RelativeBias(config,
+                                            bidirectional=not is_decoder)
+        self.blocks = LayerList(
+            [T5Block(config, is_decoder) for _ in range(n)])
+        self.final_norm = T5LayerNorm(config.d_model,
+                                      config.layer_norm_epsilon)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, x, enc=None, attention_mask=None, enc_mask=None):
+        s = x.shape[1]
+        bias = self.relative_bias(s, s)   # shared by every block (parity)
+        x = self.dropout(x)
+        for blk in self.blocks:
+            x = blk(x, enc=enc, position_bias=bias,
+                    attention_mask=attention_mask, enc_mask=enc_mask)
+        return self.dropout(self.final_norm(x))
+
+
+class T5Model(Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = VocabParallelEmbedding(
+            config.vocab_size, config.d_model,
+            weight_attr=I.Normal(0.0, config.initializer_factor),
+        )
+        self.encoder = T5Stack(config, is_decoder=False)
+        self.decoder = T5Stack(config, is_decoder=True)
+
+    def encode(self, input_ids, attention_mask=None):
+        return self.encoder(self.shared(input_ids),
+                            attention_mask=attention_mask)
+
+    def decode(self, decoder_input_ids, enc, enc_mask=None,
+               decoder_attention_mask=None):
+        return self.decoder(self.shared(decoder_input_ids), enc=enc,
+                            attention_mask=decoder_attention_mask,
+                            enc_mask=enc_mask)
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None,
+                decoder_attention_mask=None):
+        enc = self.encode(input_ids, attention_mask)
+        return self.decode(decoder_input_ids, enc, enc_mask=attention_mask,
+                           decoder_attention_mask=decoder_attention_mask)
+
+
+class T5ForConditionalGeneration(Layer):
+    """seq2seq LM head; loss when ``labels`` given (paddle convention:
+    labels shifted right internally to build decoder inputs)."""
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.t5 = T5Model(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.d_model, config.vocab_size, has_bias=False,
+                weight_attr=I.Normal(0.0, config.initializer_factor),
+            )
+
+    def _shift_right(self, labels):
+        start = jnp.full(
+            (labels.shape[0], 1), self.config.decoder_start_token_id,
+            labels.dtype)
+        return jnp.concatenate([start, labels[:, :-1]], axis=1)
+
+    def _logits(self, hidden):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            # rescale per the reference (d_model**-0.5 before the tied proj)
+            hidden = hidden * (cfg.d_model ** -0.5)
+            return hidden @ self.t5.shared.weight.value.T
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, decoder_input_ids=None, labels=None,
+                attention_mask=None, decoder_attention_mask=None):
+        if decoder_input_ids is None:
+            if labels is None:
+                raise ValueError("need decoder_input_ids or labels")
+            decoder_input_ids = self._shift_right(labels)
+        hidden = self.t5(input_ids, decoder_input_ids,
+                         attention_mask=attention_mask,
+                         decoder_attention_mask=decoder_attention_mask)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits.reshape(-1, self.config.vocab_size), labels.reshape(-1),
+            ignore_index=self.config.pad_token_id,
+        )
+
+    def generate(self, input_ids, max_length=20, attention_mask=None):
+        """Greedy decode: encoder runs once; the decoder re-runs on the
+        growing prefix inside one jitted lax.scan over a fixed-size
+        buffer (static shapes; the step index masks the suffix)."""
+        cfg = self.config
+        enc = self.t5.encode(input_ids, attention_mask)
+        b = input_ids.shape[0]
+        buf = jnp.full((b, max_length), cfg.pad_token_id, jnp.int32)
+        buf = buf.at[:, 0].set(cfg.decoder_start_token_id)
+
+        def step(buf, t):
+            hidden = self.t5.decode(buf, enc, enc_mask=attention_mask)
+            logits = self._logits(hidden)          # [b, max_len, vocab]
+            nxt = jnp.argmax(logits[:, t], axis=-1).astype(jnp.int32)
+            # t ranges 0..max_length-2, so t+1 stays in bounds; the causal
+            # mask keeps the pad suffix from influencing position t
+            return buf.at[:, t + 1].set(nxt), nxt
+
+        buf, toks = jax.lax.scan(step, buf, jnp.arange(max_length - 1))
+        return buf

@@ -674,7 +674,6 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         new = m + jnp.log(
             jnp.sum(jnp.exp(stacked - m[None]), axis=0)
         ) + emit_t
-        new = jnp.where(jnp.isfinite(m), new, neg_inf)
         # freeze alpha once past each sequence's input length
         alpha = jnp.where((t < input_lengths)[:, None], new, alpha)
         return alpha, None

@@ -296,11 +296,8 @@ class MultiLabelSoftMarginLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax.numpy as jnp
-
-        from .. import functional as F
-
         import jax
+        import jax.numpy as jnp
 
         loss = -(label * jax.nn.log_sigmoid(input)
                  + (1 - label) * jax.nn.log_sigmoid(-input))

@@ -104,7 +104,7 @@ class LBFGS:
                 bracket = (t_prev, t, f_prev, f_new, g_prev, g_new)
                 break
             t_prev, f_prev, g_prev = t, f_new, g_new
-            t = min(10 * t, t * 2 ** 1)  # expand
+            t = 2.0 * t  # bracket expansion
             f_new, g_new = self._eval(closure, x + t * d)
             gtd_new = float(g_new @ d)
             ls_iter += 1
@@ -215,7 +215,12 @@ class LBFGS:
             p.grad = None
 
     def state_dict(self):
-        return {"lr": self.lr, "state": dict(self._state)}
+        st = dict(self._state)
+        # snapshot the mutable curvature history — the live lists keep
+        # being appended/popped by step()
+        for k in ("old_sks", "old_yks", "ro"):
+            st[k] = list(st[k])
+        return {"lr": self.lr, "state": st}
 
     def set_state_dict(self, d):
         self.lr = d.get("lr", self.lr)

@@ -1,0 +1,118 @@
+"""paddle_tpu.audio parity (upstream model: test/legacy_test/test_audio_*
+— mel/DCT checked against the librosa formulas the reference follows)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import audio
+from paddle_tpu.audio import functional as AF
+
+
+class TestFunctional:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            f = np.array([0.0, 250.0, 999.0, 1000.0, 4000.0, 11025.0])
+            back = AF.mel_to_hz(AF.hz_to_mel(f, htk), htk)
+            np.testing.assert_allclose(back, f, rtol=1e-10, atol=1e-8)
+
+    def test_hz_to_mel_htk_formula(self):
+        np.testing.assert_allclose(
+            AF.hz_to_mel(700.0, htk=True), 2595.0 * math.log10(2.0)
+        )
+
+    def test_mel_frequencies_monotone(self):
+        freqs = AF.mel_frequencies(40, 50.0, 8000.0)
+        assert freqs.shape == (40,)
+        assert np.all(np.diff(freqs) > 0)
+        np.testing.assert_allclose(freqs[0], 50.0, atol=1e-6)
+        np.testing.assert_allclose(freqs[-1], 8000.0, rtol=1e-6)
+
+    def test_fbank_matrix_properties(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40, f_min=0.0,
+                                     norm=None)
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # triangles: each filter has a single peak
+        for row in fb:
+            peak = row.argmax()
+            assert (np.diff(row[: peak + 1]) >= -1e-7).all()
+            assert (np.diff(row[peak:]) <= 1e-7).all()
+        # slaney norm: filters scaled by 2/bandwidth
+        fb_s = AF.compute_fbank_matrix(16000, 512, n_mels=40, f_min=0.0,
+                                       norm="slaney")
+        assert fb_s.shape == (40, 257) and fb_s.max() < fb.max()
+
+    def test_create_dct_orthonormal(self):
+        d = AF.create_dct(13, 40, norm="ortho").astype(np.float64)
+        # columns of an orthonormal DCT-II basis are orthonormal
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-6)
+
+    def test_get_window_periodic(self):
+        w = AF.get_window("hann", 64)
+        assert w.shape == (64,)
+        np.testing.assert_allclose(w, np.hanning(65)[:-1], atol=1e-7)
+        w2 = AF.get_window("hamming", 32, fftbins=False)
+        np.testing.assert_allclose(w2, np.hamming(32), atol=1e-7)
+        w3 = AF.get_window(("kaiser", 8.0), 32, fftbins=False)
+        np.testing.assert_allclose(w3, np.kaiser(32, 8.0), atol=1e-7)
+
+    def test_power_to_db(self):
+        s = jnp.asarray([1.0, 0.1, 1e-12])
+        db = np.asarray(AF.power_to_db(s, top_db=None))
+        np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-5)
+        np.testing.assert_allclose(db[2], -100.0, atol=1e-4)  # amin floor
+        db2 = np.asarray(AF.power_to_db(s, top_db=30.0))
+        assert db2.min() >= db2.max() - 30.0
+
+
+class TestFeatures:
+    @pytest.fixture
+    def wave(self):
+        t = np.arange(16000) / 16000.0
+        x = np.sin(2 * np.pi * 440.0 * t).astype(np.float32)
+        return jnp.asarray(x[None, :])  # [1, T]
+
+    def test_spectrogram_peak_at_440(self, wave):
+        layer = audio.Spectrogram(n_fft=512, hop_length=256)
+        s = np.asarray(layer(wave))
+        assert s.shape[1] == 257
+        freqs = AF.fft_frequencies(16000, 512)
+        peak_bin = s.mean(axis=-1)[0].argmax()
+        assert abs(freqs[peak_bin] - 440.0) < 16000 / 512  # within a bin
+
+    def test_mel_pipeline_shapes(self, wave):
+        mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+        m = np.asarray(mel(wave))
+        assert m.shape[:2] == (1, 40) and (m >= 0).all()
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+        lm = np.asarray(logmel(wave))
+        assert lm.shape == m.shape
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+        c = np.asarray(mfcc(wave))
+        assert c.shape[:2] == (1, 13)
+
+    def test_mfcc_equals_manual_dct(self, wave):
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+        lm = np.asarray(logmel(wave))[0]              # [40, T]
+        dct = AF.create_dct(13, 40).astype(np.float64)
+        manual = dct.T @ lm
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+        np.testing.assert_allclose(
+            np.asarray(mfcc(wave))[0], manual, rtol=1e-4, atol=1e-4
+        )
+
+    def test_jit_and_grad(self, wave):
+        import jax
+
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+        jitted = jax.jit(lambda x: mfcc(x))
+        np.testing.assert_allclose(
+            np.asarray(jitted(wave)), np.asarray(mfcc(wave)),
+            rtol=1e-5, atol=1e-5,
+        )
+        g = jax.grad(lambda x: jnp.sum(mfcc(x) ** 2))(wave)
+        assert np.isfinite(np.asarray(g)).all()

@@ -255,3 +255,145 @@ def test_group_sharded_and_recompute_api():
     np.testing.assert_allclose(np.asarray(vjp(jnp.ones(8))[0]),
                                np.asarray(ref_vjp(jnp.ones(8))[0]),
                                rtol=1e-6)
+
+
+@pytest.fixture(autouse=True)
+def _linalg_x64(request):
+    """fp64 comparisons against numpy/torch need x64 jax (CPU tests)."""
+    if "TestLinalgExtended" in request.node.nodeid:
+        import jax
+
+        with jax.enable_x64(True):
+            yield
+    else:
+        yield
+
+
+class TestLinalgExtended:
+    """Round-3 widening: the remaining paddle.linalg surface, checked
+    against torch.linalg / numpy."""
+
+    def setup_method(self, _):
+        import numpy as np
+
+        rng = np.random.default_rng(42)
+        a = rng.normal(size=(5, 5)).astype(np.float64)
+        self.spd = (a @ a.T + 5 * np.eye(5)).astype(np.float64)
+        self.a = a
+        self.rect = rng.normal(size=(8, 5)).astype(np.float64)
+
+    def test_cholesky_solve(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        b = np.ones((5, 2))
+        chol = np.linalg.cholesky(self.spd)
+        x = np.asarray(L.cholesky_solve(jnp.asarray(b), jnp.asarray(chol)))
+        np.testing.assert_allclose(self.spd @ x, b, atol=1e-8)
+
+    def test_eigvals_eigvalsh(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        ours = np.sort(np.asarray(L.eigvalsh(jnp.asarray(self.spd))))
+        ref = np.sort(np.linalg.eigvalsh(self.spd))
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+        ev = np.asarray(L.eigvals(jnp.asarray(self.spd)))
+        np.testing.assert_allclose(
+            np.sort(ev.real), ref, rtol=1e-6, atol=1e-8
+        )
+
+    def test_lu_roundtrip(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        lu_mat, piv = L.lu(jnp.asarray(self.a))
+        P, Lm, U = L.lu_unpack(lu_mat, piv)
+        np.testing.assert_allclose(
+            np.asarray(P @ Lm @ U), self.a, atol=1e-8
+        )
+
+    def test_cov_corrcoef(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        np.testing.assert_allclose(
+            np.asarray(L.cov(jnp.asarray(self.rect.T))),
+            np.cov(self.rect.T), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(L.corrcoef(jnp.asarray(self.rect.T))),
+            np.corrcoef(self.rect.T), rtol=1e-6,
+        )
+
+    def test_multi_dot_matrix_exp_svdvals(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import torch
+        from paddle_tpu import linalg as L
+
+        mats = [self.rect, self.spd, self.a]
+        np.testing.assert_allclose(
+            np.asarray(L.multi_dot([jnp.asarray(m) for m in mats])),
+            np.linalg.multi_dot(mats), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(L.matrix_exp(jnp.asarray(self.a * 0.1))),
+            torch.linalg.matrix_exp(torch.tensor(self.a * 0.1)).numpy(),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(L.svdvals(jnp.asarray(self.rect))),
+            np.linalg.svd(self.rect, compute_uv=False), rtol=1e-6,
+        )
+
+    def test_vector_matrix_norms(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        np.testing.assert_allclose(
+            float(L.vector_norm(jnp.asarray(self.rect), p=3.0)),
+            np.sum(np.abs(self.rect) ** 3) ** (1 / 3), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            float(L.matrix_norm(jnp.asarray(self.rect), p="fro")),
+            np.linalg.norm(self.rect, "fro"), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(L.matrix_transpose(jnp.asarray(self.rect))),
+            self.rect.T,
+        )
+
+    def test_householder_product(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import torch
+        from paddle_tpu import linalg as L
+
+        At = torch.tensor(self.rect)
+        h, tau = torch.geqrf(At)
+        ours = np.asarray(
+            L.householder_product(jnp.asarray(h.numpy()),
+                                  jnp.asarray(tau.numpy()))
+        )
+        ref = torch.linalg.householder_product(h, tau).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-8)
+
+    def test_lowrank(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu import linalg as L
+
+        # rank-3 matrix: svd_lowrank with q=3 reconstructs it
+        u = self.rect[:, :3]
+        m = (u @ u.T).astype(np.float64)  # 8x8 rank<=3
+        U, s, V = L.svd_lowrank(jnp.asarray(m), q=3, niter=4)
+        rec = np.asarray(U) * np.asarray(s) @ np.asarray(V).T
+        np.testing.assert_allclose(rec, m, atol=1e-6)
+        U2, s2, V2 = L.pca_lowrank(jnp.asarray(m), q=2)
+        assert U2.shape == (8, 2) and s2.shape == (2,)

@@ -1,0 +1,172 @@
+"""T5 encoder-decoder tests (parity model: PaddleNLP
+tests/transformers/t5/test_modeling.py — shape/grad/decode behavior +
+the reference bucket function checked against the published algorithm)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.functional import extract_params, functional_call
+from paddle_tpu.models import T5Config, T5ForConditionalGeneration, T5Model
+from paddle_tpu.models.t5 import _relative_position_bucket
+
+
+def _torch_t5_bucket(relative_position, bidirectional, num_buckets,
+                     max_distance):
+    """The published T5 bucket algorithm, re-stated in numpy as an
+    independent oracle."""
+    import numpy as np
+
+    rp = relative_position.astype(np.int64)
+    ret = np.zeros_like(rp)
+    if bidirectional:
+        num_buckets //= 2
+        ret += (rp > 0).astype(np.int64) * num_buckets
+        rp = np.abs(rp)
+    else:
+        rp = -np.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rp, 1) / max_exact)
+        / np.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, num_buckets - 1)
+    return ret + np.where(is_small, rp, large)
+
+
+class TestT5:
+    def test_bucket_function_matches_oracle(self):
+        q = np.arange(40)[:, None]
+        k = np.arange(40)[None, :]
+        rel = k - q
+        for bidir in (True, False):
+            ours = np.asarray(_relative_position_bucket(
+                jnp.asarray(rel), bidir, 32, 128))
+            ref = _torch_t5_bucket(rel, bidir, 32, 128)
+            np.testing.assert_array_equal(ours, ref)
+
+    def test_forward_shapes_and_loss(self):
+        pt.seed(0)
+        cfg = T5Config.tiny()
+        model = T5ForConditionalGeneration(cfg)
+        rng = np.random.default_rng(0)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)))
+        tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 8)))
+        logits = model(src, decoder_input_ids=tgt)
+        assert logits.shape == (2, 8, cfg.vocab_size)
+        loss = model(src, labels=tgt)
+        assert np.isfinite(float(loss))
+
+    def test_grads_and_training_step(self):
+        pt.seed(0)
+        cfg = T5Config.tiny()
+        model = T5ForConditionalGeneration(cfg)
+        rng = np.random.default_rng(1)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)))
+        tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 8)))
+        params = extract_params(model)
+
+        @jax.jit
+        def loss_fn(p):
+            return functional_call(model, p, src, labels=tgt)
+
+        losses = []
+        from paddle_tpu import optimizer as opt
+
+        o = opt.AdamW(learning_rate=5e-3, multi_precision=False)
+        state = o.init(params)
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        for _ in range(8):
+            loss, grads = grad_fn(params)
+            params, state = o.update(grads, state, params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_causality(self):
+        """decoder position t output must not depend on future decoder
+        inputs."""
+        pt.seed(0)
+        cfg = T5Config.tiny()
+        model = T5Model(cfg)
+        rng = np.random.default_rng(2)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 6)))
+        tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 8)))
+        out1 = model(src, tgt)
+        tgt2 = tgt.at[:, 5:].set(7)  # perturb the future
+        out2 = model(src, tgt2)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :5]), np.asarray(out2[:, :5]),
+            rtol=1e-4, atol=1e-5,
+        )
+        assert not np.allclose(np.asarray(out1[:, 5:]),
+                               np.asarray(out2[:, 5:]))
+
+    def test_encoder_padding_mask(self):
+        """padded encoder positions must not change unmasked outputs."""
+        pt.seed(0)
+        cfg = T5Config.tiny(use_flash_attention=False)
+        model = T5Model(cfg)
+        rng = np.random.default_rng(3)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 8)))
+        mask = jnp.asarray([[1, 1, 1, 1, 1, 0, 0, 0]])
+        tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 4)))
+        out1 = model(src, tgt, attention_mask=mask)
+        src2 = src.at[:, 5:].set(9)   # change only padded tokens
+        out2 = model(src2, tgt, attention_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(out1), np.asarray(out2), rtol=1e-4, atol=1e-5
+        )
+
+    def test_generate_greedy(self):
+        pt.seed(0)
+        cfg = T5Config.tiny()
+        model = T5ForConditionalGeneration(cfg)
+        rng = np.random.default_rng(4)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 10)))
+        out = model.generate(src, max_length=6)
+        assert out.shape == (2, 6)
+        assert (np.asarray(out[:, 0]) == cfg.decoder_start_token_id).all()
+        # greedy scan == step-by-step recompute
+        enc = model.t5.encode(src)
+        buf = np.asarray(out)
+        hidden = model.t5.decode(jnp.asarray(buf), enc)
+        logits = model._logits(hidden)
+        for t in range(5):
+            nxt = np.argmax(np.asarray(logits[:, t]), axis=-1)
+            np.testing.assert_array_equal(nxt, buf[:, t + 1])
+
+    def test_gated_gelu_variant(self):
+        pt.seed(0)
+        cfg = T5Config.tiny(feed_forward_proj="gated-gelu",
+                            tie_word_embeddings=False)
+        model = T5ForConditionalGeneration(cfg)
+        src = jnp.asarray(np.random.default_rng(5).integers(
+            1, cfg.vocab_size, (2, 6)))
+        loss = model(src, labels=src[:, :4])
+        assert np.isfinite(float(loss))
+        names = [n for n, _ in model.named_parameters()]
+        assert any("wi_1" in n for n in names)
+        assert any("lm_head" in n for n in names)
+
+    def test_decoder_padding_mask(self):
+        """padded decoder positions must not influence earlier real
+        positions via self-attention (left-context is causal anyway, so
+        check that changing pad CONTENT with the mask on is inert for
+        positions the mask hides from cross/self attention)."""
+        pt.seed(0)
+        cfg = T5Config.tiny(use_flash_attention=False)
+        model = T5Model(cfg)
+        rng = np.random.default_rng(6)
+        src = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 6)))
+        tgt = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, 8)))
+        dmask = jnp.asarray([[1, 1, 1, 1, 1, 1, 0, 0]])
+        out1 = model(src, tgt, decoder_attention_mask=dmask)
+        tgt2 = tgt.at[:, 6:].set(3)   # change only masked positions
+        out2 = model(src, tgt2, decoder_attention_mask=dmask)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :6]), np.asarray(out2[:, :6]),
+            rtol=1e-4, atol=1e-5,
+        )
