@@ -15,6 +15,7 @@ from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import flags  # noqa: F401
+from . import incubate  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
 from . import metric  # noqa: F401

@@ -1,0 +1,8 @@
+"""paddle_tpu.incubate (parity: python/paddle/incubate/ — the surfaces
+PaddleNLP and the fleet examples actually import: fused nn functional
+ops, LookAhead/ModelAverage optimizer wrappers, EMA)."""
+
+from . import nn  # noqa: F401
+from .optimizer import EMA, LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["nn", "LookAhead", "ModelAverage", "EMA"]

@@ -1,0 +1,5 @@
+"""paddle_tpu.incubate.nn (parity: python/paddle/incubate/nn/)."""
+
+from . import functional  # noqa: F401
+
+__all__ = ["functional"]

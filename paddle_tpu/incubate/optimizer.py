@@ -1,0 +1,150 @@
+"""Incubate optimizer wrappers (parity: python/paddle/incubate/optimizer/
+— LookAhead, ModelAverage; plus the EMA helper paddle ships as
+paddle.static ExponentialMovingAverage, exposed here dynamic-graph style
+since this framework has a single execution mode).
+
+Design: all three are *functional wrappers* around the inner optimizer's
+(init, update) pytree contract, so they compose with TrainStep/jit and
+ZeRO sharding exactly like any base optimizer — slow/averaged weights
+inherit the parameter's PartitionSpec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class LookAhead:
+    """k inner steps with the fast optimizer, then slow-weight
+    interpolation: slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def init(self, params):
+        return {
+            "inner": self.inner.init(params),
+            "slow": _tmap(lambda p: p.astype(jnp.float32), params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, state, params, scale=None):
+        new_params, inner_state = self.inner.update(
+            grads, state["inner"], params, scale=scale
+        )
+        step = state["step"] + 1
+        sync = (step % self.k) == 0
+
+        def merge(slow, fast):
+            slow_new = slow + self.alpha * (fast.astype(jnp.float32) - slow)
+            return jnp.where(sync, slow_new, slow)
+
+        slow = _tmap(merge, state["slow"], new_params)
+        fast = _tmap(
+            lambda s, f: jnp.where(sync, s.astype(f.dtype), f),
+            slow, new_params,
+        )
+        return fast, {"inner": inner_state, "slow": slow, "step": step}
+
+
+class ModelAverage:
+    """Running average of parameters over recent steps (parity:
+    paddle.incubate.ModelAverage's sum_1/sum_2/sum_3 windowed scheme,
+    reduced to the numerically-equivalent exponential/cumulative mean:
+    the reference's window is [min_average_window, max_average_window]
+    steps; we keep the cumulative mean, restarting when the window
+    exceeds max_average_window)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 inner_optimizer=None):
+        self.inner = inner_optimizer
+        self.max_window = int(max_average_window)
+
+    def init(self, params):
+        st = {
+            "avg": _tmap(lambda p: p.astype(jnp.float32), params),
+            "count": jnp.ones((), jnp.float32),
+        }
+        if self.inner is not None:
+            st["inner"] = self.inner.init(params)
+        return st
+
+    def update(self, grads, state, params, scale=None):
+        if self.inner is None:
+            raise ValueError("ModelAverage needs inner_optimizer for "
+                             "functional update()")
+        new_params, inner_state = self.inner.update(
+            grads, state["inner"], params, scale=scale
+        )
+        restart = state["count"] >= self.max_window
+        count = jnp.where(restart, 1.0, state["count"] + 1.0)
+
+        def upd(avg, p):
+            pf = p.astype(jnp.float32)
+            cum = avg + (pf - avg) / count
+            return jnp.where(restart, pf, cum)
+
+        avg = _tmap(upd, state["avg"], new_params)
+        return new_params, {
+            "avg": avg, "count": count, "inner": inner_state,
+        }
+
+    def apply(self, state, params):
+        """Return the averaged weights cast to the params' dtypes (the
+        reference's ``apply()`` context for eval)."""
+        return _tmap(
+            lambda a, p: a.astype(p.dtype), state["avg"], params
+        )
+
+
+class EMA:
+    """Exponential moving average of parameters (parity:
+    paddle.static.ExponentialMovingAverage, with the same bias-corrected
+    ``thres_steps``-free decay schedule: decay_t = min(decay,
+    (1+t)/(10+t)))."""
+
+    def __init__(self, decay=0.999, zero_debias=True):
+        self.decay = float(decay)
+        self.zero_debias = zero_debias
+
+    def init(self, params):
+        return {
+            "ema": _tmap(lambda p: jnp.zeros_like(p, jnp.float32)
+                         if self.zero_debias
+                         else p.astype(jnp.float32), params),
+            "step": jnp.zeros((), jnp.int32),
+            # running product of the (time-varying) decays — the exact
+            # zero-init debias factor is 1 - prod(decay_i)
+            "decay_prod": jnp.ones((), jnp.float32),
+        }
+
+    def update(self, state, params):
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        decay = jnp.minimum(self.decay, (1.0 + t) / (10.0 + t))
+
+        def upd(e, p):
+            return decay * e + (1.0 - decay) * p.astype(jnp.float32)
+
+        return {
+            "ema": _tmap(upd, state["ema"], params),
+            "step": step,
+            "decay_prod": state["decay_prod"] * decay,
+        }
+
+    def apply(self, state, params):
+        if self.zero_debias:
+            corr = 1.0 - state["decay_prod"]
+            return _tmap(
+                lambda e, p: (e / jnp.maximum(corr, 1e-12)).astype(p.dtype),
+                state["ema"], params,
+            )
+        return _tmap(lambda e, p: e.astype(p.dtype), state["ema"], params)

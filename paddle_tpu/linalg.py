@@ -130,14 +130,16 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
     k = min(n, m)
     L = jnp.tril(lu_mat[..., :k], -1) + jnp.eye(n, k, dtype=lu_mat.dtype)
     U = jnp.triu(lu_mat[..., :k, :])
-    # pivots (1-based sequential row swaps) → permutation matrix
-    piv = jnp.asarray(lu_pivots) - 1
-    perm = jnp.arange(n)
+    # pivots (1-based sequential row swaps) → permutation, computed
+    # host-side: pivots are concrete in practice and a traced per-element
+    # swap loop would unroll O(n) gathers into the jaxpr
+    import numpy as _np
+
+    piv = _np.asarray(lu_pivots) - 1
+    perm = _np.arange(n)
     for i in range(piv.shape[-1]):
-        j = piv[..., i]
-        pi, pj = perm[i], perm[j]
-        perm = perm.at[i].set(pj).at[j].set(pi)
-    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+        perm[[i, piv[i]]] = perm[[piv[i], i]]
+    P = jnp.eye(n, dtype=lu_mat.dtype)[jnp.asarray(perm)].T
     return P, L, U
 
 

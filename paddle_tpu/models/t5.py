@@ -168,10 +168,10 @@ class T5Attention(Layer):
         drop = cfg.dropout_rate if self.training else 0.0
         if position_bias is None and cfg.use_flash_attention \
                 and attention_mask is None and drop == 0.0:
-            # cross-attention: bias-free → flash path (T5 has no scaling,
-            # so pre-scale q by d_kv**0.5 to cancel the kernel's 1/sqrt(d))
+            # cross-attention: bias-free → flash path (T5 convention:
+            # no logit scaling, expressed via the kernel's scale arg)
             out = fa.flash_attention(
-                q * (cfg.d_kv ** 0.5), k, v, causal=causal,
+                q, k, v, causal=causal, scale=1.0,
                 training=self.training)
         else:
             bias = position_bias

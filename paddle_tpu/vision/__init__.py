@@ -19,3 +19,5 @@ from .models import (  # noqa: F401
     resnet101,
     resnet152,
 )
+
+from . import ops  # noqa: F401,E402

@@ -1,0 +1,342 @@
+"""Detection ops (parity: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, deform_conv2d, box_coder, prior_box; reference kernels in
+paddle/phi/kernels/gpu/{nms,roi_align,roi_pool,deformable_conv}_kernel.cu).
+
+TPU-native designs:
+- nms: the O(n²) IoU matrix is one fused device program; the greedy
+  suppression pass is a ``lax.fori_loop`` over a boolean keep-mask
+  (static [n] shapes), with only the final dynamic-size index compaction
+  on host — same split the reference uses (device IoU, host gather).
+- roi_align: bilinear sampling as a dense gather (vmap over ROIs);
+  every bin samples a static ``sampling_ratio²`` grid so the whole op is
+  one jittable program, no atomics (the CUDA kernel's atomicAdd backward
+  becomes plain autodiff through the gather).
+- deform_conv2d: sampling locations = base grid + learned offsets;
+  bilinear-sample all k·k taps (a gather), then the conv reduces to one
+  einsum over [taps × in-channels] — MXU-shaped, autodiff-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# IoU + NMS
+# ---------------------------------------------------------------------------
+def _box_iou_matrix(boxes_a, boxes_b):
+    """IoU matrix [A, B]; boxes are [x1, y1, x2, y2]."""
+    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0], 0) * \
+        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1], 0)
+    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0], 0) * \
+        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1], 0)
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _nms_keep_mask(boxes_sorted, iou_threshold):
+    """Greedy NMS keep-mask over score-sorted boxes (jittable)."""
+    n = boxes_sorted.shape[0]
+    iou = _box_iou_matrix(boxes_sorted, boxes_sorted)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        # if box i survives, suppress every later box overlapping it
+        suppress = (iou[i] > iou_threshold) & (idx > i)
+        new_keep = keep & ~suppress
+        return jnp.where(keep[i], new_keep, keep)
+
+    return lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Parity: paddle.vision.ops.nms. Returns kept indices into ``boxes``
+    sorted by descending score. Dynamic-size output → eager op (the
+    jittable core is ``_nms_keep_mask``)."""
+    boxes = jnp.asarray(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores = jnp.arange(n, 0, -1).astype(jnp.float32)
+    scores = jnp.asarray(scores)
+    if category_idxs is not None:
+        # per-category NMS via the coordinate-offset trick: shift each
+        # category by the full coordinate SPAN so the regions stay
+        # disjoint wherever the frame sits (negative coords included)
+        span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+        offs = jnp.asarray(category_idxs).astype(boxes.dtype) * span
+        boxes = boxes + offs[:, None]
+    order = jnp.argsort(-scores)
+    keep_sorted = _nms_keep_mask(boxes[order], iou_threshold)
+    kept = np.asarray(order)[np.asarray(keep_sorted)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return jnp.asarray(kept)
+
+
+# ---------------------------------------------------------------------------
+# RoI align / pool
+# ---------------------------------------------------------------------------
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shaped grids → [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (wy0 * wx0) + v01 * (wy0 * wx1)
+            + v10 * (wy1 * wx0) + v11 * (wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """Parity: paddle.vision.ops.roi_align. x: [N, C, H, W]; boxes
+    [K, 4] in input-image coords; boxes_num [N] gives each image's ROI
+    count (boxes are listed image-major)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+    if sampling_ratio > 0:
+        ratio = int(sampling_ratio)
+    else:
+        # reference semantics: adaptive ceil(roi_size / output_size) per
+        # ROI. Static shapes forbid per-ROI grids, so take the max over
+        # the (concrete, eager) boxes — every bin is sampled at least as
+        # densely as the reference; under tracing fall back to 2
+        try:
+            bnp = np.asarray(boxes)
+            sizes = np.maximum(bnp[:, 2:] - bnp[:, :2], 1.0) * spatial_scale
+            ratio = int(min(8, max(
+                1,
+                np.ceil(sizes[:, 1].max() / ph).max(),
+                np.ceil(sizes[:, 0].max() / pw).max(),
+            )))
+        except Exception:
+            ratio = 2
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(feat, box):
+        x1, y1, x2, y2 = (box * spatial_scale) - off
+        rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: [ph, ratio] × [pw, ratio]
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
+        yy = jnp.broadcast_to(iy[:, :, None, None], (ph, ratio, pw, ratio))
+        xx = jnp.broadcast_to(ix[None, None, :, :], (ph, ratio, pw, ratio))
+        vals = _bilinear_sample(feat, yy, xx)     # [C, ph, r, pw, r]
+        return vals.mean(axis=(2, 4))             # [C, ph, pw]
+
+    img_idx = np.repeat(np.arange(len(boxes_num)), boxes_num)
+    feats = x[jnp.asarray(img_idx)]               # [K, C, H, W]
+    return jax.vmap(one_roi)(feats, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Parity: paddle.vision.ops.roi_pool (quantized max-pool bins)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    H, W = x.shape[-2:]
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(feat, box):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # bin b covers [start, end) with end >= start+1 (reference clamp)
+        by0 = jnp.floor(y1 + jnp.arange(ph) * bin_h)
+        by1 = jnp.ceil(y1 + (jnp.arange(ph) + 1) * bin_h)
+        bx0 = jnp.floor(x1 + jnp.arange(pw) * bin_w)
+        bx1 = jnp.ceil(x1 + (jnp.arange(pw) + 1) * bin_w)
+        in_y = (ys[None, :] >= by0[:, None]) & (ys[None, :] < by1[:, None])
+        in_x = (xs[None, :] >= bx0[:, None]) & (xs[None, :] < bx1[:, None])
+        # [ph, pw, H, W] mask → max over the masked region per bin
+        mask = in_y[:, None, :, None] & in_x[None, :, None, :]
+        big_neg = jnp.asarray(-3.4e38, feat.dtype)
+        masked = jnp.where(mask[None], feat[:, None, None], big_neg)
+        out = masked.max(axis=(-1, -2))           # [C, ph, pw]
+        empty = ~mask.any(axis=(-1, -2))
+        return jnp.where(empty[None], 0.0, out)
+
+    img_idx = np.repeat(np.arange(len(boxes_num)), boxes_num)
+    feats = x[jnp.asarray(img_idx)]
+    return jax.vmap(one_roi)(feats, boxes)
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    """Parity: paddle.vision.ops.box_coder (SSD-style delta encode /
+    decode)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    var = (jnp.asarray(prior_box_var, jnp.float32)
+           if prior_box_var is not None else jnp.ones((4,)))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[..., 2] - pb[..., 0] + norm
+    ph = pb[..., 3] - pb[..., 1] + norm
+    pcx = pb[..., 0] + 0.5 * pw
+    pcy = pb[..., 1] + 0.5 * ph
+    if code_type == "encode_center_size":
+        tw = tb[..., 2] - tb[..., 0] + norm
+        th = tb[..., 3] - tb[..., 1] + norm
+        tcx = tb[..., 0] + 0.5 * tw
+        tcy = tb[..., 1] + 0.5 * th
+        dx = (tcx - pcx) / pw / var[..., 0]
+        dy = (tcy - pcy) / ph / var[..., 1]
+        dw = jnp.log(tw / pw) / var[..., 2]
+        dh = jnp.log(th / ph) / var[..., 3]
+        return jnp.stack([dx, dy, dw, dh], axis=-1)
+    # decode_center_size
+    dcx = var[..., 0] * tb[..., 0] * pw + pcx
+    dcy = var[..., 1] * tb[..., 1] * ph + pcy
+    dw = jnp.exp(var[..., 2] * tb[..., 2]) * pw
+    dh = jnp.exp(var[..., 3] * tb[..., 3]) * ph
+    return jnp.stack([
+        dcx - 0.5 * dw, dcy - 0.5 * dh,
+        dcx + 0.5 * dw - norm, dcy + 0.5 * dh - norm,
+    ], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    """Parity: paddle.vision.ops.prior_box (SSD anchors). input
+    [N, C, H, W] feature map; image [N, C, Him, Wim]."""
+    H, W = input.shape[-2:]
+    img_h, img_w = image.shape[-2:]
+    step_h = steps[1] or img_h / H
+    step_w = steps[0] or img_w / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    sizes = []
+    for i, ms in enumerate(min_sizes):
+        for ar in ars:
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            bs = np.sqrt(ms * max_sizes[i])
+            sizes.append((bs, bs))
+    sizes = np.asarray(sizes, np.float32)       # [A, 2] (w, h)
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)               # [H, W]
+    boxes = np.stack([
+        (cxg[..., None] - sizes[None, None, :, 0] / 2) / img_w,
+        (cyg[..., None] - sizes[None, None, :, 1] / 2) / img_h,
+        (cxg[..., None] + sizes[None, None, :, 0] / 2) / img_w,
+        (cyg[..., None] + sizes[None, None, :, 1] / 2) / img_h,
+    ], axis=-1)                                  # [H, W, A, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variance, np.float32), boxes.shape).copy()
+    return jnp.asarray(boxes), jnp.asarray(var)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Parity: paddle.vision.ops.deform_conv2d (v1; v2/modulated when
+    ``mask`` given). x [N, Cin, H, W]; offset
+    [N, 2·dg·kh·kw, Hout, Wout] (paddle layout: per-tap (dy, dx) pairs);
+    weight [Cout, Cin/groups, kh, kw]."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    x = jnp.asarray(x)
+    N, Cin, H, W = x.shape
+    Cout, cpg, kh, kw = weight.shape
+    oh = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+    ow = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    K = kh * kw
+    dg = deformable_groups
+
+    xp = jnp.pad(x, ((0, 0), (0, 0), (padding[0], padding[0]),
+                     (padding[1], padding[1])))
+    base_y = (jnp.arange(oh) * stride[0])[:, None, None] \
+        + (jnp.arange(kh) * dilation[0])[None, None, :]
+    base_x = (jnp.arange(ow) * stride[1])[:, None, None] \
+        + (jnp.arange(kw) * dilation[1])[None, None, :]
+    # offset layout [N, dg*K*2, oh, ow] → [N, dg, K, 2, oh, ow]
+    off = offset.reshape(N, dg, K, 2, oh, ow)
+
+    def per_image(feat, off_i, mask_i):
+        # feat [Cin, Hp, Wp]; off_i [dg, K, 2, oh, ow]; mask_i
+        # [dg, K, oh, ow] (all-ones when the caller gave no mask)
+        cpdg = Cin // dg
+
+        def per_dg(feat_g, off_g, mask_g):
+            # off_g [K, 2, oh, ow] → per-tap sampling grids
+            dy = off_g[:, 0]                      # [K, oh, ow]
+            dx = off_g[:, 1]
+            k_y = base_y.reshape(oh, 1, kh, 1)    # broadcast helpers
+            k_x = base_x.reshape(1, ow, 1, kw)
+            yy = (jnp.broadcast_to(k_y, (oh, ow, kh, kw))
+                  .transpose(2, 3, 0, 1).reshape(K, oh, ow) + dy)
+            xx = (jnp.broadcast_to(k_x, (oh, ow, kh, kw))
+                  .transpose(2, 3, 0, 1).reshape(K, oh, ow) + dx)
+            vals = _bilinear_sample(feat_g, yy, xx)  # [cpdg, K, oh, ow]
+            return vals * mask_g[None]
+
+        feat_gs = feat.reshape(dg, cpdg, *feat.shape[-2:])
+        vals = jax.vmap(per_dg)(feat_gs, off_i, mask_i)
+        return vals.reshape(Cin, K, oh, ow)
+
+    if mask is not None:
+        mask_r = jnp.asarray(mask).reshape(N, dg, K, oh, ow)
+    else:
+        mask_r = jnp.ones((N, dg, K, oh, ow), x.dtype)
+    sampled = jax.vmap(per_image)(xp, off, mask_r)  # [N, Cin, K, oh, ow]
+
+    w = weight.reshape(groups, Cout // groups, cpg, K)
+    s = sampled.reshape(N, groups, cpg, K, oh, ow)
+    out = jnp.einsum("gock,ngckhw->ngohw", w, s).reshape(N, Cout, oh, ow)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1, 1, 1)
+    return out

@@ -1,0 +1,207 @@
+"""Detection-op tests vs hand-rolled numpy references (upstream model:
+test/legacy_test/test_nms_op.py, test_roi_align_op.py,
+test_roi_pool_op.py, test_deformable_conv_op.py, test_box_coder_op.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import ops
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_j = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / max(a_i + a_j - inter, 1e-10) > thresh:
+                suppressed[j] = True
+    return np.array(keep)
+
+
+class TestNMS:
+    def test_vs_numpy(self):
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0, 90, (60, 2))
+        wh = rng.uniform(5, 30, (60, 2))
+        boxes = np.concatenate([xy, xy + wh], -1).astype(np.float32)
+        scores = rng.random(60).astype(np.float32)
+        got = np.asarray(ops.nms(boxes, 0.5, scores=scores))
+        ref = _np_nms(boxes, scores, 0.5)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_categories(self):
+        """same geometry, different categories → nothing suppressed."""
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10.]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        got = np.asarray(ops.nms(boxes, 0.5, scores=scores,
+                                 category_idxs=np.array([0, 1]),
+                                 categories=[0, 1]))
+        assert len(got) == 2
+        got2 = np.asarray(ops.nms(boxes, 0.5, scores=scores))
+        assert len(got2) == 1
+
+    def test_top_k(self):
+        boxes = np.array([[i * 20, 0, i * 20 + 10, 10]
+                          for i in range(5)], np.float32)
+        scores = np.linspace(1, 0.5, 5).astype(np.float32)
+        got = np.asarray(ops.nms(boxes, 0.5, scores=scores, top_k=3))
+        assert len(got) == 3
+
+
+class TestRoIAlign:
+    def test_unit_scale_identity_bins(self):
+        """a 2x2 ROI aligned to pixel centers reproduces the pixels."""
+        feat = jnp.asarray(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        # box covering pixel centers (0.5..2.5) → 2x2 output == centers
+        boxes = jnp.asarray([[0.0, 0.0, 2.0, 2.0]], jnp.float32)
+        out = ops.roi_align(feat, boxes, [1], output_size=2,
+                            sampling_ratio=1, aligned=False)
+        # with aligned=False, sampling point of bin (i,j) is at
+        # (i+0.5, j+0.5) in feature coords → bilinear of the 4 corners
+        assert out.shape == (1, 1, 2, 2)
+        ref = np.array([[2.5, 3.5], [6.5, 7.5]], np.float32)
+        np.testing.assert_allclose(np.asarray(out)[0, 0], ref, atol=1e-5)
+
+    def test_grad_flows(self):
+        rng = np.random.default_rng(1)
+        feat = jnp.asarray(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+        boxes = jnp.asarray([[1, 1, 6, 6], [0, 0, 4, 7], [2, 2, 7, 7.]],
+                            jnp.float32)
+        g = jax.grad(lambda f: jnp.sum(
+            ops.roi_align(f, boxes, [2, 1], 4) ** 2))(feat)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_batch_routing(self):
+        """ROIs index the right image via boxes_num."""
+        f = np.zeros((2, 1, 4, 4), np.float32)
+        f[1] = 7.0
+        boxes = jnp.asarray([[0, 0, 3, 3], [0, 0, 3, 3.]], jnp.float32)
+        out = ops.roi_align(jnp.asarray(f), boxes, [1, 1], 2)
+        np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+        np.testing.assert_allclose(np.asarray(out)[1], 7.0)
+
+
+class TestRoIPool:
+    def test_max_in_bins(self):
+        feat = jnp.asarray(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        boxes = jnp.asarray([[0, 0, 3, 3.]], jnp.float32)
+        out = ops.roi_pool(feat, boxes, [1], output_size=2)
+        # 4x4 → 2x2 bins of 2x2 → maxes are 5, 7, 13, 15
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0], [[5, 7], [13, 15]])
+
+    def test_grad_flows(self):
+        feat = jnp.asarray(np.random.default_rng(2).normal(
+            size=(1, 2, 6, 6)).astype(np.float32))
+        boxes = jnp.asarray([[0, 0, 5, 5.]], jnp.float32)
+        g = jax.grad(lambda f: jnp.sum(
+            ops.roi_pool(f, boxes, [1], 3)))(feat)
+        # max-pool grad: one 1 per bin per channel
+        assert float(jnp.sum(g)) == pytest.approx(2 * 9)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(3)
+        priors = np.abs(rng.normal(size=(10, 4))).astype(np.float32)
+        priors[:, 2:] = priors[:, :2] + 1 + np.abs(
+            rng.normal(size=(10, 2))).astype(np.float32)
+        targets = priors + rng.normal(size=(10, 4)).astype(np.float32) * 0.1
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        enc = ops.box_coder(priors, var, targets,
+                            code_type="encode_center_size")
+        dec = ops.box_coder(priors, var, enc,
+                            code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec), targets, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestPriorBox:
+    def test_shapes_and_range(self):
+        feat = jnp.zeros((1, 8, 4, 4))
+        img = jnp.zeros((1, 3, 64, 64))
+        boxes, var = ops.prior_box(feat, img, min_sizes=[16.0],
+                                   max_sizes=[32.0],
+                                   aspect_ratios=[2.0], flip=True,
+                                   clip=True)
+        # A = 1 (ar=1) + 2 (ar=2 flip) + 1 (max_size) = 4
+        assert boxes.shape == (4, 4, 4, 4)
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+        assert (b[..., 2] > b[..., 0]).all()
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        """deform_conv2d with zero offsets == plain conv2d."""
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(2, 4, 8, 8)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(6, 4, 3, 3)).astype(np.float32))
+        offset = jnp.zeros((2, 2 * 9, 6, 6), jnp.float32)
+        got = ops.deform_conv2d(x, offset, w, padding=0)
+        ref = F.conv2d(x, w, padding=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        """1x1 kernel with integer offset (dy=1,dx=0) samples one row
+        down."""
+        x = jnp.asarray(np.arange(16, dtype=np.float32)
+                        .reshape(1, 1, 4, 4))
+        w = jnp.ones((1, 1, 1, 1), jnp.float32)
+        offset = jnp.zeros((1, 2, 4, 4), jnp.float32)
+        offset = offset.at[:, 0].set(1.0)  # dy=1
+        got = np.asarray(ops.deform_conv2d(x, offset, w))[0, 0]
+        ref = np.asarray(x)[0, 0]
+        # rows shift up by one (sampling one row down); last row clamps
+        np.testing.assert_allclose(got[:3], ref[1:])
+        np.testing.assert_allclose(got[3], ref[3])
+
+    def test_modulated_mask_and_grad(self):
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(1, 2, 6, 6)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(3, 2, 3, 3)).astype(np.float32))
+        offset = jnp.asarray(rng.normal(size=(1, 18, 4, 4))
+                             .astype(np.float32)) * 0.5
+        mask = jax.nn.sigmoid(jnp.asarray(
+            rng.normal(size=(1, 9, 4, 4)).astype(np.float32)))
+        out = ops.deform_conv2d(x, offset, w, mask=mask)
+        assert out.shape == (1, 3, 4, 4)
+        g = jax.grad(lambda o: jnp.sum(
+            ops.deform_conv2d(x, o, w, mask=mask) ** 2))(offset)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_groups_and_deformable_groups(self):
+        rng = np.random.default_rng(6)
+        x = jnp.asarray(rng.normal(size=(1, 4, 6, 6)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(4, 2, 3, 3)).astype(np.float32))
+        offset = jnp.zeros((1, 2 * 2 * 9, 4, 4), jnp.float32)
+        out = ops.deform_conv2d(x, offset, w, groups=2,
+                                deformable_groups=2)
+        import paddle_tpu.nn.functional as F
+
+        ref = F.conv2d(x, w, groups=2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
