@@ -14,6 +14,7 @@ from . import amp  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import generation  # noqa: F401
 from . import flags  # noqa: F401
 from . import incubate  # noqa: F401
 from . import jit  # noqa: F401

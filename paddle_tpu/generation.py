@@ -1,0 +1,178 @@
+"""Text-generation utilities (parity: PaddleNLP `GenerationMixin` —
+paddlenlp/generation/utils.py: decode_strategy greedy_search / sampling /
+beam_search with top_k, top_p, temperature, repetition_penalty).
+
+TPU-native design: every logits processor is a pure [batch, vocab] →
+[batch, vocab] jnp function (jit/vmap-friendly, no Python branching on
+data); sampling uses explicit jax PRNG keys; beam search keeps the KV
+cache batch-major ([batch·num_beams, ...]) so a beam reorder is one
+``jnp.take`` over the cache pytree — the TPU analog of the reference's
+`cache.index_select(beam_idx)`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# logits processors
+# ---------------------------------------------------------------------------
+def apply_temperature(logits, temperature: float):
+    if temperature == 1.0:
+        return logits
+    return logits / jnp.maximum(temperature, 1e-6)
+
+
+def top_k_filter(logits, k: int):
+    """Keep the k highest logits per row; the rest → -inf."""
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits, p: float):
+    """Nucleus filter: keep the smallest prefix of the sorted
+    distribution with cumulative probability ≥ p (the top token always
+    survives)."""
+    if p >= 1.0:
+        return logits
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # drop tokens where the cumulative mass BEFORE them already ≥ p
+    drop_sorted = (cum - probs) >= p
+    drop = jnp.zeros_like(drop_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx
+    ].set(drop_sorted)
+    return jnp.where(drop, NEG_INF, logits)
+
+
+def repetition_penalty_(logits, generated_ids, penalty: float,
+                        mask=None):
+    """CTRL-style penalty on already-generated tokens (paddle semantics:
+    positive logits divided by, negative multiplied by ``penalty``).
+    ``generated_ids`` [batch, n]; ``mask`` [batch, n] marks valid ids."""
+    if penalty == 1.0:
+        return logits
+    b, v = logits.shape
+    seen = jnp.zeros((b, v), bool)
+    valid = jnp.ones(generated_ids.shape, bool) if mask is None else \
+        mask.astype(bool)
+    seen = seen.at[
+        jnp.arange(b)[:, None], generated_ids
+    ].max(valid)
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def process_logits(logits, temperature=1.0, top_k=0, top_p=1.0,
+                   generated_ids=None, repetition_penalty=1.0,
+                   generated_mask=None, min_length_active=False,
+                   eos_token_id=None):
+    """Composition in the reference's order: repetition penalty →
+    temperature → top-k → top-p (+ optional eos ban for min_length)."""
+    if generated_ids is not None and repetition_penalty != 1.0:
+        logits = repetition_penalty_(logits, generated_ids,
+                                     repetition_penalty, generated_mask)
+    logits = apply_temperature(logits, temperature)
+    if min_length_active and eos_token_id is not None:
+        logits = logits.at[:, eos_token_id].set(NEG_INF)
+    logits = top_k_filter(logits, top_k)
+    logits = top_p_filter(logits, top_p)
+    return logits
+
+
+def sample_token(logits, rng_key, temperature=1.0, top_k=0, top_p=1.0,
+                 **kw):
+    """One sampled token per row after the processor stack."""
+    logits = process_logits(logits, temperature, top_k, top_p, **kw)
+    return jax.random.categorical(rng_key, logits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+class BeamState:
+    """Flat [batch·num_beams]-major beam bookkeeping."""
+
+    def __init__(self, batch, num_beams, max_len, dtype=jnp.int32):
+        self.batch = batch
+        self.num_beams = num_beams
+        # log-prob scores: beam 0 starts at 0, others -inf (standard
+        # first-step degeneracy fix)
+        self.scores = jnp.where(
+            jnp.arange(num_beams)[None, :] == 0, 0.0, NEG_INF
+        ) * jnp.ones((batch, 1))
+        self.tokens = jnp.zeros((batch, num_beams, max_len), dtype)
+        self.lengths = jnp.zeros((batch, num_beams), jnp.int32)
+        self.finished = jnp.zeros((batch, num_beams), bool)
+
+
+def beam_step(state: BeamState, logprobs, t: int,
+              eos_token_id: Optional[int] = None,
+              length_penalty: float = 0.0):
+    """One beam-search step. ``logprobs``: [batch·num_beams, vocab]
+    log-softmaxed model output for the beams' last tokens. Returns
+    (new_state, beam_idx [batch, num_beams] reorder indices into the
+    flat batch·beams axis, next_tokens [batch, num_beams])."""
+    b, nb = state.batch, state.num_beams
+    v = logprobs.shape[-1]
+    lp = logprobs.reshape(b, nb, v)
+    if eos_token_id is not None:
+        # finished beams may only extend with eos at no cost, so they
+        # keep competing under their final score
+        frozen = jnp.full((v,), NEG_INF).at[eos_token_id].set(0.0)
+        lp = jnp.where(state.finished[..., None], frozen, lp)
+    cand = state.scores[..., None] + lp               # [b, nb, v]
+    flat = cand.reshape(b, nb * v)
+    top_scores, top_idx = jax.lax.top_k(flat, nb)     # [b, nb]
+    src_beam = top_idx // v
+    next_tok = (top_idx % v).astype(state.tokens.dtype)
+
+    gather = lambda x: jnp.take_along_axis(  # noqa: E731
+        x, src_beam.reshape(x.shape[0], nb, *([1] * (x.ndim - 2))),
+        axis=1)
+    tokens = gather(state.tokens)
+    tokens = tokens.at[:, :, t].set(next_tok)
+    finished = jnp.take_along_axis(state.finished, src_beam, axis=1)
+    lengths = jnp.take_along_axis(state.lengths, src_beam, axis=1)
+    lengths = jnp.where(finished, lengths, lengths + 1)
+    if eos_token_id is not None:
+        finished = finished | (next_tok == eos_token_id)
+
+    new = BeamState.__new__(BeamState)
+    new.batch, new.num_beams = b, nb
+    new.scores = top_scores
+    new.tokens = tokens
+    new.lengths = lengths
+    new.finished = finished
+    # flat reorder indices for the KV cache: batch-major
+    beam_idx = (jnp.arange(b)[:, None] * nb + src_beam).reshape(-1)
+    return new, beam_idx, next_tok
+
+
+def beam_finalize(state: BeamState, length_penalty: float = 0.0):
+    """Pick each batch row's best beam under the GNMT length penalty
+    ((5+len)/6)**alpha (the reference's default scorer)."""
+    lens = jnp.maximum(state.lengths, 1).astype(jnp.float32)
+    denom = jnp.power((5.0 + lens) / 6.0, length_penalty)
+    final = state.scores / denom
+    best = jnp.argmax(final, axis=1)                  # [batch]
+    tokens = jnp.take_along_axis(
+        state.tokens, best[:, None, None], axis=1)[:, 0]
+    return tokens, jnp.take_along_axis(final, best[:, None], 1)[:, 0]
+
+
+def reorder_cache(caches, beam_idx):
+    """Gather every cache leaf along its batch (leading) axis — the
+    reference's beam cache index_select."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.take(x, beam_idx, axis=0), caches)

@@ -57,7 +57,8 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
-                                    use_neox_rotary_style=True):
+                                    use_neox_rotary_style=True,
+                                    max_position=None):
     """Parity: incubate fused_rope. q/k/v: [b, s, h, d]; rotates every
     tensor given. sin/cos may be the paddle-shaped [1, s, 1, d] tables
     (the duplicated-half layout) or the compact [s, d/2] this package's
@@ -66,8 +67,17 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if sin is None or cos is None:
         max_pos = s
         if position_ids is not None:
-            # tables must cover the largest requested position
-            max_pos = int(jnp.max(position_ids)) + 1
+            if max_position is not None:
+                max_pos = int(max_position)
+            else:
+                try:  # concrete ids: size the table to cover them
+                    max_pos = int(jnp.max(position_ids)) + 1
+                except Exception as e:  # tracer (jit/vmap)
+                    raise ValueError(
+                        "fused_rope under jit with position_ids needs "
+                        "max_position= (or precomputed sin/cos): the "
+                        "default table cannot be sized from a traced "
+                        "value") from e
         cos_t, sin_t = rope_frequencies(d, max(max_pos, s), dtype=q.dtype)
     else:
         # accept [..., L, d] (duplicated-half paddle layout) or
@@ -84,12 +94,25 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         sin_t = sin_t.reshape(-1, last)
         if last == d:  # duplicated-half layout → compact
             cos_t, sin_t = cos_t[:, : d // 2], sin_t[:, : d // 2]
+    def de_interleave(t):
+        # interleaved (x0,x1),(x2,x3) pairs → split-half layout
+        return t.reshape(*t.shape[:-1], d // 2, 2) \
+            .swapaxes(-1, -2).reshape(*t.shape[:-1], d)
+
+    def re_interleave(t):
+        return t.reshape(*t.shape[:-1], 2, d // 2) \
+            .swapaxes(-1, -2).reshape(*t.shape[:-1], d)
+
     outs = []
     for t in (q, k, v):
         if t is None:
             outs.append(None)
             continue
+        if not use_neox_rotary_style:
+            t = de_interleave(t)
         rot, _ = apply_rope(t, t, cos_t, sin_t, position_ids=position_ids)
+        if not use_neox_rotary_style:
+            rot = re_interleave(rot)
         outs.append(rot)
     return tuple(outs)
 

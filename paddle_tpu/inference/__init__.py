@@ -74,7 +74,7 @@ class Predictor:
         self.params = extract_params(model)
         model.eval()
         self._prefill_cache = {}
-        self._decode_fn = None
+        self._decode_fns: Dict[int, object] = {}
         self._ttft_ms: Optional[float] = None
 
     # ------------------------------------------------------------------
@@ -108,8 +108,9 @@ class Predictor:
         return self._prefill_cache[key]
 
     def _get_decode(self, batch: int):
-        if self._decode_fn is None:
-            max_len = self.config.max_seq_len
+        # keyed by batch: the closure bakes the position shape in, and
+        # beam search calls with batch·num_beams rows
+        if batch not in self._decode_fns:
 
             def decode_step(params, tok, caches, idx):
                 pos = jnp.full((batch, 1), idx, jnp.int32)
@@ -117,10 +118,11 @@ class Predictor:
                     self.model, params, tok, position_ids=pos,
                     kv_caches=caches, cache_index=idx,
                 )
-                return jnp.argmax(logits[:, -1, :], axis=-1), caches
+                return logits[:, -1, :], caches
 
-            self._decode_fn = jax.jit(decode_step, donate_argnums=(2,))
-        return self._decode_fn
+            self._decode_fns[batch] = jax.jit(
+                decode_step, donate_argnums=(2,))
+        return self._decode_fns[batch]
 
     # ------------------------------------------------------------------
     def run(self, input_ids) -> jax.Array:
@@ -133,8 +135,25 @@ class Predictor:
         input_ids,
         max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
+        decode_strategy: str = "greedy_search",
+        top_k: int = 0,
+        top_p: float = 1.0,
+        temperature: float = 1.0,
+        repetition_penalty: float = 1.0,
+        num_beams: int = 1,
+        length_penalty: float = 0.0,
+        seed: int = 0,
     ) -> np.ndarray:
-        """Greedy decode with primed KV cache; records TTFT."""
+        """Parity: PaddleNLP GenerationMixin.generate — greedy_search /
+        sampling (top-k, top-p, temperature, repetition penalty) /
+        beam_search (KV cache reordered per step via one batched gather).
+        Records TTFT on the prefill."""
+        if decode_strategy == "beam_search" or num_beams > 1:
+            return self._beam_generate(
+                input_ids, max_new_tokens, max(num_beams, 2),
+                eos_token_id, length_penalty)
+        from .. import generation as G
+
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
@@ -142,6 +161,8 @@ class Predictor:
         bucket = self._bucket(prompt_len)
         pad = bucket - prompt_len
         padded = np.pad(ids, ((0, 0), (0, pad)))
+        sampling = decode_strategy == "sampling"
+        rng = jax.random.PRNGKey(seed)
 
         t0 = time.perf_counter()
         prefill, cache_proto = self._get_prefill(batch, bucket)
@@ -149,7 +170,42 @@ class Predictor:
             self.params, jnp.asarray(padded, jnp.int32), cache_proto
         )
         # next token comes from the last *real* prompt position
-        next_tok = jnp.argmax(logits[:, prompt_len - 1, :], axis=-1)
+        last = logits[:, prompt_len - 1, :]
+        # seen-token buffer for the repetition penalty: the PROMPT counts
+        # too (PaddleNLP penalizes full input_ids), then each generated
+        # token is appended
+        buf_len = prompt_len + max_new_tokens
+        gen_buf = jnp.zeros((batch, buf_len), jnp.int32)
+        gen_buf = gen_buf.at[:, :prompt_len].set(jnp.asarray(ids, jnp.int32))
+        gen_mask = jnp.zeros((batch, buf_len), bool)
+        gen_mask = gen_mask.at[:, :prompt_len].set(True)
+
+        # one compiled program per token for the whole processor stack —
+        # keeps the decode loop at two dispatches/step (decode + pick)
+        @jax.jit
+        def pick(logit_row, rng, step_i, gen_buf, gen_mask):
+            if sampling:
+                rng, sub = jax.random.split(rng)
+                tok = G.sample_token(
+                    logit_row, sub, temperature=temperature, top_k=top_k,
+                    top_p=top_p, generated_ids=gen_buf,
+                    repetition_penalty=repetition_penalty,
+                    generated_mask=gen_mask)
+            else:
+                proc = G.process_logits(
+                    logit_row, generated_ids=gen_buf,
+                    repetition_penalty=repetition_penalty,
+                    generated_mask=gen_mask)
+                tok = jnp.argmax(proc, axis=-1)
+            slot = prompt_len + step_i
+            gen_buf = jax.lax.dynamic_update_slice_in_dim(
+                gen_buf, tok[:, None].astype(jnp.int32), slot, axis=1)
+            gen_mask = jax.lax.dynamic_update_slice_in_dim(
+                gen_mask, jnp.ones((batch, 1), bool), slot, axis=1)
+            return tok, rng, gen_buf, gen_mask
+
+        next_tok, rng, gen_buf, gen_mask = pick(
+            last, rng, jnp.int32(0), gen_buf, gen_mask)
         next_tok.block_until_ready()
         self._ttft_ms = (time.perf_counter() - t0) * 1e3
 
@@ -158,7 +214,9 @@ class Predictor:
         tok = next_tok[:, None].astype(jnp.int32)
         for i in range(max_new_tokens - 1):
             idx = prompt_len + i
-            nxt, caches = decode(self.params, tok, caches, idx)
+            logit_row, caches = decode(self.params, tok, caches, idx)
+            nxt, rng, gen_buf, gen_mask = pick(
+                logit_row, rng, jnp.int32(i + 1), gen_buf, gen_mask)
             out.append(np.asarray(nxt))
             if eos_token_id is not None and bool(
                 np.all(out[-1] == eos_token_id)
@@ -166,6 +224,52 @@ class Predictor:
                 break
             tok = nxt[:, None].astype(jnp.int32)
         return np.stack(out, axis=1)
+
+    def _beam_generate(self, input_ids, max_new_tokens, num_beams,
+                       eos_token_id, length_penalty):
+        from .. import generation as G
+
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        batch, prompt_len = ids.shape
+        bucket = self._bucket(prompt_len)
+        # expand each row to num_beams contiguous copies (batch-major)
+        tiled = np.repeat(ids, num_beams, axis=0)
+        padded = np.pad(tiled, ((0, 0), (0, bucket - prompt_len)))
+
+        t0 = time.perf_counter()
+        prefill, cache_proto = self._get_prefill(batch * num_beams, bucket)
+        logits, caches = prefill(
+            self.params, jnp.asarray(padded, jnp.int32), cache_proto
+        )
+        state = G.BeamState(batch, num_beams, max_new_tokens)
+        lp = jax.nn.log_softmax(
+            logits[:, prompt_len - 1, :].astype(jnp.float32), axis=-1)
+        state, beam_idx, next_tok = G.beam_step(
+            state, lp, 0, eos_token_id)
+        caches = G.reorder_cache(caches, beam_idx)
+        next_tok.block_until_ready()
+        self._ttft_ms = (time.perf_counter() - t0) * 1e3
+
+        decode = self._get_decode(batch * num_beams)
+        tok = next_tok.reshape(-1, 1).astype(jnp.int32)
+        for i in range(max_new_tokens - 1):
+            logit_row, caches = decode(
+                self.params, tok, caches, prompt_len + i)
+            lp = jax.nn.log_softmax(
+                logit_row.astype(jnp.float32), axis=-1)
+            state, beam_idx, next_tok = G.beam_step(
+                state, lp, i + 1, eos_token_id)
+            caches = G.reorder_cache(caches, beam_idx)
+            tok = next_tok.reshape(-1, 1).astype(jnp.int32)
+            if eos_token_id is not None and bool(
+                jnp.all(state.finished)
+            ):
+                break
+        tokens, scores = G.beam_finalize(state, length_penalty)
+        self._last_beam_scores = np.asarray(scores)
+        return np.asarray(tokens)
 
     @property
     def last_ttft_ms(self):

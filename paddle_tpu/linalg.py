@@ -130,16 +130,29 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
     k = min(n, m)
     L = jnp.tril(lu_mat[..., :k], -1) + jnp.eye(n, k, dtype=lu_mat.dtype)
     U = jnp.triu(lu_mat[..., :k, :])
-    # pivots (1-based sequential row swaps) → permutation, computed
-    # host-side: pivots are concrete in practice and a traced per-element
-    # swap loop would unroll O(n) gathers into the jaxpr
+    # pivots (1-based sequential row swaps) → permutation. Concrete
+    # pivots (the usual case) resolve host-side; traced pivots go through
+    # a fori_loop so the jaxpr stays O(1) ops, not O(n) unrolled swaps.
     import numpy as _np
 
-    piv = _np.asarray(lu_pivots) - 1
-    perm = _np.arange(n)
-    for i in range(piv.shape[-1]):
-        perm[[i, piv[i]]] = perm[[piv[i], i]]
-    P = jnp.eye(n, dtype=lu_mat.dtype)[jnp.asarray(perm)].T
+    try:
+        piv = _np.asarray(lu_pivots) - 1
+        perm = _np.arange(n)
+        for i in range(piv.shape[-1]):
+            perm[[i, piv[i]]] = perm[[piv[i], i]]
+        perm = jnp.asarray(perm)
+    except Exception:  # tracer (jit/vmap)
+        from jax import lax as _lax
+
+        pivj = jnp.asarray(lu_pivots) - 1
+
+        def _swap(i, perm):
+            j = pivj[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+
+        perm = _lax.fori_loop(0, pivj.shape[-1], _swap, jnp.arange(n))
+    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
     return P, L, U
 
 

@@ -124,20 +124,69 @@ class TrainStep:
 
         model_ref = model
         loss_ref = loss_fn
+        merge_k = (self.strategy.gradient_merge_k_steps
+                   if getattr(self.strategy, "gradient_merge", False) else 1)
+        self.gradient_merge_k = merge_k
+
+        def loss_of(p, batch, rng):
+            rngs = {"dropout": rng, "default": rng}
+            if loss_ref is None:
+                # model computes its own scalar loss from the batch dict
+                return functional_call(model_ref, p, **batch, rngs=rngs)
+            out = functional_call(model_ref, p, batch["input"], rngs=rngs)
+            return loss_ref(out, batch["label"])
 
         def step_fn(params, opt_state, batch, rng):
-            def loss_of(p):
-                rngs = {"dropout": rng, "default": rng}
-                if loss_ref is None:
-                    # model computes its own scalar loss from the batch dict
-                    out = functional_call(model_ref, p, **batch, rngs=rngs)
-                    return out
-                out = functional_call(
-                    model_ref, p, batch["input"], rngs=rngs
-                )
-                return loss_ref(out, batch["label"])
+            if merge_k <= 1:
+                loss, grads = jax.value_and_grad(loss_of)(
+                    params, batch, rng)
+            else:
+                # gradient merge (parity: fleet gradient_merge /
+                # accumulate_steps): split the global batch into k
+                # micro-batches and scan — one live micro-batch of
+                # activations at a time, fp32 grad accumulators, a single
+                # optimizer update. One compiled program, no host loop.
+                def is_batched(v):
+                    return hasattr(v, "ndim") and v.ndim > 0
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+                static_part = {k: v for k, v in batch.items()
+                               if not is_batched(v)}
+
+                def reshape_mb(v):
+                    b = v.shape[0]
+                    if b % merge_k:
+                        raise ValueError(
+                            f"gradient_merge: batch {b} not divisible by "
+                            f"k_steps {merge_k}")
+                    return v.reshape(merge_k, b // merge_k, *v.shape[1:])
+
+                mbatch = {k: reshape_mb(v) for k, v in batch.items()
+                          if is_batched(v)}
+                rngs_k = jax.random.split(rng, merge_k)
+                zero = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+                def body(carry, xs):
+                    acc, loss_sum = carry
+                    mb, r = xs
+                    mb = {**mb, **static_part}
+                    mb = jax.tree_util.tree_map(
+                        lambda v: jax.lax.with_sharding_constraint(
+                            v, NamedSharding(mesh, batch_spec(
+                                v.ndim, self.batch_seq_axis
+                                if v.ndim > 1 else None, self.strategy)))
+                        if hasattr(v, "ndim") and v.ndim > 0 else v, mb)
+                    loss, grads = jax.value_and_grad(loss_of)(params, mb, r)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                    return (acc, loss_sum + loss), None
+
+                (acc, loss_sum), _ = jax.lax.scan(
+                    body, (zero, jnp.zeros((), jnp.float32)),
+                    (mbatch, rngs_k))
+                grads = jax.tree_util.tree_map(
+                    lambda a: a / merge_k, acc)
+                loss = loss_sum / merge_k
             new_params, new_state = optimizer.update(grads, opt_state, params)
             return new_params, new_state, loss
 

@@ -321,7 +321,15 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   .transpose(2, 3, 0, 1).reshape(K, oh, ow) + dy)
             xx = (jnp.broadcast_to(k_x, (oh, ow, kh, kw))
                   .transpose(2, 3, 0, 1).reshape(K, oh, ow) + dx)
-            vals = _bilinear_sample(feat_g, yy, xx)  # [cpdg, K, oh, ow]
+            # reference semantics: taps OUTSIDE the (padded) map read 0,
+            # not the clamped edge — a one-pixel zero ring + coordinate
+            # shift makes the clamping _bilinear_sample produce exactly
+            # that (far-out coords land wholly in the ring)
+            ring = jnp.pad(feat_g, ((0, 0), (1, 1), (1, 1)))
+            far = (yy < -1.0) | (yy > feat_g.shape[-2] + 0.0) | \
+                (xx < -1.0) | (xx > feat_g.shape[-1] + 0.0)
+            vals = _bilinear_sample(ring, yy + 1.0, xx + 1.0)
+            vals = jnp.where(far[None], 0.0, vals)  # [cpdg, K, oh, ow]
             return vals * mask_g[None]
 
         feat_gs = feat.reshape(dg, cpdg, *feat.shape[-2:])

@@ -492,3 +492,53 @@ class TestLBFGS:
             np.asarray(lin.weight.grad),
             np.asarray(grads[lin.weight.name]),
         )
+
+
+# ---------------------------------------------------------------------------
+# gradient merge (strategy.gradient_merge) in TrainStep
+# ---------------------------------------------------------------------------
+class TestGradientMerge:
+    def _mk(self, merge_k):
+        import jax
+
+        from paddle_tpu import distributed as dist
+        from paddle_tpu.distributed.strategy import DistributedStrategy
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.trainer import TrainStep
+
+        pt.seed(0)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2,
+                               use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        strategy = DistributedStrategy()
+        if merge_k > 1:
+            strategy.gradient_merge = True
+            strategy.gradient_merge_k_steps = merge_k
+        mesh = dist.build_mesh(devices=jax.devices()[:1])
+        o = opt.AdamW(learning_rate=1e-3, multi_precision=False)
+        return TrainStep(model, o, mesh, strategy), cfg
+
+    def test_merged_equals_full_batch(self):
+        """k micro-batches with mean-accumulated grads == one full-batch
+        step (same data, dropout off)."""
+        ts1, cfg = self._mk(1)
+        ts4, _ = self._mk(4)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)))
+        batch = {"input_ids": ids, "labels": ids}
+        l1 = ts1.run(batch)
+        l4 = ts4.run(batch)
+        np.testing.assert_allclose(float(l1), float(l4), rtol=2e-5)
+        for n in ts1.params:
+            np.testing.assert_allclose(
+                np.asarray(ts1.params[n]), np.asarray(ts4.params[n]),
+                rtol=2e-4, atol=2e-5,
+            )
+        assert ts4.gradient_merge_k == 4
+
+    def test_indivisible_batch_raises(self):
+        ts3, cfg = self._mk(3)
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (8, 16)))
+        with pytest.raises(ValueError, match="not divisible"):
+            ts3.run({"input_ids": ids, "labels": ids})

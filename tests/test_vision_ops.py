@@ -174,9 +174,11 @@ class TestDeformConv:
         offset = offset.at[:, 0].set(1.0)  # dy=1
         got = np.asarray(ops.deform_conv2d(x, offset, w))[0, 0]
         ref = np.asarray(x)[0, 0]
-        # rows shift up by one (sampling one row down); last row clamps
+        # rows shift up by one (sampling one row down); the last row's
+        # taps fall OUTSIDE the map and read 0 (reference zero-padding
+        # semantics, not edge clamping)
         np.testing.assert_allclose(got[:3], ref[1:])
-        np.testing.assert_allclose(got[3], ref[3])
+        np.testing.assert_allclose(got[3], 0.0)
 
     def test_modulated_mask_and_grad(self):
         rng = np.random.default_rng(5)
