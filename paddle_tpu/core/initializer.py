@@ -113,6 +113,105 @@ class KaimingUniform(KaimingNormal):
         return Uniform(-limit, limit)(key, shape, dtype)
 
 
+class Orthogonal(Initializer):
+    """Parity: paddle.nn.initializer.Orthogonal — QR of a gaussian,
+    sign-fixed; trailing dims flattened for >2-D shapes."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal needs >= 2 dims")
+        rows = shape[0]
+        cols = int(math.prod(shape[1:]))
+        a = jax.random.normal(
+            key, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)          # q: [max, min], orthonormal cols
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T                      # → [rows(min), cols(max)]
+        return (self.gain * q.reshape(shape)).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Parity: paddle.nn.initializer.Dirac — identity-preserving conv
+    kernels ([out, in, *k]); channel i passes input channel i % in
+    through the kernel center."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Dirac needs a conv kernel shape")
+        out_c, in_c = shape[0], shape[1]
+        w = jnp.zeros(shape, dtype)
+        centers = tuple(k // 2 for k in shape[2:])
+        opg = out_c // self.groups
+        # reference (torch dirac_/paddle Dirac): within each group only
+        # the first min(out_per_group, in) channels get an identity tap;
+        # the rest stay zero (no modular wrap)
+        for o in range(out_c):
+            d = o % opg
+            if d < in_c:
+                w = w.at[(o, d) + centers].set(1.0)
+        return w
+
+
+class Assign(Initializer):
+    """Parity: paddle.nn.initializer.Assign — fixed array/list value."""
+
+    def __init__(self, value):
+        import numpy as _np
+
+        self.value = _np.asarray(value)
+
+    def __call__(self, key, shape, dtype):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign: value shape {self.value.shape} != {shape}")
+        return jnp.asarray(self.value, dtype)
+
+
+class Bilinear(Initializer):
+    """Parity: paddle.nn.initializer.Bilinear — upsampling deconv
+    kernels."""
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects [out, in, kh, kw]")
+        kh, kw = shape[2], shape[3]
+
+        def ramp(k):
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return (1 - jnp.abs(jnp.arange(k) / f - c))
+
+        kern = ramp(kh)[:, None] * ramp(kw)[None, :]
+        # reference fills EVERY (out, in) filter with the ramp kernel
+        w = jnp.broadcast_to(kern, shape)
+        return w.astype(dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Parity: paddle.nn.initializer.calculate_gain."""
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d",
+                       "conv_transpose1d", "conv_transpose2d",
+                       "conv_transpose3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1 + a * a))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    raise ValueError(f"unknown nonlinearity {nonlinearity!r}")
+
+
 def _linear_default_weight_init():
     # paddle's default for Linear: XavierNormal-like (upstream uses
     # XavierNormal for most layers via default_initializer on create_parameter)

@@ -33,6 +33,7 @@ def top_k_filter(logits, k: int):
     """Keep the k highest logits per row; the rest → -inf."""
     if k <= 0:
         return logits
+    k = min(k, logits.shape[-1])  # reference clamps (TopKProcess)
     kth = jax.lax.top_k(logits, k)[0][..., -1:]
     return jnp.where(logits < kth, NEG_INF, logits)
 
@@ -117,8 +118,7 @@ class BeamState:
 
 
 def beam_step(state: BeamState, logprobs, t: int,
-              eos_token_id: Optional[int] = None,
-              length_penalty: float = 0.0):
+              eos_token_id: Optional[int] = None):
     """One beam-search step. ``logprobs``: [batch·num_beams, vocab]
     log-softmaxed model output for the beams' last tokens. Returns
     (new_state, beam_idx [batch, num_beams] reorder indices into the

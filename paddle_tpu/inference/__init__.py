@@ -75,6 +75,7 @@ class Predictor:
         model.eval()
         self._prefill_cache = {}
         self._decode_fns: Dict[int, object] = {}
+        self._pick_fns: Dict[tuple, object] = {}
         self._ttft_ms: Optional[float] = None
 
     # ------------------------------------------------------------------
@@ -124,6 +125,42 @@ class Predictor:
                 decode_step, donate_argnums=(2,))
         return self._decode_fns[batch]
 
+    def _get_pick(self, batch, buf_len, sampling, top_k, top_p,
+                  temperature, repetition_penalty):
+        """Compiled per-token processor stack, cached per config so a
+        second generate() call never re-traces (the compile would
+        otherwise land inside the TTFT measurement every call). ``slot``
+        is a traced scalar: one program serves every step."""
+        from .. import generation as G
+
+        key = (batch, buf_len, sampling, top_k, top_p, temperature,
+               repetition_penalty)
+        if key not in self._pick_fns:
+
+            @jax.jit
+            def pick(logit_row, rng, slot, gen_buf, gen_mask):
+                if sampling:
+                    rng, sub = jax.random.split(rng)
+                    tok = G.sample_token(
+                        logit_row, sub, temperature=temperature,
+                        top_k=top_k, top_p=top_p, generated_ids=gen_buf,
+                        repetition_penalty=repetition_penalty,
+                        generated_mask=gen_mask)
+                else:
+                    proc = G.process_logits(
+                        logit_row, generated_ids=gen_buf,
+                        repetition_penalty=repetition_penalty,
+                        generated_mask=gen_mask)
+                    tok = jnp.argmax(proc, axis=-1)
+                gen_buf = jax.lax.dynamic_update_slice_in_dim(
+                    gen_buf, tok[:, None].astype(jnp.int32), slot, axis=1)
+                gen_mask = jax.lax.dynamic_update_slice_in_dim(
+                    gen_mask, jnp.ones((batch, 1), bool), slot, axis=1)
+                return tok, rng, gen_buf, gen_mask
+
+            self._pick_fns[key] = pick
+        return self._pick_fns[key]
+
     # ------------------------------------------------------------------
     def run(self, input_ids) -> jax.Array:
         """One-shot forward (parity: Predictor::Run) → logits."""
@@ -151,7 +188,8 @@ class Predictor:
         if decode_strategy == "beam_search" or num_beams > 1:
             return self._beam_generate(
                 input_ids, max_new_tokens, max(num_beams, 2),
-                eos_token_id, length_penalty)
+                eos_token_id, length_penalty, temperature,
+                repetition_penalty)
         from .. import generation as G
 
         ids = np.asarray(input_ids)
@@ -180,32 +218,11 @@ class Predictor:
         gen_mask = jnp.zeros((batch, buf_len), bool)
         gen_mask = gen_mask.at[:, :prompt_len].set(True)
 
-        # one compiled program per token for the whole processor stack —
-        # keeps the decode loop at two dispatches/step (decode + pick)
-        @jax.jit
-        def pick(logit_row, rng, step_i, gen_buf, gen_mask):
-            if sampling:
-                rng, sub = jax.random.split(rng)
-                tok = G.sample_token(
-                    logit_row, sub, temperature=temperature, top_k=top_k,
-                    top_p=top_p, generated_ids=gen_buf,
-                    repetition_penalty=repetition_penalty,
-                    generated_mask=gen_mask)
-            else:
-                proc = G.process_logits(
-                    logit_row, generated_ids=gen_buf,
-                    repetition_penalty=repetition_penalty,
-                    generated_mask=gen_mask)
-                tok = jnp.argmax(proc, axis=-1)
-            slot = prompt_len + step_i
-            gen_buf = jax.lax.dynamic_update_slice_in_dim(
-                gen_buf, tok[:, None].astype(jnp.int32), slot, axis=1)
-            gen_mask = jax.lax.dynamic_update_slice_in_dim(
-                gen_mask, jnp.ones((batch, 1), bool), slot, axis=1)
-            return tok, rng, gen_buf, gen_mask
+        pick = self._get_pick(batch, buf_len, sampling, top_k, top_p,
+                              temperature, repetition_penalty)
 
         next_tok, rng, gen_buf, gen_mask = pick(
-            last, rng, jnp.int32(0), gen_buf, gen_mask)
+            last, rng, jnp.int32(prompt_len), gen_buf, gen_mask)
         next_tok.block_until_ready()
         self._ttft_ms = (time.perf_counter() - t0) * 1e3
 
@@ -216,7 +233,8 @@ class Predictor:
             idx = prompt_len + i
             logit_row, caches = decode(self.params, tok, caches, idx)
             nxt, rng, gen_buf, gen_mask = pick(
-                logit_row, rng, jnp.int32(i + 1), gen_buf, gen_mask)
+                logit_row, rng, jnp.int32(prompt_len + i + 1),
+                gen_buf, gen_mask)
             out.append(np.asarray(nxt))
             if eos_token_id is not None and bool(
                 np.all(out[-1] == eos_token_id)
@@ -226,7 +244,8 @@ class Predictor:
         return np.stack(out, axis=1)
 
     def _beam_generate(self, input_ids, max_new_tokens, num_beams,
-                       eos_token_id, length_penalty):
+                       eos_token_id, length_penalty, temperature=1.0,
+                       repetition_penalty=1.0):
         from .. import generation as G
 
         ids = np.asarray(input_ids)
@@ -237,6 +256,26 @@ class Predictor:
         # expand each row to num_beams contiguous copies (batch-major)
         tiled = np.repeat(ids, num_beams, axis=0)
         padded = np.pad(tiled, ((0, 0), (0, bucket - prompt_len)))
+        prompt_flat = jnp.asarray(tiled, jnp.int32)
+        step_pos = jnp.arange(max_new_tokens)
+
+        def beam_logprobs(logits, state, t):
+            # the reference's beam path runs the logits-processor stack
+            # (repetition penalty over prompt+beam tokens, temperature)
+            # before log-softmax; top-k/top-p are sampling-only
+            if repetition_penalty != 1.0 or temperature != 1.0:
+                toks_flat = state.tokens.reshape(
+                    batch * num_beams, max_new_tokens)
+                buf = jnp.concatenate([prompt_flat, toks_flat], axis=1)
+                mask = jnp.concatenate([
+                    jnp.ones(prompt_flat.shape, bool),
+                    jnp.broadcast_to(step_pos[None] < t, toks_flat.shape),
+                ], axis=1)
+                logits = G.process_logits(
+                    logits, temperature=temperature, generated_ids=buf,
+                    repetition_penalty=repetition_penalty,
+                    generated_mask=mask)
+            return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
         t0 = time.perf_counter()
         prefill, cache_proto = self._get_prefill(batch * num_beams, bucket)
@@ -244,8 +283,7 @@ class Predictor:
             self.params, jnp.asarray(padded, jnp.int32), cache_proto
         )
         state = G.BeamState(batch, num_beams, max_new_tokens)
-        lp = jax.nn.log_softmax(
-            logits[:, prompt_len - 1, :].astype(jnp.float32), axis=-1)
+        lp = beam_logprobs(logits[:, prompt_len - 1, :], state, 0)
         state, beam_idx, next_tok = G.beam_step(
             state, lp, 0, eos_token_id)
         caches = G.reorder_cache(caches, beam_idx)
@@ -257,8 +295,7 @@ class Predictor:
         for i in range(max_new_tokens - 1):
             logit_row, caches = decode(
                 self.params, tok, caches, prompt_len + i)
-            lp = jax.nn.log_softmax(
-                logit_row.astype(jnp.float32), axis=-1)
+            lp = beam_logprobs(logit_row, state, i + 1)
             state, beam_idx, next_tok = G.beam_step(
                 state, lp, i + 1, eos_token_id)
             caches = G.reorder_cache(caches, beam_idx)

@@ -1,0 +1,20 @@
+"""paddle_tpu.nn.initializer (parity: paddle.nn.initializer) — the
+initializer zoo lives in core.initializer; this module is the public
+namespace."""
+
+from ..core.initializer import (  # noqa: F401
+    Assign,
+    Bilinear,
+    Constant,
+    Dirac,
+    Initializer,
+    KaimingNormal,
+    KaimingUniform,
+    Normal,
+    Orthogonal,
+    TruncatedNormal,
+    Uniform,
+    XavierNormal,
+    XavierUniform,
+    calculate_gain,
+)

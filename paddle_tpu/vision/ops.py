@@ -348,3 +348,94 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if bias is not None:
         out = out + jnp.asarray(bias).reshape(1, -1, 1, 1)
     return out
+
+
+def matrix_nms(boxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0):
+    """Parity: paddle.vision.ops.matrix_nms (SOLOv2) — unlike greedy NMS
+    this is a closed-form parallel decay: every box's score is multiplied
+    by min_j decay(iou_ij) over higher-scored overlapping boxes. No
+    sequential loop at all — a single [n, n] program, the NMS variant
+    that actually fits the TPU. boxes [N, 4]; scores [N] (single class).
+    Returns (decayed_scores, keep_indices sorted by decayed score)."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    # reference order: score_threshold prunes ORIGINAL scores before the
+    # decay; only post_threshold applies to decayed scores
+    valid = np.asarray(scores >= score_threshold)
+    valid_idx = np.nonzero(valid)[0]
+    if valid_idx.size == 0:
+        return jnp.zeros_like(scores), jnp.asarray(np.zeros(0, np.int64))
+    sub_scores = scores[jnp.asarray(valid_idx)]
+    sub_boxes = boxes[jnp.asarray(valid_idx)]
+    order = jnp.argsort(-sub_scores)
+    if nms_top_k > 0:
+        order = order[:nms_top_k]
+    b = sub_boxes[order]
+    s = sub_scores[order]
+    n = b.shape[0]
+    iou = _box_iou_matrix(b, b)
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)   # j < i by score
+    iou_ji = jnp.where(upper, iou, 0.0).T            # [i, j] j higher
+    # max overlap each higher-scored box j itself suffered
+    comp = jnp.max(jnp.where(upper, iou, 0.0), axis=0)  # per column j
+    if use_gaussian:
+        # reference decay: exp(sigma*(comp^2 - iou^2)) — sigma MULTIPLIES
+        decay = jnp.exp(gaussian_sigma
+                        * (comp[None, :] ** 2 - iou_ji ** 2))
+    else:
+        decay = (1.0 - iou_ji) / jnp.maximum(1.0 - comp[None, :], 1e-10)
+    decay = jnp.where(iou_ji > 0, decay, 1.0)
+    decay_factor = jnp.min(decay, axis=1)
+    new_scores = s * decay_factor
+    keep = new_scores >= post_threshold
+    # eager compaction (dynamic size, like nms)
+    kept_sorted = jnp.argsort(-new_scores)
+    orig = valid_idx[np.asarray(order)]
+    kept = orig[np.asarray(kept_sorted)][np.asarray(keep[kept_sorted])]
+    if keep_top_k > 0:
+        kept = kept[:keep_top_k]
+    out_scores = jnp.zeros_like(scores).at[jnp.asarray(orig)].set(
+        new_scores)
+    return out_scores, jnp.asarray(kept)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Parity: paddle.vision.ops.psroi_pool (R-FCN position-sensitive
+    average pooling): input [N, C·ph·pw, H, W] → [K, C, ph, pw]; output
+    bin (c, i, j) averages channel c·ph·pw + i·pw + j over the bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    Cin, H, W = x.shape[1], x.shape[2], x.shape[3]
+    C = Cin // (ph * pw)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(feat, box):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        bin_h = jnp.maximum(y2 - y1, 0.1) / ph
+        bin_w = jnp.maximum(x2 - x1, 0.1) / pw
+        by0 = jnp.floor(y1 + jnp.arange(ph) * bin_h)
+        by1 = jnp.ceil(y1 + (jnp.arange(ph) + 1) * bin_h)
+        bx0 = jnp.floor(x1 + jnp.arange(pw) * bin_w)
+        bx1 = jnp.ceil(x1 + (jnp.arange(pw) + 1) * bin_w)
+        in_y = (ys[None, :] >= by0[:, None]) & (ys[None, :] < by1[:, None])
+        in_x = (xs[None, :] >= bx0[:, None]) & (xs[None, :] < bx1[:, None])
+        mask = in_y[:, None, :, None] & in_x[None, :, None, :]  # ph,pw,H,W
+        # position-sensitive channel selection: [C, ph, pw, H, W]
+        fs = feat.reshape(C, ph, pw, H, W)
+        num = jnp.sum(jnp.where(mask[None], fs, 0.0), axis=(-1, -2))
+        den = jnp.maximum(mask.sum(axis=(-1, -2)), 1)[None]
+        return num / den                                  # [C, ph, pw]
+
+    img_idx = np.repeat(np.arange(len(boxes_num)), boxes_num)
+    feats = x[jnp.asarray(img_idx)]
+    return jax.vmap(one_roi)(feats, boxes)

@@ -397,3 +397,55 @@ class TestLinalgExtended:
         np.testing.assert_allclose(rec, m, atol=1e-6)
         U2, s2, V2 = L.pca_lowrank(jnp.asarray(m), q=2)
         assert U2.shape == (8, 2) and s2.shape == (2,)
+
+
+class TestInitializers:
+    def _mk(self, init, shape, dtype=jnp.float32):
+        import jax
+
+        return np.asarray(init(jax.random.PRNGKey(0), shape, dtype))
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn import initializer as I
+
+        for shape in [(8, 8), (4, 12), (12, 4), (6, 2, 3)]:
+            w = self._mk(I.Orthogonal(), shape).reshape(shape[0], -1)
+            rows, cols = w.shape
+            if rows <= cols:
+                np.testing.assert_allclose(w @ w.T, np.eye(rows),
+                                           atol=1e-5)
+            else:
+                np.testing.assert_allclose(w.T @ w, np.eye(cols),
+                                           atol=1e-5)
+
+    def test_dirac_identity_conv(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.nn import initializer as I
+
+        w = jnp.asarray(self._mk(I.Dirac(), (3, 3, 3, 3)))
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 3, 6, 6)).astype(np.float32))
+        y = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   atol=1e-5)
+
+    def test_assign_and_gain(self):
+        from paddle_tpu.nn import initializer as I
+
+        v = np.arange(6, dtype=np.float32).reshape(2, 3)
+        w = self._mk(I.Assign(v), (2, 3))
+        np.testing.assert_array_equal(w, v)
+        with pytest.raises(ValueError):
+            self._mk(I.Assign(v), (3, 2))
+        assert I.calculate_gain("relu") == pytest.approx(np.sqrt(2))
+        assert I.calculate_gain("tanh") == pytest.approx(5 / 3)
+
+    def test_bilinear_upsample_kernel(self):
+        from paddle_tpu.nn import initializer as I
+
+        w = self._mk(I.Bilinear(), (2, 2, 4, 4))
+        # reference: EVERY (out, in) filter carries the separable ramp
+        assert w[0, 0].max() > 0
+        np.testing.assert_allclose(w[0, 1], w[0, 0], atol=1e-6)
+        np.testing.assert_allclose(w[1, 0], w[0, 0], atol=1e-6)
+        np.testing.assert_allclose(w[0, 0], w[0, 0].T, atol=1e-6)

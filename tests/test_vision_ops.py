@@ -207,3 +207,74 @@ class TestDeformConv:
         ref = F.conv2d(x, w, groups=2)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestMatrixNMS:
+    def test_score_threshold_prefilters_originals(self):
+        """score_threshold prunes ORIGINAL scores; decayed survivors are
+        kept unless below post_threshold."""
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.5], np.float32)
+        # heavy overlap decays box 1 to ~0.09; with post_threshold=0 it
+        # must STILL be kept (paddle keeps decayed boxes)
+        ns, keep = ops.matrix_nms(boxes, scores, score_threshold=0.3,
+                                  post_threshold=0.0)
+        assert 1 in list(np.asarray(keep))
+        # but a box under score_threshold never participates
+        scores2 = np.array([0.9, 0.1], np.float32)
+        ns2, keep2 = ops.matrix_nms(boxes, scores2, score_threshold=0.3)
+        assert list(np.asarray(keep2)) == [0]
+        assert float(ns2[1]) == 0.0
+
+    def test_decay_behavior(self):
+        """overlapping lower-scored boxes get decayed, disjoint ones
+        keep their score."""
+        boxes = np.array([
+            [0, 0, 10, 10],      # top box
+            [1, 1, 11, 11],      # heavy overlap with top
+            [50, 50, 60, 60],    # disjoint
+        ], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        new_scores, keep = ops.matrix_nms(boxes, scores,
+                                          score_threshold=0.0)
+        ns = np.asarray(new_scores)
+        assert ns[0] == pytest.approx(0.9)      # top box untouched
+        assert ns[1] < 0.4                      # heavily decayed
+        assert ns[2] == pytest.approx(0.7)      # disjoint untouched
+        assert list(np.asarray(keep)[:2]) == [0, 2]
+
+    def test_gaussian_kernel_and_threshold(self):
+        boxes = np.array([[0, 0, 10, 10], [2, 2, 12, 12]], np.float32)
+        scores = np.array([0.9, 0.85], np.float32)
+        ns_lin, _ = ops.matrix_nms(boxes, scores, score_threshold=0.0)
+        # sigma MULTIPLIES the exponent (reference convention): a large
+        # sigma suppresses harder than the linear kernel
+        ns_g, _ = ops.matrix_nms(boxes, scores, score_threshold=0.0,
+                                 use_gaussian=True, gaussian_sigma=8.0)
+        assert np.asarray(ns_g)[1] < np.asarray(ns_lin)[1]
+        _, keep = ops.matrix_nms(boxes, scores, post_threshold=0.88)
+        assert list(np.asarray(keep)) == [0]
+
+
+class TestPSRoIPool:
+    def test_position_sensitive_selection(self):
+        """each output bin reads its OWN channel group."""
+        ph = pw = 2
+        C = 1
+        x = np.zeros((1, C * ph * pw, 4, 4), np.float32)
+        # channel k holds constant value k+1 everywhere
+        for k in range(4):
+            x[0, k] = k + 1
+        boxes = jnp.asarray([[0, 0, 4, 4.]], jnp.float32)
+        out = ops.psroi_pool(jnp.asarray(x), boxes, [1], 2)
+        # bin (i, j) reads channel i*pw+j → value i*pw+j+1
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0], [[1, 2], [3, 4]])
+
+    def test_grad(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 8, 6, 6)).astype(np.float32))
+        boxes = jnp.asarray([[0, 0, 5, 5.]], jnp.float32)
+        g = jax.grad(lambda f: jnp.sum(
+            ops.psroi_pool(f, boxes, [1], 2) ** 2))(x)
+        assert np.isfinite(np.asarray(g)).all()
