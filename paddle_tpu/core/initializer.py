@@ -151,12 +151,16 @@ class Dirac(Initializer):
         opg = out_c // self.groups
         # reference (torch dirac_/paddle Dirac): within each group only
         # the first min(out_per_group, in) channels get an identity tap;
-        # the rest stay zero (no modular wrap)
-        for o in range(out_c):
-            d = o % opg
-            if d < in_c:
-                w = w.at[(o, d) + centers].set(1.0)
-        return w
+        # the rest stay zero (no modular wrap). One batched scatter, not
+        # a per-channel eager loop.
+        import numpy as _np
+
+        os_ = _np.arange(out_c)
+        ds = os_ % opg
+        sel = ds < in_c
+        idx = (os_[sel], ds[sel]) + tuple(
+            _np.full(sel.sum(), c) for c in centers)
+        return w.at[idx].set(1.0)
 
 
 class Assign(Initializer):

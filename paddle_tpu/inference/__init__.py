@@ -161,6 +161,42 @@ class Predictor:
             self._pick_fns[key] = pick
         return self._pick_fns[key]
 
+    def _get_beam_logprobs(self, batch, num_beams, max_new_tokens,
+                           prompt_len, temperature, repetition_penalty):
+        """Compiled beam logits-processor + log-softmax (the reference's
+        beam path applies repetition penalty over prompt+beam tokens and
+        temperature; top-k/top-p are sampling-only). Cached per config —
+        one program serves every step (t is traced)."""
+        from .. import generation as G
+
+        key = ("beam", batch, num_beams, max_new_tokens, prompt_len,
+               temperature, repetition_penalty)
+        if key not in self._pick_fns:
+            step_pos = jnp.arange(max_new_tokens)
+
+            @jax.jit
+            def lp_fn(logits, beam_tokens, t, prompt_flat):
+                if repetition_penalty != 1.0 or temperature != 1.0:
+                    toks_flat = beam_tokens.reshape(
+                        batch * num_beams, max_new_tokens)
+                    buf = jnp.concatenate([prompt_flat, toks_flat],
+                                          axis=1)
+                    mask = jnp.concatenate([
+                        jnp.ones(prompt_flat.shape, bool),
+                        jnp.broadcast_to(step_pos[None] < t,
+                                         toks_flat.shape),
+                    ], axis=1)
+                    logits = G.process_logits(
+                        logits, temperature=temperature,
+                        generated_ids=buf,
+                        repetition_penalty=repetition_penalty,
+                        generated_mask=mask)
+                return jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1)
+
+            self._pick_fns[key] = lp_fn
+        return self._pick_fns[key]
+
     # ------------------------------------------------------------------
     def run(self, input_ids) -> jax.Array:
         """One-shot forward (parity: Predictor::Run) → logits."""
@@ -257,25 +293,12 @@ class Predictor:
         tiled = np.repeat(ids, num_beams, axis=0)
         padded = np.pad(tiled, ((0, 0), (0, bucket - prompt_len)))
         prompt_flat = jnp.asarray(tiled, jnp.int32)
-        step_pos = jnp.arange(max_new_tokens)
+        lp_fn = self._get_beam_logprobs(
+            batch, num_beams, max_new_tokens, prompt_len, temperature,
+            repetition_penalty)
 
         def beam_logprobs(logits, state, t):
-            # the reference's beam path runs the logits-processor stack
-            # (repetition penalty over prompt+beam tokens, temperature)
-            # before log-softmax; top-k/top-p are sampling-only
-            if repetition_penalty != 1.0 or temperature != 1.0:
-                toks_flat = state.tokens.reshape(
-                    batch * num_beams, max_new_tokens)
-                buf = jnp.concatenate([prompt_flat, toks_flat], axis=1)
-                mask = jnp.concatenate([
-                    jnp.ones(prompt_flat.shape, bool),
-                    jnp.broadcast_to(step_pos[None] < t, toks_flat.shape),
-                ], axis=1)
-                logits = G.process_logits(
-                    logits, temperature=temperature, generated_ids=buf,
-                    repetition_penalty=repetition_penalty,
-                    generated_mask=mask)
-            return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return lp_fn(logits, state.tokens, jnp.int32(t), prompt_flat)
 
         t0 = time.perf_counter()
         prefill, cache_proto = self._get_prefill(batch * num_beams, bucket)

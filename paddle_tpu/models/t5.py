@@ -136,6 +136,18 @@ class T5RelativeBias(Layer):
         bias = self.embedding(buckets)            # [q, k, heads]
         return jnp.transpose(bias, (2, 0, 1))[None]  # [1, h, q, k]
 
+    def row(self, q_pos, k_len):
+        """Single-query bias row for cached decode: [1, h, 1, k_len].
+        ``q_pos`` may be traced (the decode loop's cache index)."""
+        cfg = self.config
+        buckets = _relative_position_bucket(
+            jnp.arange(k_len) - q_pos, self.bidirectional,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )
+        bias = self.embedding(buckets)            # [k_len, heads]
+        return jnp.transpose(bias, (1, 0))[None, :, None, :]
+
 
 class T5Attention(Layer):
     def __init__(self, config: T5Config, is_cross: bool = False):
@@ -155,6 +167,51 @@ class T5Attention(Layer):
                                       weight_attr=init)
         self.o = RowParallelLinear(inner, cfg.d_model, has_bias=False,
                                    weight_attr=init_o)
+
+    def project_kv(self, kv):
+        """Project K/V once (cross-attention prefill: the encoder output
+        never changes during decode)."""
+        cfg = self.config
+        b, sk, _ = kv.shape
+        return (self.k(kv).reshape(b, sk, cfg.num_heads, cfg.d_kv),
+                self.v(kv).reshape(b, sk, cfg.num_heads, cfg.d_kv))
+
+    def decode_step(self, x, cache_index, kv_cache=None,
+                    precomputed_kv=None, position_bias=None,
+                    attention_mask=None):
+        """Single-token attention against a cache. ``kv_cache``
+        (k, v) [b, max_len, h, d] for self-attention (updated at
+        ``cache_index``); ``precomputed_kv`` for cross-attention.
+        Returns (out, new_kv_cache)."""
+        cfg = self.config
+        b = x.shape[0]
+        q = self.q(x).reshape(b, 1, cfg.num_heads, cfg.d_kv)
+        if precomputed_kv is not None:
+            k, v = precomputed_kv
+            bias = position_bias
+            if attention_mask is not None:
+                pad = jnp.where(attention_mask[:, None, None, :] > 0,
+                                0.0, -1e30).astype(jnp.float32)
+                bias = pad if bias is None else bias + pad
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=bias, scale=1.0, training=False)
+            return self.o(out.reshape(b, 1, -1)), None
+        ck, cv = kv_cache
+        k_new = self.k(x).reshape(b, cfg.num_heads, cfg.d_kv)
+        v_new = self.v(x).reshape(b, cfg.num_heads, cfg.d_kv)
+        ck = jax.lax.dynamic_update_index_in_dim(
+            ck, k_new[:, None], cache_index, 1)
+        cv = jax.lax.dynamic_update_index_in_dim(
+            cv, v_new[:, None], cache_index, 1)
+        max_len = ck.shape[1]
+        # causal validity: only positions <= cache_index are live
+        live = jnp.arange(max_len) <= cache_index        # [max_len]
+        bias = jnp.where(live, 0.0, -1e30)[None, None, None, :]
+        if position_bias is not None:
+            bias = bias + position_bias
+        out = F.scaled_dot_product_attention(
+            q, ck, cv, attn_mask=bias, scale=1.0, training=False)
+        return self.o(out.reshape(b, 1, -1)), (ck, cv)
 
     def forward(self, x, kv=None, position_bias=None, causal=False,
                 attention_mask=None):
@@ -235,6 +292,19 @@ class T5Block(Layer):
                 self.ln_cross(x), kv=enc, attention_mask=enc_mask))
         return x + self.dropout(self.ff(self.ln2(x)))
 
+    def decode_step(self, x, cache_index, self_cache, cross_kv,
+                    position_bias=None, enc_mask=None):
+        """One cached decoder token. Returns (x, new_self_cache)."""
+        h, self_cache = self.self_attn.decode_step(
+            self.ln1(x), cache_index, kv_cache=self_cache,
+            position_bias=position_bias)
+        x = x + h
+        h, _ = self.cross_attn.decode_step(
+            self.ln_cross(x), cache_index, precomputed_kv=cross_kv,
+            attention_mask=enc_mask)
+        x = x + h
+        return x + self.ff(self.ln2(x)), self_cache
+
 
 class T5Stack(Layer):
     def __init__(self, config: T5Config, is_decoder: bool):
@@ -257,6 +327,32 @@ class T5Stack(Layer):
             x = blk(x, enc=enc, position_bias=bias,
                     attention_mask=attention_mask, enc_mask=enc_mask)
         return self.dropout(self.final_norm(x))
+
+    def init_decode(self, batch, max_len, enc, dtype=jnp.float32):
+        """Decoder-only: allocate self-attention caches and project the
+        cross-attention K/V once from the encoder output."""
+        cfg = self.blocks[0].self_attn.config
+        caches = [
+            (jnp.zeros((batch, max_len, cfg.num_heads, cfg.d_kv), dtype),
+             jnp.zeros((batch, max_len, cfg.num_heads, cfg.d_kv), dtype))
+            for _ in self.blocks
+        ]
+        cross = [blk.cross_attn.project_kv(enc) for blk in self.blocks]
+        return caches, cross
+
+    def decode_step(self, x, cache_index, caches, cross_kvs,
+                    enc_mask=None):
+        """x: [b, 1, d_model] single-token embedding. Returns
+        (hidden [b, 1, d], new_caches)."""
+        max_len = caches[0][0].shape[1]
+        bias = self.relative_bias.row(cache_index, max_len)
+        new_caches = []
+        for blk, cache, cross in zip(self.blocks, caches, cross_kvs):
+            x, cache = blk.decode_step(
+                x, cache_index, cache, cross, position_bias=bias,
+                enc_mask=enc_mask)
+            new_caches.append(cache)
+        return self.final_norm(x), new_caches
 
 
 class T5Model(Layer):
@@ -332,10 +428,17 @@ class T5ForConditionalGeneration(Layer):
             ignore_index=self.config.pad_token_id,
         )
 
-    def generate(self, input_ids, max_length=20, attention_mask=None):
-        """Greedy decode: encoder runs once; the decoder re-runs on the
-        growing prefix inside one jitted lax.scan over a fixed-size
-        buffer (static shapes; the step index masks the suffix)."""
+    def generate(self, input_ids, max_length=20, attention_mask=None,
+                 use_cache=True):
+        """Greedy decode, encoder run once. ``use_cache=True`` (default)
+        decodes incrementally — per-layer self-attention KV caches plus
+        cross-attention K/V projected a single time from the encoder
+        output, O(T) attention per new token. ``use_cache=False`` is the
+        cache-free reference path (full decoder re-run inside a
+        lax.scan), kept as the numerics oracle."""
+        if use_cache:
+            return self._generate_cached(input_ids, max_length,
+                                         attention_mask)
         cfg = self.config
         enc = self.t5.encode(input_ids, attention_mask)
         b = input_ids.shape[0]
@@ -351,4 +454,29 @@ class T5ForConditionalGeneration(Layer):
             return buf.at[:, t + 1].set(nxt), nxt
 
         buf, toks = jax.lax.scan(step, buf, jnp.arange(max_length - 1))
+        return buf
+
+    def _generate_cached(self, input_ids, max_length, attention_mask):
+        cfg = self.config
+        enc = self.t5.encode(input_ids, attention_mask)
+        b = input_ids.shape[0]
+        caches, cross = self.t5.decoder.init_decode(
+            b, max_length, enc, dtype=enc.dtype)
+        buf = jnp.full((b, max_length), cfg.pad_token_id, jnp.int32)
+        buf = buf.at[:, 0].set(cfg.decoder_start_token_id)
+
+        def step(carry, t):
+            buf, caches = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+            x = self.t5.shared(tok)                # [b, 1, d]
+            hidden, caches = self.t5.decoder.decode_step(
+                x, t, caches, cross, enc_mask=attention_mask)
+            logits = self._logits(hidden)[:, 0]    # [b, vocab]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], t + 1, axis=1)
+            return (buf, caches), nxt
+
+        (buf, _), _ = jax.lax.scan(
+            step, (buf, caches), jnp.arange(max_length - 1))
         return buf
