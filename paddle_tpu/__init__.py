@@ -23,6 +23,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import signal  # noqa: F401
+from .hapi.summary import flops, summary  # noqa: F401
 from . import sparse  # noqa: F401
 from . import vision  # noqa: F401
 from .core import dtype as _dtype_mod

@@ -6,3 +6,5 @@ from .callbacks import (  # noqa: F401
     ProgBarLogger,
 )
 from .model import Model  # noqa: F401
+
+from .summary import flops, summary  # noqa: F401,E402

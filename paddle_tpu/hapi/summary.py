@@ -1,0 +1,165 @@
+"""Model introspection (parity: paddle.summary / paddle.flops —
+python/paddle/hapi/model_summary.py, hapi/dynamic_flops.py).
+
+Implemented with forward post-hooks over one abstract-shape trace:
+``jax.eval_shape`` runs the whole model without allocating or computing,
+so summarizing a 70B-parameter model costs nothing — the TPU-world
+version of the reference's hook-based dry run.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.module import Layer
+
+
+def _shapes_of(out):
+    if hasattr(out, "shape"):
+        return [tuple(out.shape)]
+    if isinstance(out, (tuple, list)):
+        res = []
+        for o in out:
+            res.extend(_shapes_of(o))
+        return res
+    return []
+
+
+def _collect(net: Layer, input_spec, dtypes, kwargs):
+    """One eval_shape pass recording (layer, output shapes) per leaf."""
+    records = []
+    handles = []
+    targets = list(net.named_sublayers(include_self=False))
+    if not targets:              # the net itself is a single leaf layer
+        targets = [("", net)]
+    for name, sub in targets:
+        if sub._sub_layers:      # only leaves get rows (reference style)
+            continue
+
+        def mk(name, sub):
+            def hook(lyr, inputs, out):
+                records.append({
+                    "name": name,
+                    "type": type(sub).__name__,
+                    "out": _shapes_of(out),
+                    "params": int(sum(
+                        np.prod(p.shape)
+                        for p in sub._parameters.values()
+                        if p is not None)),
+                    "in": _shapes_of(inputs),
+                })
+                return out
+
+            return hook
+
+        handles.append(sub.register_forward_post_hook(mk(name, sub)))
+
+    try:
+        args = [jax.ShapeDtypeStruct(s, d)
+                for s, d in zip(input_spec, dtypes)]
+        jax.eval_shape(lambda *a: net(*a, **kwargs), *args)
+    finally:
+        for h in handles:
+            h.remove()
+    return records
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None, **kwargs):  # noqa: A002
+    """Parity: paddle.summary — prints the layer table, returns
+    {'total_params', 'trainable_params'}."""
+    if input is not None:
+        specs = [tuple(np.asarray(x).shape) for x in (
+            input if isinstance(input, (tuple, list)) else [input])]
+        dts = [jnp.asarray(np.asarray(x)).dtype for x in (
+            input if isinstance(input, (tuple, list)) else [input])]
+    else:
+        if isinstance(input_size, tuple) and all(
+                isinstance(i, int) for i in input_size):
+            input_size = [input_size]
+        specs = [tuple(s) for s in input_size]
+        dts = dtypes or [jnp.float32] * len(specs)
+        if not isinstance(dts, (list, tuple)):
+            dts = [dts] * len(specs)
+    records = _collect(net, specs, dts, kwargs)
+
+    header = f"{'Layer (type)':<38}{'Output Shape':<26}{'Param #':>12}"
+    sep = "=" * len(header)
+    lines = [sep, header, sep]
+    for r in records:
+        shape = str(r["out"][0] if len(r["out"]) == 1 else r["out"])
+        lines.append(
+            f"{r['name'] + ' (' + r['type'] + ')':<38}"
+            f"{shape:<26}{r['params']:>12,}")
+    all_params = int(sum(np.prod(p.shape)
+                         for _, p in net.named_parameters()))
+    trainable = int(sum(np.prod(p.shape)
+                        for _, p in net.named_parameters() if p.trainable))
+    lines += [sep,
+              f"Total params: {all_params:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {all_params - trainable:,}",
+              sep]
+    print("\n".join(lines))
+    return {"total_params": all_params, "trainable_params": trainable}
+
+
+_FLOP_RULES = {}
+
+
+def _rule(*type_names):
+    def deco(fn):
+        for t in type_names:
+            _FLOP_RULES[t] = fn
+        return fn
+
+    return deco
+
+
+@_rule("Linear", "ColumnParallelLinear", "RowParallelLinear")
+def _linear_flops(rec):
+    out = rec["out"][0]
+    params = rec["params"]
+    # 2 * tokens * in * out ≈ 2 * prod(out_shape[:-1]) * weight_size
+    tokens = int(np.prod(out[:-1])) if len(out) > 1 else 1
+    return 2 * tokens * params
+
+
+@_rule("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose")
+def _conv_flops(rec):
+    out = rec["out"][0]
+    spatial = int(np.prod(out[2:])) * out[0]
+    return 2 * spatial * rec["params"]
+
+
+@_rule("Embedding", "VocabParallelEmbedding")
+def _emb_flops(rec):
+    return 0
+
+
+def flops(net: Layer, input_size, dtypes=None, print_detail=False,
+          **kwargs):
+    """Parity: paddle.flops — MAC-based FLOPs estimate from one abstract
+    trace (matmul-bearing leaves; normalizations/activations are counted
+    as 0, matching the reference's dominant-term accounting)."""
+    if isinstance(input_size, tuple) and all(
+            isinstance(i, int) for i in input_size):
+        input_size = [input_size]
+    dts = dtypes or [jnp.float32] * len(input_size)
+    if not isinstance(dts, (list, tuple)):
+        dts = [dts] * len(input_size)
+    records = _collect(net, [tuple(s) for s in input_size], dts, kwargs)
+    total = 0
+    for r in records:
+        rule = _FLOP_RULES.get(r["type"])
+        if rule is not None and r["out"]:
+            f = int(rule(r))
+            total += f
+            if print_detail:
+                print(f"{r['name']:<40}{r['type']:<20}{f:>16,}")
+    if print_detail:
+        print(f"{'Total FLOPs:':<60}{total:>16,}")
+    return total

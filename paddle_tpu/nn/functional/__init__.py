@@ -432,19 +432,33 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     return summed / counts
 
 
+def _adaptive_avg_matrix(out_len, in_len):
+    """[out, in] row-stochastic bin-average matrix with the reference's
+    adaptive bin edges: start = floor(i·in/out), end = ceil((i+1)·in/out).
+    Makes adaptive pooling two separable matmuls (MXU-shaped)."""
+    i = jnp.arange(out_len)
+    start = jnp.floor(i * in_len / out_len).astype(jnp.int32)
+    end = jnp.ceil((i + 1) * in_len / out_len).astype(jnp.int32)
+    j = jnp.arange(in_len)
+    mask = (j[None, :] >= start[:, None]) & (j[None, :] < end[:, None])
+    m = mask.astype(jnp.float32)
+    return m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     x = _v(x)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
-    if data_format == "NCHW":
-        h, w = x.shape[2], x.shape[3]
-    else:
-        h, w = x.shape[1], x.shape[2]
-    assert h % output_size[0] == 0 and w % output_size[1] == 0, (
-        "adaptive pool requires divisible sizes in this implementation"
-    )
-    k = (h // output_size[0], w // output_size[1])
-    return avg_pool2d(x, k, k, 0, data_format)
+    if data_format == "NHWC":
+        return jnp.moveaxis(
+            adaptive_avg_pool2d(jnp.moveaxis(x, -1, 1), output_size), 1, -1)
+    h, w = x.shape[2], x.shape[3]
+    if h % output_size[0] == 0 and w % output_size[1] == 0:
+        k = (h // output_size[0], w // output_size[1])
+        return avg_pool2d(x, k, k, 0, data_format)
+    my = _adaptive_avg_matrix(output_size[0], h)
+    mx = _adaptive_avg_matrix(output_size[1], w)
+    return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -702,3 +716,180 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     if reduction == "sum":
         return jnp.sum(loss)
     return loss
+
+
+# ---------------------------------------------------------------------------
+# interpolate / grid_sample
+# ---------------------------------------------------------------------------
+def _resize_src_index(out_len, in_len, align_corners):
+    i = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners:
+        if out_len == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * (in_len - 1) / (out_len - 1)
+    return jnp.clip((i + 0.5) * in_len / out_len - 0.5, 0.0,
+                    in_len - 1.0)
+
+
+def _cubic_weights(out_len, in_len, align_corners, a=-0.75):
+    """Separable cubic-convolution matrix [out, in] with the torch/paddle
+    kernel (a = -0.75) and border-replicated taps."""
+    if align_corners:
+        src = _resize_src_index(out_len, in_len, True)
+    else:
+        # raw half-pixel coordinate (unclipped — edge taps replicate via
+        # the index clamp below)
+        i = jnp.arange(out_len, dtype=jnp.float32)
+        src = (i + 0.5) * in_len / out_len - 0.5
+    base = jnp.floor(src).astype(jnp.int32)
+    t = src - base
+
+    def k(x):
+        ax = jnp.abs(x)
+        w1 = (a + 2) * ax ** 3 - (a + 3) * ax ** 2 + 1
+        w2 = a * ax ** 3 - 5 * a * ax ** 2 + 8 * a * ax - 4 * a
+        return jnp.where(ax <= 1, w1, jnp.where(ax < 2, w2, 0.0))
+
+    m = jnp.zeros((out_len, in_len))
+    rows = jnp.arange(out_len)
+    for off in (-1, 0, 1, 2):
+        idx = jnp.clip(base + off, 0, in_len - 1)
+        m = m.at[rows, idx].add(k(t - off))
+    return m
+
+
+def _lin_weights(out_len, in_len, align_corners):
+    """Separable 1-D interpolation matrix [out_len, in_len]."""
+    src = _resize_src_index(out_len, in_len, align_corners)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_len - 1)
+    w_hi = src - lo
+    m = jnp.zeros((out_len, in_len))
+    m = m.at[jnp.arange(out_len), lo].add(1.0 - w_hi)
+    m = m.at[jnp.arange(out_len), hi].add(w_hi)
+    return m
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """Parity: paddle.nn.functional.interpolate (4-D NCHW/NHWC; modes
+    nearest / bilinear / bicubic / area).
+
+    TPU design: linear modes are two separable [out, in] matmuls (MXU
+    ops, trivially fused by XLA) rather than gathers; nearest is a pure
+    gather; area is adaptive average pooling.
+    """
+    x = _v(x)
+    if data_format == "NHWC":
+        return jnp.moveaxis(
+            interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor, mode,
+                        align_corners, "NCHW"), 1, -1)
+    n, c, h, w = x.shape
+    if size is not None:
+        oh, ow = (size, size) if isinstance(size, int) else tuple(size)
+    else:
+        sf = (scale_factor, scale_factor) if not isinstance(
+            scale_factor, (tuple, list)) else scale_factor
+        oh, ow = int(h * sf[0]), int(w * sf[1])
+    if mode == "nearest":
+        # paddle/torch nearest: floor(i * in/out)
+        iy = jnp.minimum((jnp.arange(oh) * h // oh), h - 1)
+        ix = jnp.minimum((jnp.arange(ow) * w // ow), w - 1)
+        return x[:, :, iy][:, :, :, ix]
+    if mode == "bilinear":
+        my = _lin_weights(oh, h, align_corners)
+        mx = _lin_weights(ow, w, align_corners)
+        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
+    if mode == "bicubic":
+        my = _cubic_weights(oh, h, align_corners)
+        mx = _cubic_weights(ow, w, align_corners)
+        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
+    if mode == "area":
+        return adaptive_avg_pool2d(x, (oh, ow))
+    raise ValueError(f"interpolate: unknown mode {mode!r}")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       data_format)
+
+
+def _unnormalize_coord(g, size, align_corners):
+    if align_corners:
+        return (g + 1.0) * 0.5 * (size - 1)
+    return ((g + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect_coord(p, size, align_corners):
+    if align_corners:
+        span = 2.0 * (size - 1)
+        if size == 1:
+            return jnp.zeros_like(p)
+        p = jnp.abs(jnp.mod(p, span))
+        return jnp.where(p > size - 1, span - p, p)
+    span = 2.0 * size
+    p = jnp.mod(p + 0.5, span)
+    p = jnp.abs(p)
+    p = jnp.where(p > size, span - p, p)
+    return jnp.clip(p - 0.5, 0.0, size - 1.0)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Parity: paddle.nn.functional.grid_sample. x [N, C, H, W]; grid
+    [N, Hg, Wg, 2] with normalized (x, y) in [-1, 1]. One batched
+    bilinear gather — autodiff replaces the reference's atomic-add
+    backward kernel."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unknown mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample: unknown padding_mode {padding_mode!r}")
+    x = _v(x)
+    grid = _v(grid)
+    n, c, h, w = x.shape
+    gx = _unnormalize_coord(grid[..., 0].astype(jnp.float32), w,
+                            align_corners)
+    gy = _unnormalize_coord(grid[..., 1].astype(jnp.float32), h,
+                            align_corners)
+    if padding_mode == "reflection":
+        gx = _reflect_coord(gx, w, align_corners)
+        gy = _reflect_coord(gy, h, align_corners)
+
+    def sample_one(feat, yy, xx):
+        if padding_mode == "zeros":
+            ring = jnp.pad(feat, ((0, 0), (1, 1), (1, 1)))
+            far = (yy < -1.0) | (yy > h) | (xx < -1.0) | (xx > w)
+            yy2 = jnp.clip(yy + 1.0, 0.0, h + 1.0)
+            xx2 = jnp.clip(xx + 1.0, 0.0, w + 1.0)
+            if mode == "nearest":
+                iy = jnp.round(yy2).astype(jnp.int32)
+                ix = jnp.round(xx2).astype(jnp.int32)
+                vals = ring[:, iy, ix]
+            else:
+                vals = _bilerp(ring, yy2, xx2)
+            return jnp.where(far[None], 0.0, vals)
+        yy2 = jnp.clip(yy, 0.0, h - 1.0)
+        xx2 = jnp.clip(xx, 0.0, w - 1.0)
+        if mode == "nearest":
+            return feat[:, jnp.round(yy2).astype(jnp.int32),
+                        jnp.round(xx2).astype(jnp.int32)]
+        return _bilerp(feat, yy2, xx2)
+
+    return jax.vmap(sample_one)(x, gy, gx)
+
+
+def _bilerp(feat, y, x):
+    """feat [C, H, W]; y/x same-shaped float grids → [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy1 = y - y0
+    wx1 = x - x0
+    return (feat[:, y0, x0] * ((1 - wy1) * (1 - wx1))
+            + feat[:, y0, x1] * ((1 - wy1) * wx1)
+            + feat[:, y1, x0] * (wy1 * (1 - wx1))
+            + feat[:, y1, x1] * (wy1 * wx1))

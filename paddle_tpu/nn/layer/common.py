@@ -332,3 +332,32 @@ class Unflatten(Layer):
     def forward(self, x):
         ax = self.axis % x.ndim
         return x.reshape(x.shape[:ax] + self.shape + x.shape[ax + 1:])
+
+
+class Upsample(Layer):
+    """Parity: paddle.nn.Upsample over F.interpolate."""
+
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "nearest", False, data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "bilinear", True, data_format)

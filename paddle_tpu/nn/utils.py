@@ -1,0 +1,161 @@
+"""paddle_tpu.nn.utils (parity: python/paddle/nn/utils/ — weight_norm,
+remove_weight_norm, spectral_norm, clip_grad_norm_, clip_grad_value_,
+parameters_to_vector, vector_to_parameters).
+
+Reparameterization design in a functional world: ``weight_norm`` replaces
+the layer's ``weight`` Parameter with ``weight_g``/``weight_v`` Parameters
+and recomputes the plain-array ``weight`` attribute inside a forward
+pre-hook. Because the recompute reads the (possibly tracer-swapped)
+Parameter values, the same layer works eagerly AND under
+``functional_call``/jit/grad — gradients flow to g and v, which is the
+whole point of the reparameterization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.module import Layer
+from ..core.parameter import Parameter
+
+
+def _norm_except_dim(v, dim):
+    """L2 norm over all axes except ``dim`` (paddle weight_norm layout);
+    dim=None → full-tensor norm (scalar g)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Parity: paddle.nn.utils.weight_norm — w = g · v / ||v||."""
+    if name not in layer._parameters:
+        raise ValueError(f"weight_norm: no parameter {name!r}")
+    w = layer._parameters.pop(name)
+    g0 = _norm_except_dim(w.value, dim)
+    layer.add_parameter(f"{name}_g", Parameter(g0, name=f"{w.name}_g"))
+    layer.add_parameter(f"{name}_v",
+                        Parameter(w.value, name=f"{w.name}_v"))
+
+    def _recompute(lyr, inputs):
+        g = lyr._parameters[f"{name}_g"].value
+        v = lyr._parameters[f"{name}_v"].value
+        # plain-array attribute: functional extraction sees only g and v
+        object.__setattr__(
+            lyr, name, v * (g / _norm_except_dim(v, dim)))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = (
+        handle, dim)
+    _recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g·v/||v|| back into a single Parameter."""
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"remove_weight_norm: {name!r} not weight-normed")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    g = layer._parameters.pop(f"{name}_g")
+    v = layer._parameters.pop(f"{name}_v")
+    w = v.value * (g.value / _norm_except_dim(v.value, dim))
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w, name=v.name[:-2]))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0):
+    """Parity: paddle.nn.utils.spectral_norm — w / sigma_max(w), with the
+    power-iteration vector ``u`` kept as a buffer. Under jit the
+    iteration runs from the stored buffer (stop-gradient, reference
+    behavior); the buffer itself advances on eager calls."""
+    if name not in layer._parameters:
+        raise ValueError(f"spectral_norm: no parameter {name!r}")
+    w = layer._parameters.pop(name)
+    layer.add_parameter(f"{name}_orig",
+                        Parameter(w.value, name=f"{w.name}_orig"))
+    mat0 = _to_matrix(w.value, dim)
+    key = jax.random.PRNGKey(0)
+    u0 = jax.random.normal(key, (mat0.shape[0],), jnp.float32)
+    layer.register_buffer(f"{name}_u", u0 / jnp.linalg.norm(u0))
+
+    def _recompute(lyr, inputs):
+        wv = lyr._parameters[f"{name}_orig"].value
+        mat = _to_matrix(wv, dim)
+        u = lyr._buffers[f"{name}_u"]
+        for _ in range(max(1, n_power_iterations)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        sigma = u @ (mat @ v)
+        object.__setattr__(lyr, name, wv / sigma)
+        try:  # persist the iterate when running eagerly
+            import jax.core as _jc
+
+            if not isinstance(u, _jc.Tracer):
+                lyr._buffers[f"{name}_u"] = u
+        except Exception:
+            pass
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = (
+        handle, dim)
+    _recompute(layer, ())
+    return layer
+
+
+def _to_matrix(w, dim):
+    if dim != 0:
+        w = jnp.moveaxis(w, dim, 0)
+    return w.reshape(w.shape[0], -1)
+
+
+# ---------------------------------------------------------------------------
+# gradient / parameter vector utilities
+# ---------------------------------------------------------------------------
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    """Parity: paddle.nn.utils.clip_grad_norm_ — clips the ``.grad``
+    fields in place, returns the total norm."""
+    params = [p for p in parameters if getattr(p, "grad", None) is not None]
+    if not params:
+        return jnp.zeros(())
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(p.grad)) for p in params]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(p.grad) ** norm_type) for p in params]
+        )) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad = p.grad * scale
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if getattr(p, "grad", None) is not None:
+            p.grad = jnp.clip(p.grad, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters):
+    return jnp.concatenate([jnp.ravel(p.value) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters):
+    i = 0
+    for p in parameters:
+        n = int(jnp.size(p.value))
+        p.value = vec[i:i + n].reshape(p.value.shape).astype(p.value.dtype)
+        i += n

@@ -85,24 +85,14 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 # RoI align / pool
 # ---------------------------------------------------------------------------
 def _bilinear_sample(feat, y, x):
-    """feat [C, H, W]; y/x arbitrary same-shaped grids → [C, *grid]."""
+    """feat [C, H, W]; y/x arbitrary same-shaped grids → [C, *grid].
+    Border-clamped wrapper over the shared 4-tap gather
+    (nn.functional._bilerp)."""
+    from ..nn.functional import _bilerp
+
     H, W = feat.shape[-2:]
-    y = jnp.clip(y, 0.0, H - 1.0)
-    x = jnp.clip(x, 0.0, W - 1.0)
-    y0 = jnp.floor(y).astype(jnp.int32)
-    x0 = jnp.floor(x).astype(jnp.int32)
-    y1 = jnp.minimum(y0 + 1, H - 1)
-    x1 = jnp.minimum(x0 + 1, W - 1)
-    wy1 = y - y0
-    wx1 = x - x0
-    wy0 = 1.0 - wy1
-    wx0 = 1.0 - wx1
-    v00 = feat[:, y0, x0]
-    v01 = feat[:, y0, x1]
-    v10 = feat[:, y1, x0]
-    v11 = feat[:, y1, x1]
-    return (v00 * (wy0 * wx0) + v01 * (wy0 * wx1)
-            + v10 * (wy1 * wx0) + v11 * (wy1 * wx1))
+    return _bilerp(feat, jnp.clip(y, 0.0, H - 1.0),
+                   jnp.clip(x, 0.0, W - 1.0))
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
