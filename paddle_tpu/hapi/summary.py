@@ -71,16 +71,21 @@ def _collect(net: Layer, input_spec, dtypes, kwargs):
 def summary(net: Layer, input_size=None, dtypes=None, input=None, **kwargs):  # noqa: A002
     """Parity: paddle.summary — prints the layer table, returns
     {'total_params', 'trainable_params'}."""
+    def _norm_sizes(sz):
+        if sz is None:
+            return None
+        if isinstance(sz, (tuple, list)) and sz and all(
+                isinstance(i, int) for i in sz):
+            return [tuple(sz)]          # single shape, tuple OR list
+        return [tuple(s) for s in sz]
+
     if input is not None:
         specs = [tuple(np.asarray(x).shape) for x in (
             input if isinstance(input, (tuple, list)) else [input])]
         dts = [jnp.asarray(np.asarray(x)).dtype for x in (
             input if isinstance(input, (tuple, list)) else [input])]
     else:
-        if isinstance(input_size, tuple) and all(
-                isinstance(i, int) for i in input_size):
-            input_size = [input_size]
-        specs = [tuple(s) for s in input_size]
+        specs = _norm_sizes(input_size)
         dts = dtypes or [jnp.float32] * len(specs)
         if not isinstance(dts, (list, tuple)):
             dts = [dts] * len(specs)
@@ -145,9 +150,9 @@ def flops(net: Layer, input_size, dtypes=None, print_detail=False,
     """Parity: paddle.flops — MAC-based FLOPs estimate from one abstract
     trace (matmul-bearing leaves; normalizations/activations are counted
     as 0, matching the reference's dominant-term accounting)."""
-    if isinstance(input_size, tuple) and all(
+    if isinstance(input_size, (tuple, list)) and input_size and all(
             isinstance(i, int) for i in input_size):
-        input_size = [input_size]
+        input_size = [tuple(input_size)]
     dts = dtypes or [jnp.float32] * len(input_size)
     if not isinstance(dts, (list, tuple)):
         dts = [dts] * len(input_size)

@@ -721,12 +721,14 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 # ---------------------------------------------------------------------------
 # interpolate / grid_sample
 # ---------------------------------------------------------------------------
-def _resize_src_index(out_len, in_len, align_corners):
+def _resize_src_index(out_len, in_len, align_corners, align_mode=0):
     i = jnp.arange(out_len, dtype=jnp.float32)
     if align_corners:
         if out_len == 1:
             return jnp.zeros((1,), jnp.float32)
         return i * (in_len - 1) / (out_len - 1)
+    if align_mode == 1:   # paddle asymmetric mode: src = i·in/out
+        return jnp.clip(i * in_len / out_len, 0.0, in_len - 1.0)
     return jnp.clip((i + 0.5) * in_len / out_len - 0.5, 0.0,
                     in_len - 1.0)
 
@@ -758,9 +760,9 @@ def _cubic_weights(out_len, in_len, align_corners, a=-0.75):
     return m
 
 
-def _lin_weights(out_len, in_len, align_corners):
+def _lin_weights(out_len, in_len, align_corners, align_mode=0):
     """Separable 1-D interpolation matrix [out_len, in_len]."""
-    src = _resize_src_index(out_len, in_len, align_corners)
+    src = _resize_src_index(out_len, in_len, align_corners, align_mode)
     lo = jnp.floor(src).astype(jnp.int32)
     hi = jnp.minimum(lo + 1, in_len - 1)
     w_hi = src - lo
@@ -771,19 +773,59 @@ def _lin_weights(out_len, in_len, align_corners):
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, data_format="NCHW"):
-    """Parity: paddle.nn.functional.interpolate (4-D NCHW/NHWC; modes
-    nearest / bilinear / bicubic / area).
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    """Parity: paddle.nn.functional.interpolate — 3-D NCW (linear /
+    nearest), 4-D NCHW/NHWC (nearest / bilinear / bicubic / area), 5-D
+    NCDHW (trilinear / nearest).
 
-    TPU design: linear modes are two separable [out, in] matmuls (MXU
-    ops, trivially fused by XLA) rather than gathers; nearest is a pure
+    TPU design: linear modes are separable [out, in] matmuls (MXU ops,
+    trivially fused by XLA) rather than gathers; nearest is a pure
     gather; area is adaptive average pooling.
     """
     x = _v(x)
-    if data_format == "NHWC":
+    if data_format in ("NWC", "NHWC", "NDHWC"):
+        fmt = {"NWC": "NCW", "NHWC": "NCHW", "NDHWC": "NCDHW"}
         return jnp.moveaxis(
             interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor, mode,
-                        align_corners, "NCHW"), 1, -1)
+                        align_corners, align_mode, fmt[data_format]),
+            1, -1)
+    if x.ndim == 3:
+        n, c, w = x.shape
+        if size is not None:
+            ow = size if isinstance(size, int) else tuple(size)[0]
+        else:
+            sf = scale_factor if not isinstance(
+                scale_factor, (tuple, list)) else scale_factor[0]
+            ow = int(w * sf)
+        if mode == "nearest":
+            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
+            return x[:, :, ix]
+        if mode == "linear":
+            mx = _lin_weights(ow, w, align_corners, align_mode)
+            return jnp.einsum("Ow,ncw->ncO", mx, x).astype(x.dtype)
+        raise ValueError(f"interpolate 3-D: unknown mode {mode!r}")
+    if x.ndim == 5:
+        n, c, d, h, w = x.shape
+        if size is not None:
+            od, oh, ow = (size,) * 3 if isinstance(size, int) \
+                else tuple(size)
+        else:
+            sf = (scale_factor,) * 3 if not isinstance(
+                scale_factor, (tuple, list)) else scale_factor
+            od, oh, ow = int(d * sf[0]), int(h * sf[1]), int(w * sf[2])
+        if mode == "nearest":
+            iz = jnp.minimum(jnp.arange(od) * d // od, d - 1)
+            iy = jnp.minimum(jnp.arange(oh) * h // oh, h - 1)
+            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
+            return x[:, :, iz][:, :, :, iy][:, :, :, :, ix]
+        if mode == "trilinear":
+            mz = _lin_weights(od, d, align_corners, align_mode)
+            my = _lin_weights(oh, h, align_corners, align_mode)
+            mx = _lin_weights(ow, w, align_corners, align_mode)
+            return jnp.einsum(
+                "Dd,Hh,Ww,ncdhw->ncDHW", mz, my, mx, x
+            ).astype(x.dtype)
+        raise ValueError(f"interpolate 5-D: unknown mode {mode!r}")
     n, c, h, w = x.shape
     if size is not None:
         oh, ow = (size, size) if isinstance(size, int) else tuple(size)
@@ -797,8 +839,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         ix = jnp.minimum((jnp.arange(ow) * w // ow), w - 1)
         return x[:, :, iy][:, :, :, ix]
     if mode == "bilinear":
-        my = _lin_weights(oh, h, align_corners)
-        mx = _lin_weights(ow, w, align_corners)
+        my = _lin_weights(oh, h, align_corners, align_mode)
+        mx = _lin_weights(ow, w, align_corners, align_mode)
         return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
     if mode == "bicubic":
         my = _cubic_weights(oh, h, align_corners)
@@ -810,9 +852,9 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",
-             align_corners=False, data_format="NCHW"):
+             align_corners=False, align_mode=0, data_format="NCHW"):
     return interpolate(x, size, scale_factor, mode, align_corners,
-                       data_format)
+                       align_mode, data_format)
 
 
 def _unnormalize_coord(g, size, align_corners):
@@ -849,10 +891,8 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     x = _v(x)
     grid = _v(grid)
     n, c, h, w = x.shape
-    gx = _unnormalize_coord(grid[..., 0].astype(jnp.float32), w,
-                            align_corners)
-    gy = _unnormalize_coord(grid[..., 1].astype(jnp.float32), h,
-                            align_corners)
+    gx = _unnormalize_coord(_f32up(grid[..., 0]), w, align_corners)
+    gy = _unnormalize_coord(_f32up(grid[..., 1]), h, align_corners)
     if padding_mode == "reflection":
         gx = _reflect_coord(gx, w, align_corners)
         gy = _reflect_coord(gy, h, align_corners)
@@ -877,7 +917,7 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                         jnp.round(xx2).astype(jnp.int32)]
         return _bilerp(feat, yy2, xx2)
 
-    return jax.vmap(sample_one)(x, gy, gx)
+    return jax.vmap(sample_one)(x, gy, gx).astype(x.dtype)
 
 
 def _bilerp(feat, y, x):

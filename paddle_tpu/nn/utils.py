@@ -25,6 +25,7 @@ def _norm_except_dim(v, dim):
     dim=None → full-tensor norm (scalar g)."""
     if dim is None:
         return jnp.sqrt(jnp.sum(jnp.square(v)))
+    dim = dim % v.ndim
     axes = tuple(i for i in range(v.ndim) if i != dim)
     return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
 

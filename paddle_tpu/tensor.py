@@ -383,3 +383,35 @@ def logcumsumexp(x, axis=None):
         axis = 0
     m = jnp.max(x, axis=axis, keepdims=True)
     return m + jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis))
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(_v(x), _v(y), axes=axes)
+
+
+def renorm(x, p, axis, max_norm):
+    """Parity: paddle.renorm — rescale each sub-tensor along ``axis`` so
+    its p-norm is at most max_norm."""
+    x = _v(x)
+    axis = axis % x.ndim
+    other = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=other, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+def scatter_nd(index, updates, shape):
+    """Parity: paddle.scatter_nd — zeros of ``shape`` with ``updates``
+    scatter-ADDED at ``index`` (duplicates accumulate)."""
+    index = _v(index)
+    updates = _v(updates)
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    idx_tuple = tuple(jnp.moveaxis(index, -1, 0))
+    return out.at[idx_tuple].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    x = _v(x)
+    index = _v(index)
+    idx_tuple = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx_tuple].add(_v(updates))

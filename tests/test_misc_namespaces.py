@@ -449,3 +449,36 @@ class TestInitializers:
         np.testing.assert_allclose(w[0, 1], w[0, 0], atol=1e-6)
         np.testing.assert_allclose(w[1, 0], w[0, 0], atol=1e-6)
         np.testing.assert_allclose(w[0, 0], w[0, 0].T, atol=1e-6)
+
+
+class TestTensorOpsRound3:
+    def test_tensordot(self):
+        import torch
+
+        a = np.random.default_rng(0).normal(size=(3, 4, 5))
+        b = np.random.default_rng(1).normal(size=(4, 5, 6))
+        ours = np.asarray(pt.tensor.tensordot(jnp.asarray(a),
+                                              jnp.asarray(b), axes=2))
+        ref = torch.tensordot(torch.tensor(a), torch.tensor(b),
+                              dims=2).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_renorm(self):
+        import torch
+
+        x = np.random.default_rng(2).normal(size=(4, 5)).astype(
+            np.float32) * 3
+        ours = np.asarray(pt.tensor.renorm(jnp.asarray(x), 2.0, 0, 1.0))
+        ref = torch.renorm(torch.tensor(x), 2, 0, 1.0).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+        norms = np.linalg.norm(ours, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_scatter_nd(self):
+        idx = jnp.asarray([[1], [2], [1]])
+        upd = jnp.asarray([9.0, 10.0, 11.0])
+        out = np.asarray(pt.tensor.scatter_nd(idx, upd, [4]))
+        np.testing.assert_allclose(out, [0.0, 20.0, 10.0, 0.0])
+        x = jnp.ones((4,))
+        out2 = np.asarray(pt.tensor.scatter_nd_add(x, idx, upd))
+        np.testing.assert_allclose(out2, [1.0, 21.0, 11.0, 1.0])

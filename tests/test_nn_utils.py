@@ -205,3 +205,73 @@ class TestDtypePreservation:
             F.grid_sample(x, g, mode="biliner")
         with pytest.raises(ValueError):
             F.grid_sample(x, g, padding_mode="reflect")
+
+
+class TestInterpolate3D5D:
+    def test_linear_1d_vs_torch(self):
+        x = np.random.default_rng(3).normal(size=(2, 3, 11)).astype(
+            np.float32)
+        for ac in (False, True):
+            ours = np.asarray(F.interpolate(
+                jnp.asarray(x), size=7, mode="linear", align_corners=ac))
+            ref = torch.nn.functional.interpolate(
+                torch.tensor(x), size=7, mode="linear",
+                align_corners=ac).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+        ours_n = np.asarray(F.interpolate(jnp.asarray(x), size=7,
+                                          mode="nearest"))
+        ref_n = torch.nn.functional.interpolate(
+            torch.tensor(x), size=7, mode="nearest").numpy()
+        np.testing.assert_allclose(ours_n, ref_n)
+
+    def test_trilinear_vs_torch(self):
+        x = np.random.default_rng(4).normal(size=(1, 2, 5, 6, 7)).astype(
+            np.float32)
+        for ac in (False, True):
+            ours = np.asarray(F.interpolate(
+                jnp.asarray(x), size=(8, 4, 9), mode="trilinear",
+                align_corners=ac))
+            ref = torch.nn.functional.interpolate(
+                torch.tensor(x), size=(8, 4, 9), mode="trilinear",
+                align_corners=ac).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+        ours_n = np.asarray(F.interpolate(jnp.asarray(x), size=(8, 4, 9),
+                                          mode="nearest"))
+        ref_n = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(8, 4, 9), mode="nearest").numpy()
+        np.testing.assert_allclose(ours_n, ref_n)
+
+
+class TestReviewRound3Fixes:
+    def test_summary_list_input_size(self):
+        net = nn.Sequential(nn.Linear(8, 4))
+        info = pt.summary(net, [2, 8])     # paddle's canonical LIST form
+        assert info["total_params"] == 8 * 4 + 4
+        assert pt.flops(net, [2, 8]) == 2 * 2 * (8 * 4 + 4)
+
+    def test_renorm_negative_axis(self):
+        x = np.random.default_rng(0).normal(size=(4, 5)).astype(
+            np.float32) * 3
+        ours = np.asarray(pt.renorm(jnp.asarray(x), 2.0, -1, 1.0))
+        ref = torch.renorm(torch.tensor(x), 2, -1, 1.0).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_weight_norm_negative_dim(self):
+        lin = nn.Linear(6, 4)
+        nn.utils.weight_norm(lin, dim=-1)
+        assert lin.weight_g.shape == (1, 4)   # per-column norms kept
+
+    def test_grid_sample_keeps_bf16(self):
+        x = jnp.ones((1, 2, 4, 4), jnp.bfloat16)
+        g = jnp.zeros((1, 2, 2, 2))
+        assert F.grid_sample(x, g).dtype == jnp.bfloat16
+
+    def test_align_mode_1(self):
+        """paddle align_mode=1 (asymmetric): src = i*in/out."""
+        x = jnp.asarray(np.arange(4, dtype=np.float32)[None, None])
+        out = np.asarray(F.interpolate(x, size=8, mode="linear",
+                                       align_mode=1))
+        # src = i*0.5, clamped at the last sample → halves of the ramp
+        # with the final position clipped to x[-1] (paddle boundary rule)
+        expect = np.minimum(np.arange(8) * 0.5, 3.0)
+        np.testing.assert_allclose(out[0, 0], expect, atol=1e-6)

@@ -147,6 +147,35 @@ OPS = [
      F.cosine_similarity,
      lambda: [_rand((4, 8), 48), _rand((4, 8), 49)], (0, 1)),
     ("glu", F.glu, lambda: [_rand((4, 16), 50)], (0,)),
+    # --- round-3 additions: CTC, resampling, signal ---
+    ("ctc_loss",
+     lambda lg: F.ctc_loss(
+         lg, jnp.asarray([[1, 2, 1], [2, 2, 1]]),
+         jnp.asarray([8, 7]), jnp.asarray([3, 2]), reduction="sum"),
+     lambda: [_rand((8, 2, 4), 80)], (0,)),
+    ("interpolate_bilinear",
+     lambda x: F.interpolate(x, size=(7, 5), mode="bilinear"),
+     lambda: [_rand((2, 3, 4, 6), 81)], (0,)),
+    ("interpolate_bicubic",
+     lambda x: F.interpolate(x, size=(9, 5), mode="bicubic"),
+     lambda: [_rand((2, 2, 5, 7), 82)], (0,)),
+    ("grid_sample",
+     lambda x, g: F.grid_sample(x, g, padding_mode="border"),
+     # grad w.r.t. grid is piecewise (kinks at cell crossings): place
+     # sampling points mid-cell (pix = k + 0.5 → frac 0.5) so the
+     # central difference stays inside one cell
+     lambda: [_rand((1, 2, 6, 6), 83),
+              ((np.arange(1, 5)[None, :, None, None] + 0.5
+                + 0.1 * _rand((1, 4, 4, 2), 84))
+               / 2.5 - 1.0).astype(np.float64)],
+     (0, 1)),
+    ("stft_power",
+     lambda x: jnp.sum(jnp.abs(__import__(
+         "paddle_tpu.signal", fromlist=["stft"]).stft(x, 16, 8)) ** 2),
+     lambda: [_rand((2, 64), 85)], (0,)),
+    ("adaptive_avg_pool_nondiv",
+     lambda x: F.adaptive_avg_pool2d(x, (3, 4)),
+     lambda: [_rand((2, 2, 7, 9), 86)], (0,)),
 ]
 
 
