@@ -1,0 +1,22 @@
+"""Shared example bootstrap: run on an 8-device virtual CPU mesh so every
+example works on any machine (swap for real TPU devices in production —
+nothing else changes)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # drop the sandbox's remote-TPU plugin if present
+    from jax._src import xla_bridge as _xb
+
+    for _reg in ("_backend_factories", "backend_factories"):
+        _d = getattr(_xb, _reg, None)
+        if isinstance(_d, dict):
+            _d.pop("axon", None)
+except Exception:
+    pass

@@ -12,6 +12,8 @@ and the host-side runtime (trainer, data, checkpoint, launch, profiler).
 
 from . import amp  # noqa: F401
 from . import audio  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import generation  # noqa: F401
@@ -23,6 +25,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import signal  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi.summary import flops, summary  # noqa: F401
 from . import sparse  # noqa: F401
 from . import vision  # noqa: F401
@@ -72,3 +75,22 @@ def no_grad(fn=None):
     if fn is None:
         return contextlib.nullcontext()
     return fn
+
+
+def iinfo(dtype):
+    import jax.numpy as _jnp
+    import numpy as _np
+
+    return _np.iinfo(_jnp.dtype(dtype))
+
+
+def finfo(dtype):
+    import jax.numpy as _jnp
+    import numpy as _np
+
+    d = _jnp.dtype(dtype)
+    if d == _jnp.bfloat16:
+        import ml_dtypes
+
+        return ml_dtypes.finfo(ml_dtypes.bfloat16)
+    return _np.finfo(d)

@@ -97,3 +97,5 @@ class PyLayer:
     @staticmethod
     def backward(ctx, *grads):
         raise NotImplementedError
+
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401,E402

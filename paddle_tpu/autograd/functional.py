@@ -1,0 +1,62 @@
+"""Functional autograd transforms (parity: paddle.incubate.autograd /
+paddle.autograd — Jacobian, Hessian, jvp, vjp; upstream:
+python/paddle/incubate/autograd/functional.py).
+
+On TPU these ARE jax's program transforms — the value added here is the
+paddle calling convention (tuple-of-tensors xs, optional cotangents v)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _as_tuple(xs):
+    return xs if isinstance(xs, (tuple, list)) else (xs,)
+
+
+def _maybe_unpack(out, was_single):
+    return out[0] if was_single and isinstance(out, (tuple, list)) \
+        else out
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """∂func(xs)/∂xs. xs: tensor or tuple. Returns jax-style nested
+    jacobian (tuple over inputs when xs is a tuple)."""
+    single = not isinstance(xs, (tuple, list))
+    xs_t = tuple(jnp.asarray(x) for x in _as_tuple(xs))
+    argnums = 0 if single else tuple(range(len(xs_t)))
+    return jax.jacobian(lambda *a: func(*a), argnums=argnums)(*xs_t)
+
+
+def hessian(func, xs, create_graph=False):
+    """Hessian of a scalar-valued func."""
+    single = not isinstance(xs, (tuple, list))
+    xs_t = tuple(jnp.asarray(x) for x in _as_tuple(xs))
+    argnums = 0 if single else tuple(range(len(xs_t)))
+    return jax.hessian(lambda *a: func(*a), argnums=argnums)(*xs_t)
+
+
+def vjp(func, xs, v=None):
+    """Returns (func(xs), vjp result). ``v``: cotangent(s) matching the
+    output structure; defaults to ones (paddle convention)."""
+    single = not isinstance(xs, (tuple, list))
+    xs_t = tuple(jnp.asarray(x) for x in _as_tuple(xs))
+    out, pullback = jax.vjp(lambda *a: func(*a), *xs_t)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    grads = pullback(v)
+    return out, _maybe_unpack(grads, single)
+
+
+def jvp(func, xs, v=None):
+    """Returns (func(xs), jvp result). ``v``: tangent(s) matching xs;
+    defaults to ones."""
+    single = not isinstance(xs, (tuple, list))
+    xs_t = tuple(jnp.asarray(x) for x in _as_tuple(xs))
+    if v is None:
+        v_t = tuple(jnp.ones_like(x) for x in xs_t)
+    else:
+        v_t = tuple(jnp.asarray(t) for t in _as_tuple(v))
+    out, tangent = jax.jvp(lambda *a: func(*a), xs_t, v_t)
+    return out, tangent

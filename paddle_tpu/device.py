@@ -1,0 +1,83 @@
+"""paddle_tpu.device (parity: paddle.device — set_device/get_device and
+the synchronization/stream surface; python/paddle/device/__init__.py).
+
+Device identity on TPU is owned by PJRT; "streams" are XLA's async
+dispatch queue, so ``synchronize`` maps to blocking on all live arrays
+(the effective barrier jax exposes)."""
+
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def set_device(device: str):
+    """Parity: paddle.device.set_device('gpu:0'|'cpu'|...). Maps device
+    kinds onto the jax default-device mechanism."""
+    global _current
+    plat = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    alias = {"gpu": "tpu", "xpu": "tpu", "npu": "tpu"}.get(plat, plat)
+    try:
+        # query the named backend directly — jax.devices() alone only
+        # lists the default backend, which would silently misroute e.g.
+        # set_device("cpu") on a TPU host
+        matches = list(jax.devices(alias))
+    except RuntimeError as e:
+        raise ValueError(
+            f"set_device: no {device!r} backend available") from e
+    jax.config.update("jax_default_device", matches[min(idx,
+                                                       len(matches) - 1)])
+    _current = device
+    return device
+
+
+def get_device():
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    name = {"tpu": "gpu"}.get(d.platform, d.platform)  # paddle alias
+    return f"{name}:{d.id}"
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes on EVERY device
+    (parity: paddle.device.synchronize / cuda.synchronize)."""
+    for d in jax.devices():
+        (jax.device_put(0.0, d) + 0).block_until_ready()
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(name: str = "tpu"):
+    return any(d.platform == name for d in jax.devices())
+
+
+class Stream:
+    """Parity shim: XLA owns scheduling; stream objects are inert
+    markers (documented N/A — one async queue per device)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)

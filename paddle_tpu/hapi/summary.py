@@ -18,6 +18,16 @@ import numpy as np
 from ..core.module import Layer
 
 
+def _norm_sizes(sz):
+    """Accept one shape (tuple OR list of ints) or a list of shapes."""
+    if sz is None:
+        return None
+    if isinstance(sz, (tuple, list)) and sz and all(
+            isinstance(i, int) for i in sz):
+        return [tuple(sz)]
+    return [tuple(s) for s in sz]
+
+
 def _shapes_of(out):
     if hasattr(out, "shape"):
         return [tuple(out.shape)]
@@ -71,14 +81,6 @@ def _collect(net: Layer, input_spec, dtypes, kwargs):
 def summary(net: Layer, input_size=None, dtypes=None, input=None, **kwargs):  # noqa: A002
     """Parity: paddle.summary — prints the layer table, returns
     {'total_params', 'trainable_params'}."""
-    def _norm_sizes(sz):
-        if sz is None:
-            return None
-        if isinstance(sz, (tuple, list)) and sz and all(
-                isinstance(i, int) for i in sz):
-            return [tuple(sz)]          # single shape, tuple OR list
-        return [tuple(s) for s in sz]
-
     if input is not None:
         specs = [tuple(np.asarray(x).shape) for x in (
             input if isinstance(input, (tuple, list)) else [input])]
@@ -150,13 +152,11 @@ def flops(net: Layer, input_size, dtypes=None, print_detail=False,
     """Parity: paddle.flops — MAC-based FLOPs estimate from one abstract
     trace (matmul-bearing leaves; normalizations/activations are counted
     as 0, matching the reference's dominant-term accounting)."""
-    if isinstance(input_size, (tuple, list)) and input_size and all(
-            isinstance(i, int) for i in input_size):
-        input_size = [tuple(input_size)]
+    input_size = _norm_sizes(input_size)
     dts = dtypes or [jnp.float32] * len(input_size)
     if not isinstance(dts, (list, tuple)):
         dts = [dts] * len(input_size)
-    records = _collect(net, [tuple(s) for s in input_size], dts, kwargs)
+    records = _collect(net, input_size, dts, kwargs)
     total = 0
     for r in records:
         rule = _FLOP_RULES.get(r["type"])

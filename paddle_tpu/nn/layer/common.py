@@ -338,26 +338,31 @@ class Upsample(Layer):
     """Parity: paddle.nn.Upsample over F.interpolate."""
 
     def __init__(self, size=None, scale_factor=None, mode="nearest",
-                 align_corners=False, data_format="NCHW"):
+                 align_corners=False, align_mode=0, data_format="NCHW"):
         super().__init__()
         self.size = size
         self.scale_factor = scale_factor
         self.mode = mode
         self.align_corners = align_corners
+        self.align_mode = align_mode
         self.data_format = data_format
 
     def forward(self, x):
         from .. import functional as F
 
         return F.interpolate(x, self.size, self.scale_factor, self.mode,
-                             self.align_corners, self.data_format)
+                             align_corners=self.align_corners,
+                             align_mode=self.align_mode,
+                             data_format=self.data_format)
 
 
 class UpsamplingNearest2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "nearest", False, data_format)
+        super().__init__(size, scale_factor, "nearest",
+                         data_format=data_format)
 
 
 class UpsamplingBilinear2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "bilinear", True, data_format)
+        super().__init__(size, scale_factor, "bilinear",
+                         align_corners=True, data_format=data_format)

@@ -1,0 +1,6 @@
+"""paddle_tpu.utils (parity: paddle.utils — dlpack interop; the
+cpp_extension/install-check machinery is N/A in this build)."""
+
+from . import dlpack  # noqa: F401
+
+__all__ = ["dlpack"]

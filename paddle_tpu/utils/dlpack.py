@@ -1,0 +1,21 @@
+"""DLPack interop (parity: paddle.utils.dlpack — zero-copy exchange with
+torch/numpy/cupy via the standard __dlpack__ protocol)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def to_dlpack(x):
+    """Export a framework tensor as a DLPack capsule."""
+    from ..core.parameter import Parameter
+
+    if isinstance(x, Parameter):
+        x = x.value
+    return x.__dlpack__()
+
+
+def from_dlpack(capsule_or_tensor):
+    """Import from a DLPack capsule OR any object implementing
+    ``__dlpack__`` (torch/cupy/numpy arrays directly)."""
+    return jnp.from_dlpack(capsule_or_tensor)
