@@ -31,8 +31,11 @@ def set_device(device: str):
     except RuntimeError as e:
         raise ValueError(
             f"set_device: no {device!r} backend available") from e
-    jax.config.update("jax_default_device", matches[min(idx,
-                                                       len(matches) - 1)])
+    if not 0 <= idx < len(matches):
+        raise ValueError(
+            f"set_device: index {idx} out of range for "
+            f"{len(matches)} {alias} device(s)")
+    jax.config.update("jax_default_device", matches[idx])
     _current = device
     return device
 

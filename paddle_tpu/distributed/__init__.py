@@ -21,10 +21,12 @@ from .auto_parallel import (  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp,
     all_gather,
+    all_gather_object,
     all_reduce,
     alltoall,
     barrier,
     broadcast,
+    broadcast_object_list,
     reduce_scatter,
 )
 from . import checkpoint  # noqa: F401

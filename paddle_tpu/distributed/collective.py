@@ -212,3 +212,57 @@ def barrier(group=None):
     x = jnp.ones((hcg.mesh.devices.size,), jnp.int32)
     all_reduce(x, mesh=hcg.mesh, group="dp") if "dp" in hcg.mesh.axis_names \
         else None
+
+
+# ---------------------------------------------------------------------------
+# object collectives (parity: paddle.distributed.all_gather_object /
+# broadcast_object_list — pickled python objects over the coordination
+# service rather than NCCL byte tensors)
+# ---------------------------------------------------------------------------
+def _object_via_host(obj, tag: str):
+    """Share pickled objects through jax's multihost broadcast (the
+    TPU-world TCPStore): every process contributes, all receive the
+    list ordered by process index."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    # fixed-size frame: length-prefix + padded body, gathered as one
+    # host-value broadcast per process
+    max_len = int(multihost_utils.process_allgather(
+        jnp.asarray([payload.size]))[..., 0].max())
+    frame = np.zeros((max_len + 8,), np.uint8)
+    frame[:8] = np.frombuffer(
+        np.asarray([payload.size], np.int64).tobytes(), np.uint8)
+    frame[8:8 + payload.size] = payload
+    gathered = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(frame)))
+    out = []
+    for row in gathered.reshape(jax.process_count(), -1):
+        n = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
+        out.append(pickle.loads(row[8:8 + n].tobytes()))
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Parity: paddle.distributed.all_gather_object — appends every
+    rank's ``obj`` (any picklable) into ``object_list``."""
+    object_list.extend(_object_via_host(obj, "all_gather_object"))
+    return object_list
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    """Parity: paddle.distributed.broadcast_object_list — replaces the
+    list contents with rank ``src``'s."""
+    gathered = _object_via_host(list(object_list), "broadcast_object")
+    if not 0 <= src < len(gathered):
+        raise ValueError(
+            f"broadcast_object_list: src {src} out of range for "
+            f"{len(gathered)} process(es)")
+    object_list[:] = gathered[src]
+    return object_list

@@ -186,42 +186,18 @@ class Flatten(Layer):
         self.stop_axis = stop_axis
 
     def forward(self, x):
+        import math
+
         shape = x.shape
         stop = self.stop_axis if self.stop_axis >= 0 else len(shape) + self.stop_axis
+        # host arithmetic on the STATIC dims — a jnp.prod here would
+        # trace to a device op and break int() under jit
         new_shape = (
             shape[: self.start_axis]
-            + (int(jnp.prod(jnp.array(shape[self.start_axis : stop + 1]))),)
-            + shape[stop + 1 :]
+            + (math.prod(shape[self.start_axis: stop + 1]),)
+            + shape[stop + 1:]
         )
         return x.reshape(new_shape)
-
-
-class Upsample(Layer):
-    def __init__(self, size=None, scale_factor=None, mode="nearest", data_format="NCHW"):
-        super().__init__()
-        self.size = size
-        self.scale_factor = scale_factor
-        self.mode = mode
-        self.data_format = data_format
-
-    def forward(self, x):
-        import jax.image
-
-        if self.data_format == "NCHW":
-            n, c, h, w = x.shape
-            if self.size is not None:
-                oh, ow = self.size
-            else:
-                oh, ow = int(h * self.scale_factor), int(w * self.scale_factor)
-            method = {"nearest": "nearest", "bilinear": "linear"}[self.mode]
-            return jax.image.resize(x, (n, c, oh, ow), method=method)
-        n, h, w, c = x.shape
-        if self.size is not None:
-            oh, ow = self.size
-        else:
-            oh, ow = int(h * self.scale_factor), int(w * self.scale_factor)
-        method = {"nearest": "nearest", "bilinear": "linear"}[self.mode]
-        return jax.image.resize(x, (n, oh, ow, c), method=method)
 
 
 class Bilinear(Layer):

@@ -273,3 +273,159 @@ class Transpose(BaseTransform):
 
     def __call__(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+# ---------------------------------------------------------------------------
+# geometric transforms over grid_sample (round 3: rotate/affine/perspective)
+# ---------------------------------------------------------------------------
+def _apply_inverse_matrix(img, inv3x3, interpolation="bilinear", fill=0.0):
+    """Warp CHW/NCHW image by the INVERSE 3x3 pixel-coordinate matrix via
+    one grid_sample call (zeros padding ≈ constant fill 0)."""
+    import jax.numpy as jnp
+
+    from ..nn.functional import grid_sample
+
+    single = img.ndim == 3
+    x = jnp.asarray(img)[None] if single else jnp.asarray(img)
+    n, c, h, w = x.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    ones = jnp.ones_like(xs)
+    tgt = jnp.stack([xs, ys, ones], 0).reshape(3, -1)     # [3, H*W]
+    src = jnp.asarray(inv3x3, jnp.float32) @ tgt           # [3, H*W]
+    sx = src[0] / jnp.maximum(jnp.abs(src[2]), 1e-9) * jnp.sign(src[2])
+    sy = src[1] / jnp.maximum(jnp.abs(src[2]), 1e-9) * jnp.sign(src[2])
+    # pixel coords → normalized [-1, 1] (align_corners=False convention)
+    gx = (2.0 * sx + 1.0) / w - 1.0
+    gy = (2.0 * sy + 1.0) / h - 1.0
+    grid = jnp.stack([gx, gy], -1).reshape(1, h, w, 2)
+    grid = jnp.broadcast_to(grid, (n, h, w, 2))
+    out = grid_sample(x, grid, mode=interpolation,
+                      padding_mode="zeros", align_corners=False)
+    if fill:
+        # zeros padding filled the outside with 0; shift to `fill`
+        mask = grid_sample(jnp.ones_like(x[:, :1]), grid,
+                           mode=interpolation, padding_mode="zeros",
+                           align_corners=False)
+        out = out + (1.0 - mask) * fill
+    return out[0] if single else out
+
+
+def _affine_pixel_matrix(angle, translate, scale, shear, center):
+    """Forward 2x3 affine in pixel coords (paddle/torchvision
+    convention: rotate about center, then shear/scale/translate)."""
+    import math
+
+    cx, cy = center
+    # positive angle = counter-clockwise in display coords (y down), the
+    # paddle/torchvision convention
+    rot = math.radians(-angle)
+    sx, sy = [math.radians(s) for s in shear]
+    # RSS = rotate ∘ shear ∘ scale (torchvision _get_inverse_affine_matrix)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = [[scale * a, scale * b, 0.0], [scale * c, scale * d, 0.0]]
+    tx, ty = translate
+    m[0][2] = cx + tx - m[0][0] * cx - m[0][1] * cy
+    m[1][2] = cy + ty - m[1][0] * cx - m[1][1] * cy
+    return m
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0.0, center=None):
+    """Parity: paddle.vision.transforms.functional.affine (CHW tensors)."""
+    import numpy as np
+
+    h, w = img.shape[-2:]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if not isinstance(shear, (tuple, list)):
+        shear = (shear, 0.0)
+    m = np.vstack([_affine_pixel_matrix(angle, translate, scale, shear,
+                                        center), [0.0, 0.0, 1.0]])
+    return _apply_inverse_matrix(img, np.linalg.inv(m), interpolation,
+                                 fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, fill=0.0,
+           center=None):
+    """Parity: paddle.vision.transforms.functional.rotate (expand=False)."""
+    if expand:
+        raise NotImplementedError("rotate(expand=True) not supported")
+    return affine(img, angle=angle, interpolation=interpolation,
+                  fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0.0):
+    """Parity: paddle.vision.transforms.functional.perspective — warp so
+    ``startpoints`` (4 [x, y] corners) map onto ``endpoints``."""
+    import numpy as np
+
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec += [ex, ey]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(bvec, np.float64))
+    m = np.append(coeffs, 1.0).reshape(3, 3)
+    return _apply_inverse_matrix(img, np.linalg.inv(m), interpolation,
+                                 fill)
+
+
+def _symmetric_range(value):
+    """scalar d → (-d, d); sequence → tuple(value)."""
+    import numpy as np
+
+    return (-value, value) if np.isscalar(value) else tuple(value)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", fill=0.0,
+                 center=None, seed=None):
+        import numpy as np
+
+        self.degrees = _symmetric_range(degrees)
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, img):
+        ang = float(self._rng.uniform(*self.degrees))
+        return rotate(img, ang, self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0.0, seed=None):
+        import numpy as np
+
+        self.degrees = _symmetric_range(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = None if shear is None else _symmetric_range(shear)
+        self.interpolation = interpolation
+        self.fill = fill
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, img):
+        h, w = img.shape[-2:]
+        ang = float(self._rng.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = float(self._rng.uniform(-self.translate[0],
+                                         self.translate[0]) * w)
+            ty = float(self._rng.uniform(-self.translate[1],
+                                         self.translate[1]) * h)
+        sc = 1.0 if self.scale is None else float(
+            self._rng.uniform(*self.scale))
+        sh = (0.0, 0.0) if self.shear is None else (
+            float(self._rng.uniform(*self.shear)), 0.0)
+        return affine(img, ang, (tx, ty), sc, sh, self.interpolation,
+                      self.fill)

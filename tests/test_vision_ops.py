@@ -278,3 +278,59 @@ class TestPSRoIPool:
         g = jax.grad(lambda f: jnp.sum(
             ops.psroi_pool(f, boxes, [1], 2) ** 2))(x)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestGeometricTransforms:
+    def test_rotate_90_exact(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.asarray(np.arange(16, dtype=np.float32)
+                          .reshape(1, 4, 4))
+        out = np.asarray(T.rotate(img, 90.0, interpolation="nearest"))
+        # positive angle = counter-clockwise in display coords (y down)
+        # == np.rot90(k=1) on the array
+        assert out.shape == (1, 4, 4)
+        np.testing.assert_allclose(
+            out[0], np.rot90(np.asarray(img)[0], 1), atol=1e-4)
+
+    def test_identity_affine(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.asarray(np.random.default_rng(0).normal(
+            size=(3, 8, 8)).astype(np.float32))
+        out = np.asarray(T.affine(img))
+        np.testing.assert_allclose(out, np.asarray(img), atol=1e-4)
+
+    def test_translate_shifts(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.zeros((1, 6, 6)).at[0, 2, 2].set(1.0)
+        out = np.asarray(T.affine(img, translate=(1, 0),
+                                  interpolation="nearest"))
+        assert out[0, 2, 3] == 1.0 and out[0, 2, 2] == 0.0
+
+    def test_perspective_identity_and_roundtrip(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.asarray(np.random.default_rng(1).normal(
+            size=(1, 8, 8)).astype(np.float32))
+        pts = [[0, 0], [7, 0], [7, 7], [0, 7]]
+        out = np.asarray(T.perspective(img, pts, pts))
+        np.testing.assert_allclose(out, np.asarray(img), atol=1e-4)
+
+    def test_random_transforms_run(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.ones((3, 8, 8))
+        r1 = T.RandomRotation(30.0, seed=0)(img)
+        r2 = T.RandomAffine(15.0, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                            seed=0)(img)
+        assert r1.shape == r2.shape == (3, 8, 8)
+        assert np.isfinite(np.asarray(r1)).all()
+
+    def test_random_affine_tuple_shear(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = jnp.ones((1, 8, 8))
+        out = T.RandomAffine(10.0, shear=(-5.0, 5.0), seed=0)(img)
+        assert out.shape == (1, 8, 8)
