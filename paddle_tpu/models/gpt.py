@@ -7,6 +7,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core import initializer as I
@@ -65,13 +66,39 @@ class GPTAttention(Layer):
         self.out_proj = RowParallelLinear(h, h, weight_attr=init)
         self.dropout = Dropout(config.attention_probs_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_index=None):
         cfg = self.config
         b, s, _ = x.shape
         qkv = self.qkv_proj(x).reshape(
             b, s, 3, cfg.num_attention_heads, cfg.head_dim
         )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_cache is not None:
+            # AOT-Predictor cache protocol: prefill writes the prompt
+            # K/V at [0:s] (cache_index 0), a single-token step writes
+            # at scalar cache_index and attends over the masked cache.
+            # (llama.py additionally implements the per-slot vector
+            # index + chunked forms the continuous-batching engine uses)
+            ck, cv = kv_cache
+            k = k.astype(ck.dtype)
+            v = v.astype(cv.dtype)
+            if s == 1:
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    ck, k, cache_index, 1)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cv, v, cache_index, 1)
+                live = jnp.arange(ck.shape[1]) <= cache_index
+                bias = jnp.where(live, 0.0, -1e30)[None, None, None, :]
+                out = F.scaled_dot_product_attention(
+                    q, ck, cv, attn_mask=bias, training=False)
+                return (self.out_proj(out.reshape(b, 1, cfg.hidden_size)),
+                        (ck, cv))
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, 0, 1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, 0, 1)
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=False)
+            return (self.out_proj(out.reshape(b, s, cfg.hidden_size)),
+                    (ck, cv))
         if cfg.use_flash_attention and not (
             self.training and cfg.attention_probs_dropout_prob > 0
         ):
@@ -101,7 +128,13 @@ class GPTBlock(Layer):
         )
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_index=None):
+        if kv_cache is not None:
+            a, kv_cache = self.attn(self.ln_1(x), kv_cache, cache_index)
+            x = x + a
+            h = self.fc_out(
+                F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+            return x + h, kv_cache
         x = x + self.dropout(self.attn(self.ln_1(x)))
         h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
         return x + self.dropout(h)
@@ -125,12 +158,19 @@ class GPTModel(Layer):
         )
         self.ln_f = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, kv_caches=None,
+                cache_index=None):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = jnp.arange(s)[None, :]
         x = self.embeddings(input_ids) + self.position_embeddings(position_ids)
         x = shard_activation(x, ("dp", "fsdp"), "sep", None)
+        if kv_caches is not None:
+            new_caches = []
+            for block, cache in zip(self.h, kv_caches):
+                x, cache = block(x, cache, cache_index)
+                new_caches.append(cache)
+            return self.ln_f(x), new_caches
         x = self.drop(x)
         for block in self.h:
             x = block(x)
@@ -148,7 +188,12 @@ class GPTForCausalLM(Layer):
             has_bias=False,
         )
 
-    def forward(self, input_ids, labels=None, position_ids=None):
+    def forward(self, input_ids, labels=None, position_ids=None,
+                kv_caches=None, cache_index=None):
+        if kv_caches is not None:
+            hidden, caches = self.gpt(input_ids, position_ids,
+                                      kv_caches, cache_index)
+            return self.lm_head(hidden), caches
         hidden = self.gpt(input_ids, position_ids)
         logits = self.lm_head(hidden)
         if labels is None:
@@ -156,3 +201,16 @@ class GPTForCausalLM(Layer):
         return F.cross_entropy(
             logits[:, :-1, :], labels[:, 1:], ignore_index=-100
         )
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        dtype = dtype or jnp.bfloat16
+        return [
+            (
+                jnp.zeros((batch_size, max_len, cfg.num_attention_heads,
+                           cfg.head_dim), dtype),
+                jnp.zeros((batch_size, max_len, cfg.num_attention_heads,
+                           cfg.head_dim), dtype),
+            )
+            for _ in range(cfg.num_hidden_layers)
+        ]
