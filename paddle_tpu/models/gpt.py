@@ -79,24 +79,26 @@ class GPTAttention(Layer):
             # at scalar cache_index and attends over the masked cache.
             # (llama.py additionally implements the per-slot vector
             # index + chunked forms the continuous-batching engine uses)
+            if getattr(cache_index, "ndim", 0) == 1:
+                raise ValueError(
+                    "GPT decode cache supports scalar cache_index only "
+                    "(the continuous-batching engine's per-slot vector "
+                    "form is implemented for Llama)")
             ck, cv = kv_cache
             k = k.astype(ck.dtype)
             v = v.astype(cv.dtype)
-            if s == 1:
-                ck = jax.lax.dynamic_update_slice_in_dim(
-                    ck, k, cache_index, 1)
-                cv = jax.lax.dynamic_update_slice_in_dim(
-                    cv, v, cache_index, 1)
-                live = jnp.arange(ck.shape[1]) <= cache_index
-                bias = jnp.where(live, 0.0, -1e30)[None, None, None, :]
-                out = F.scaled_dot_product_attention(
-                    q, ck, cv, attn_mask=bias, training=False)
-                return (self.out_proj(out.reshape(b, 1, cfg.hidden_size)),
-                        (ck, cv))
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, 0, 1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, 0, 1)
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, k, cache_index, 1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, v, cache_index, 1)
+            # chunked form: query i sits at absolute position
+            # cache_index + i and may attend to kv_idx <= that
+            q_pos = cache_index + jnp.arange(s)              # [s]
+            live = (jnp.arange(ck.shape[1])[None, :]
+                    <= q_pos[:, None])                       # [s, L]
+            bias = jnp.where(live, 0.0, -1e30)[None, None, :, :]
             out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, training=False)
+                q, ck, cv, attn_mask=bias, training=False)
             return (self.out_proj(out.reshape(b, s, cfg.hidden_size)),
                     (ck, cv))
         if cfg.use_flash_attention and not (

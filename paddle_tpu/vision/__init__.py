@@ -11,7 +11,20 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import (  # noqa: F401
+    AlexNet,
+    DenseNet,
     ResNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    VGG,
+    alexnet,
+    densenet121,
+    shufflenet_v2_x1_0,
+    squeezenet1_1,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
     mobilenet_v2,
     resnet18,
     resnet34,

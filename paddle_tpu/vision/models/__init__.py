@@ -9,3 +9,19 @@ from .resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+
+from .classic import (  # noqa: F401,E402
+    VGG,
+    AlexNet,
+    DenseNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    alexnet,
+    densenet121,
+    shufflenet_v2_x1_0,
+    squeezenet1_1,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)

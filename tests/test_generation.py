@@ -249,3 +249,35 @@ class TestGPTPredictor:
         pred = Predictor(GPTForCausalLM(cfg), c)
         out = pred.generate(np.arange(1, 7)[None], max_new_tokens=3)
         assert out.shape == (1, 3)
+
+    def test_chunked_prefill_and_vector_guard(self, gpt_pred):
+        """s>1 prefill at cache_index>0 (chunked) matches one-shot
+        prefill; vector cache_index raises clearly."""
+        import jax
+
+        pred, cfg = gpt_pred
+        model, params = pred.model, pred.params
+        from paddle_tpu.core.functional import functional_call
+
+        ids = np.random.default_rng(3).integers(1, cfg.vocab_size, (1, 8))
+        caches = model.init_kv_caches(1, 16, dtype=jnp.float32)
+        pos = jnp.arange(8)[None]
+        full_logits, _ = functional_call(
+            model, params, jnp.asarray(ids), position_ids=pos,
+            kv_caches=caches, cache_index=0)
+        # two chunks of 4
+        caches2 = model.init_kv_caches(1, 16, dtype=jnp.float32)
+        l1, caches2 = functional_call(
+            model, params, jnp.asarray(ids[:, :4]),
+            position_ids=pos[:, :4], kv_caches=caches2, cache_index=0)
+        l2, caches2 = functional_call(
+            model, params, jnp.asarray(ids[:, 4:]),
+            position_ids=pos[:, 4:], kv_caches=caches2, cache_index=4)
+        np.testing.assert_allclose(
+            np.asarray(l2), np.asarray(full_logits[:, 4:]), rtol=2e-4,
+            atol=2e-4)
+        with pytest.raises(ValueError, match="scalar cache_index"):
+            functional_call(
+                model, params, jnp.asarray(ids[:, :1]),
+                position_ids=pos[:, :1], kv_caches=caches2,
+                cache_index=jnp.asarray([4]))

@@ -181,3 +181,54 @@ class TestModels:
             params, opt_state, loss = step(params, opt_state)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestClassicZoo:
+    """Round-3 zoo widening: forward shapes + one gradient smoke
+    (upstream: test/legacy_test/test_vision_models.py)."""
+
+    @pytest.mark.parametrize("ctor,size", [
+        ("vgg11", 64), ("alexnet", 224), ("squeezenet1_1", 64),
+        ("densenet121", 64), ("shufflenet_v2_x1_0", 64),
+    ])
+    def test_forward_shapes(self, ctor, size):
+        from paddle_tpu.vision import models as M
+
+        pt.seed(0)
+        net = getattr(M, ctor)(num_classes=7)
+        net.eval()
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 3, size, size)).astype(np.float32))
+        out = net(x)
+        assert out.shape == (2, 7)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_shufflenet_trains(self):
+        import jax
+
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.core.functional import (
+            extract_params,
+            functional_call,
+        )
+        from paddle_tpu.vision import models as M
+
+        pt.seed(0)
+        net = M.shufflenet_v2_x1_0(num_classes=4)
+        x = jnp.asarray(np.random.default_rng(1).normal(
+            size=(4, 3, 32, 32)).astype(np.float32))
+        y = jnp.asarray([0, 1, 2, 3])
+        params = extract_params(net, trainable_only=True)
+        o = opt.SGD(learning_rate=0.05, multi_precision=False)
+        st = o.init(params)
+
+        def loss_fn(p):
+            return pt.nn.functional.cross_entropy(
+                functional_call(net, p, x), y)
+
+        l0 = float(loss_fn(params))
+        gv = jax.jit(jax.value_and_grad(loss_fn))
+        for _ in range(8):
+            loss, g = gv(params)
+            params, st = o.update(g, st, params)
+        assert float(loss) < l0
