@@ -84,6 +84,8 @@ class GPTAttention(Layer):
                     "GPT decode cache supports scalar cache_index only "
                     "(the continuous-batching engine's per-slot vector "
                     "form is implemented for Llama)")
+            if cache_index is None:
+                cache_index = 0
             ck, cv = kv_cache
             k = k.astype(ck.dtype)
             v = v.astype(cv.dtype)
@@ -91,6 +93,14 @@ class GPTAttention(Layer):
                 ck, k, cache_index, 1)
             cv = jax.lax.dynamic_update_slice_in_dim(
                 cv, v, cache_index, 1)
+            if s > 1 and isinstance(cache_index, int) and cache_index == 0:
+                # prefill fast path: s×s causal attention over the
+                # prompt only (the full-cache masked form below costs
+                # O(s·L) for an L-slot cache)
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, training=False)
+                return (self.out_proj(
+                    out.reshape(b, s, cfg.hidden_size)), (ck, cv))
             # chunked form: query i sits at absolute position
             # cache_index + i and may attend to kv_idx <= that
             q_pos = cache_index + jnp.arange(s)              # [s]

@@ -415,3 +415,84 @@ def scatter_nd_add(x, index, updates):
     index = _v(index)
     idx_tuple = tuple(jnp.moveaxis(index, -1, 0))
     return x.at[idx_tuple].add(_v(updates))
+
+
+def rand(shape, dtype=None):
+    """Parity: paddle.rand — U[0,1) from the global seed stream
+    (delegates to core.random so dtype strings resolve uniformly)."""
+    from .core import random as _r
+
+    return _r.uniform(tuple(shape), dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None):
+    """Parity: paddle.randn."""
+    from .core import random as _r
+
+    return _r.normal(tuple(shape), dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    """Parity: paddle.randint."""
+    from .core import random as _r
+
+    return _r.randint(low, high, tuple(shape), dtype)
+
+
+def randperm(n, dtype="int64"):
+    """Parity: paddle.randperm."""
+    from .core import random as _r
+
+    return _r.randperm(n, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):  # noqa: A002
+    """Parity: paddle.uniform (note paddle's default range is [-1, 1),
+    unlike rand's [0, 1))."""
+    from .core import random as _r
+
+    return _r.uniform(tuple(shape), dtype, min, max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    """Parity: paddle.normal (mean/std leading, paddle argument order)."""
+    from .core import random as _r
+
+    return _r.normal(tuple(shape), None, mean, std)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    """Parity: paddle.multinomial — rows of ``x`` are (unnormalized)
+    probabilities. Without replacement, asking for more samples than
+    there are nonzero-probability categories raises (paddle semantics)."""
+    from .core.random import next_rng_key
+
+    x = _v(x)
+    if not replacement:
+        try:  # concrete probs: enforce the reference's error contract
+            import numpy as _np
+
+            nonzero = int((_np.asarray(x) > 0).sum(axis=-1).min())
+            if num_samples > nonzero:
+                raise ValueError(
+                    f"multinomial(replacement=False): num_samples "
+                    f"{num_samples} exceeds the {nonzero} nonzero-"
+                    f"probability categories")
+        except ValueError:
+            raise
+        except Exception:
+            pass  # traced input: no host check possible
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        # one vectorized draw: categorical broadcasts over a leading
+        # sample axis
+        out = jax.random.categorical(
+            next_rng_key("default"), logits, axis=-1,
+            shape=(num_samples,) + x.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick: iid gumbel noise + top-k == sampling
+        # without replacement
+        g = jax.random.gumbel(next_rng_key("default"), logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return out if x.ndim > 1 else out.reshape(-1)

@@ -29,6 +29,20 @@ class _ConvBNReLU(Layer):
         return F.relu(self.bn(self.conv(x)))
 
 
+class _MaxPool2x2(Layer):
+    def forward(self, x):
+        return F.max_pool2d(x, 2, 2)
+
+
+class _ConvReLU(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, 3, padding=1)
+
+    def forward(self, x):
+        return F.relu(self.conv(x))
+
+
 # ---------------------------------------------------------------------------
 # VGG
 # ---------------------------------------------------------------------------
@@ -53,16 +67,14 @@ class VGG(Layer):
         cin = 3
         for v in _VGG_CFGS[depth]:
             if v == "M":
-                layers.append(("pool", None))
-            else:
-                if batch_norm:
-                    layers.append(("conv", _ConvBNReLU(cin, v)))
-                else:
-                    layers.append(("conv", Conv2D(cin, v, 3, padding=1)))
+                layers.append(_MaxPool2x2())
+            elif batch_norm:
+                layers.append(_ConvBNReLU(cin, v))
                 cin = v
-        self._plan = [kind for kind, _ in layers]
-        self.features = LayerList(
-            [m for _, m in layers if m is not None])
+            else:
+                layers.append(_ConvReLU(cin, v))
+                cin = v
+        self.features = LayerList(layers)
         self.batch_norm = batch_norm
         self.with_pool = with_pool
         self.classifier = LayerList([
@@ -72,13 +84,8 @@ class VGG(Layer):
         self.dropout = Dropout(0.5)
 
     def forward(self, x):
-        it = iter(self.features)
-        for kind in self._plan:
-            if kind == "pool":
-                x = F.max_pool2d(x, 2, 2)
-            else:
-                m = next(it)
-                x = m(x) if self.batch_norm else F.relu(m(x))
+        for m in self.features:
+            x = m(x)
         if self.with_pool:
             x = F.adaptive_avg_pool2d(x, (7, 7))
         x = x.reshape(x.shape[0], -1)
@@ -225,7 +232,6 @@ class DenseNet(Layer):
         self.stem = Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False)
         self.stem_bn = BatchNorm2D(c)
         blocks = []
-        self._sizes = []
         for bi, n in enumerate(block_cfg):
             for _ in range(n):
                 blocks.append(_DenseLayer(c, growth_rate))

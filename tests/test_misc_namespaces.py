@@ -482,3 +482,46 @@ class TestTensorOpsRound3:
         x = jnp.ones((4,))
         out2 = np.asarray(pt.tensor.scatter_nd_add(x, idx, upd))
         np.testing.assert_allclose(out2, [1.0, 21.0, 11.0, 1.0])
+
+
+class TestRandomCreation:
+    def test_shapes_and_ranges(self):
+        pt.seed(7)
+        r = pt.rand((3, 4))
+        assert r.shape == (3, 4) and (np.asarray(r) >= 0).all() \
+            and (np.asarray(r) < 1).all()
+        n = pt.randn((5,))
+        assert n.shape == (5,)
+        i = pt.randint(2, 9, (100,))
+        ai = np.asarray(i)
+        assert ai.min() >= 2 and ai.max() < 9
+        p = np.asarray(pt.randperm(10))
+        assert sorted(p.tolist()) == list(range(10))
+        u = np.asarray(pt.uniform((50,), min=3.0, max=4.0))
+        assert u.min() >= 3.0 and u.max() < 4.0
+
+    def test_seed_reproducible(self):
+        pt.seed(123)
+        a = np.asarray(pt.randn((4,)))
+        pt.seed(123)
+        b = np.asarray(pt.randn((4,)))
+        np.testing.assert_array_equal(a, b)
+        c = np.asarray(pt.randn((4,)))
+        assert not np.array_equal(b, c)   # stream advances
+
+    def test_multinomial(self):
+        pt.seed(0)
+        probs = jnp.asarray([0.0, 0.7, 0.3, 0.0])
+        s = np.asarray(pt.multinomial(probs, 200, replacement=True))
+        assert set(np.unique(s)) <= {1, 2}
+        assert (s == 1).mean() > 0.5
+        nr = np.asarray(pt.multinomial(jnp.ones(6), 6))
+        assert sorted(nr.tolist()) == list(range(6))
+
+    def test_multinomial_overdraw_raises(self):
+        with pytest.raises(ValueError, match="nonzero"):
+            pt.multinomial(jnp.asarray([0.0, 0.5, 0.5, 0.0]), 3)
+
+    def test_dtype_strings(self):
+        assert pt.rand((2,), "float32").dtype == jnp.float32
+        assert pt.randint(0, 5, (3,), "int32").dtype == jnp.int32
