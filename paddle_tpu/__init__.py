@@ -25,6 +25,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import signal  # noqa: F401
+from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from .hapi.summary import flops, summary  # noqa: F401
 from . import sparse  # noqa: F401

@@ -27,12 +27,29 @@ from .core.module import Layer
 
 
 class TracedLayer:
-    def __init__(self, layer: Layer, jit_fn, params):
+    def __init__(self, layer: Layer, jit_fn, params, input_spec=None):
         self.layer = layer
         self._fn = jit_fn
         self._params = params
+        self._input_spec = input_spec
+
+    def _check_spec(self, args):
+        from .static import InputSpec
+
+        for i, (spec, arg) in enumerate(zip(self._input_spec, args)):
+            if not isinstance(spec, InputSpec):
+                continue
+            shape = jnp.shape(arg)
+            ok = len(shape) == len(spec.shape) and all(
+                d is None or d == a for d, a in zip(spec.shape, shape))
+            if not ok:
+                raise ValueError(
+                    f"to_static input {i}: shape {shape} does not match "
+                    f"declared {spec}")
 
     def __call__(self, *args, **kwargs):
+        if self._input_spec is not None:
+            self._check_spec(args)
         return self._fn(self._params, *args, **kwargs)
 
     @property
@@ -53,7 +70,8 @@ def to_static(layer=None, input_spec=None, full_graph=True, **kw):
             fn = jax.jit(
                 lambda p, *a, **k: functional_call(target, p, *a, **k)
             )
-            return TracedLayer(target, fn, params)
+            return TracedLayer(target, fn, params,
+                               input_spec=input_spec)
         # plain function
         return jax.jit(target)
 
@@ -72,10 +90,14 @@ def save(traced, path: str, input_spec: Optional[Sequence] = None):
         traced = to_static(traced)
     if input_spec is None:
         raise ValueError("input_spec required for jit.save")
+    from .static import InputSpec
+
     specs = [
-        x if isinstance(x, jax.ShapeDtypeStruct)
+        x.to_symbolic_struct(prefix=f"a{i}_")
+        if isinstance(x, InputSpec)
+        else x if isinstance(x, jax.ShapeDtypeStruct)
         else jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
-        for x in input_spec
+        for i, x in enumerate(input_spec)
     ]
     from jax import export as jexport
 
@@ -85,7 +107,12 @@ def save(traced, path: str, input_spec: Optional[Sequence] = None):
     exported = jexport.export(jax.jit(fn))(*specs)
     payload = {
         "stablehlo": exported.serialize(),
-        "in_specs": [(tuple(s.shape), str(s.dtype)) for s in specs],
+        # symbolic dims are not picklable — record them as None markers
+        "in_specs": [
+            (tuple(d if isinstance(d, int) else None for d in s.shape),
+             str(s.dtype))
+            for s in specs
+        ],
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:

@@ -1,0 +1,63 @@
+"""paddle_tpu.static (parity: the slice of paddle.static that survives in
+a jit-only world — InputSpec for export signatures; Program/Executor are
+documented N/A in MAPPING.md since there is no second execution mode)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec — a symbolic tensor signature for
+    jit.save / to_static. ``None`` dims mean 'dynamic' and export through
+    jax.export symbolic shapes (the StableHLO module stays batch-
+    polymorphic); ``to_struct`` resolves them concretely when a fixed
+    shape is needed."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        from .core.dtype import convert_dtype
+
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name!r})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name)
+
+    def to_symbolic_struct(self, prefix="d"):
+        """jax.ShapeDtypeStruct with export-symbolic dims for the None
+        entries (batch-polymorphic StableHLO)."""
+        from jax import export as jexport
+
+        if None not in self.shape:
+            return jax.ShapeDtypeStruct(self.shape, self.dtype)
+        spec_str = ", ".join(
+            f"{prefix}{i}" if d is None else str(d)
+            for i, d in enumerate(self.shape))
+        return jax.ShapeDtypeStruct(
+            jexport.symbolic_shape(spec_str), self.dtype)
+
+    def to_struct(self, batch_size=None):
+        """Resolve to a jax.ShapeDtypeStruct; ``batch_size`` fills a
+        leading None dim."""
+        shape = list(self.shape)
+        for i, d in enumerate(shape):
+            if d is None:
+                if i == 0 and batch_size is not None:
+                    shape[i] = batch_size
+                else:
+                    raise ValueError(
+                        f"InputSpec {self!r}: dynamic dim {i} must be "
+                        "resolved before export (pass batch_size, or "
+                        "give a concrete shape — StableHLO export is "
+                        "shape-specialized)")
+        return jax.ShapeDtypeStruct(tuple(shape), self.dtype)
+
+
+__all__ = ["InputSpec"]

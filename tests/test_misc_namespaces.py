@@ -525,3 +525,61 @@ class TestRandomCreation:
     def test_dtype_strings(self):
         assert pt.rand((2,), "float32").dtype == jnp.float32
         assert pt.randint(0, 5, (3,), "int32").dtype == jnp.int32
+
+
+class TestInputSpec:
+    def test_jit_save_load_with_input_spec(self, tmp_path):
+        from paddle_tpu import jit, static
+
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 2)
+        spec = static.InputSpec([3, 4], "float32", name="x")
+        path = str(tmp_path / "model")
+        jit.save(lin, path, input_spec=[spec])
+        loaded = jit.load(path)
+        x = jnp.ones((3, 4))
+        np.testing.assert_allclose(
+            np.asarray(loaded(x)), np.asarray(lin(x)), rtol=1e-5)
+
+    def test_dynamic_dim_resolution(self):
+        from paddle_tpu import static
+
+        spec = static.InputSpec([None, 8], "int64")
+        s = spec.to_struct(batch_size=4)
+        assert s.shape == (4, 8)
+        with pytest.raises(ValueError, match="dynamic dim"):
+            static.InputSpec([4, None], "int64").to_struct()
+
+    def test_dynamic_batch_export(self, tmp_path):
+        """None dims export batch-POLYMORPHIC StableHLO: one saved
+        module serves every batch size."""
+        from paddle_tpu import jit, static
+
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 2)
+        path = str(tmp_path / "dyn")
+        jit.save(lin, path,
+                 input_spec=[static.InputSpec([None, 4], "float32")])
+        loaded = jit.load(path)
+        for b in (1, 3, 6):
+            x = jnp.ones((b, 4))
+            np.testing.assert_allclose(
+                np.asarray(loaded(x)), np.asarray(lin(x)), rtol=1e-5)
+
+    def test_to_static_validates_spec(self):
+        from paddle_tpu import jit, static
+
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 2)
+        ts = jit.to_static(lin,
+                           input_spec=[static.InputSpec([None, 4])])
+        ts(jnp.ones((3, 4)))       # matches
+        with pytest.raises(ValueError, match="does not match"):
+            ts(jnp.ones((3, 5)))
+
+    def test_from_tensor(self):
+        from paddle_tpu import static
+
+        t = jnp.zeros((2, 3), jnp.float32)
+        spec = static.InputSpec.from_tensor(t, name="t")
+        assert spec.shape == (2, 3) and spec.name == "t"
