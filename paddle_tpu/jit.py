@@ -33,9 +33,16 @@ class TracedLayer:
         self._params = params
         self._input_spec = input_spec
 
-    def _check_spec(self, args):
+    def _check_spec(self, args, kwargs):
         from .static import InputSpec
 
+        n_spec = len(self._input_spec)
+        if len(args) != n_spec:
+            raise ValueError(
+                f"to_static declared {n_spec} input_spec entries but got "
+                f"{len(args)} positional inputs; pass spec'd tensors "
+                "positionally (keyword tensors bypass the declared "
+                "signature)")
         for i, (spec, arg) in enumerate(zip(self._input_spec, args)):
             if not isinstance(spec, InputSpec):
                 continue
@@ -49,7 +56,7 @@ class TracedLayer:
 
     def __call__(self, *args, **kwargs):
         if self._input_spec is not None:
-            self._check_spec(args)
+            self._check_spec(args, kwargs)
         return self._fn(self._params, *args, **kwargs)
 
     @property
@@ -90,14 +97,21 @@ def save(traced, path: str, input_spec: Optional[Sequence] = None):
         traced = to_static(traced)
     if input_spec is None:
         raise ValueError("input_spec required for jit.save")
+    from jax import export as jexport
+
     from .static import InputSpec
 
+    scope = jexport.SymbolicScope()   # ONE scope for every dynamic dim
+    # unnamed specs share canonical per-position symbols (d0, d1, ...)
+    # so two dynamic-batch inputs are EQUAL-batch, the paddle meaning;
+    # give specs distinct name= values to declare independent dims
     specs = [
-        x.to_symbolic_struct(prefix=f"a{i}_")
+        x.to_symbolic_struct(
+            prefix=(f"{x.name}_" if x.name else "d"), scope=scope)
         if isinstance(x, InputSpec)
         else x if isinstance(x, jax.ShapeDtypeStruct)
         else jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
-        for i, x in enumerate(input_spec)
+        for x in input_spec
     ]
     from jax import export as jexport
 

@@ -933,3 +933,147 @@ def _bilerp(feat, y, x):
             + feat[:, y0, x1] * ((1 - wy1) * wx1)
             + feat[:, y1, x0] * (wy1 * (1 - wx1))
             + feat[:, y1, x1] * (wy1 * wx1))
+
+
+# ---------------------------------------------------------------------------
+# functional loss forms (parity: python/paddle/nn/functional/loss.py);
+# the corresponding nn.layer.loss classes delegate here
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    """input is LOG-probabilities (paddle convention)."""
+    x, t = _v(input), _v(label)
+    loss = t * (jnp.log(jnp.clip(t, 1e-30)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(
+        0.0, -_v(label) * (_v(input) - _v(other)) + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.abs(_v(input) - _v(label))
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False,
+                        reduction="mean"):  # noqa: A002
+    def dist(a, b):
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
+            1.0 / p)
+
+    a, pos, neg = _v(input), _v(positive), _v(negative)
+    d_pos = dist(a, pos)
+    d_neg = dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    return _reduce_loss(jnp.maximum(0.0, d_pos - d_neg + margin),
+                        reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    x1, x2 = _v(input1), _v(input2)
+    if x1.ndim == 1:      # paddle accepts a single [M] pair
+        x1, x2 = x1[None], x2[None]
+    cos = cosine_similarity(x1, x2, axis=1)
+    loss = jnp.where(_v(label) > 0, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce_loss(jax.nn.softplus(-_v(label) * _v(input)),
+                        reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0,
+                         reduction="mean"):  # noqa: A002
+    x = _v(input)
+    loss = jnp.where(_v(label) > 0, x, jnp.maximum(0.0, margin - x))
+    return _reduce_loss(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):  # noqa: A002
+    x, t = _v(input), _v(label)
+    if log_input:
+        loss = jnp.exp(x) - t * x
+    else:
+        loss = x - t * jnp.log(x + epsilon)
+    if full:
+        stirling = (t * jnp.log(t) - t
+                    + 0.5 * jnp.log(2.0 * jnp.pi * t))
+        loss = loss + jnp.where(t > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):  # noqa: A002
+    var = jnp.maximum(_v(variance), epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(_v(input) - _v(label)) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
+    return _reduce_loss(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):  # noqa: A002
+    x, t = _v(input), _v(label)
+    loss = -(t * jax.nn.log_sigmoid(x)
+             + (1 - t) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * _v(weight)
+    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    """Parity: paddle.nn.functional.sigmoid_focal_loss (RetinaNet)."""
+    x, t = _f32up(_v(logit)), _v(label).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / _v(normalizer)
+    return _reduce_loss(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    """Parity: paddle.nn.functional.dice_loss — input [N, ..., C]
+    probabilities, label [N, ..., 1] class ids."""
+    x = _v(input)
+    t = jax.nn.one_hot(jnp.squeeze(_v(label), -1), x.shape[-1],
+                       dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * t, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(t, axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    """Parity: paddle.nn.functional.log_loss (probability input)."""
+    x, t = _v(input), _v(label)
+    return -(t * jnp.log(x + epsilon)
+             + (1 - t) * jnp.log(1 - x + epsilon))
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(_v(input) - _v(label))

@@ -110,12 +110,7 @@ class KLDivLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax.numpy as jnp
-
-        loss = label * (jnp.log(jnp.clip(label, 1e-30)) - input)
-        if self.reduction == "batchmean":
-            return jnp.sum(loss) / input.shape[0]
-        return _reduce(loss, self.reduction)
+        return F.kl_div(input, label, self.reduction)
 
 
 class MarginRankingLoss(Layer):
@@ -125,10 +120,8 @@ class MarginRankingLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, other, label):  # noqa: A002
-        import jax.numpy as jnp
-
-        loss = jnp.maximum(0.0, -label * (input - other) + self.margin)
-        return _reduce(loss, self.reduction)
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
 
 
 def _reduce(loss, reduction):
@@ -186,15 +179,8 @@ class CosineEmbeddingLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input1, input2, label):
-        import jax.numpy as jnp
-
-        from .. import functional as F
-
-        cos = F.cosine_similarity(input1, input2, axis=1)
-        loss = jnp.where(
-            label > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin)
-        )
-        return _reduce(loss, self.reduction)
+        return F.cosine_embedding_loss(input1, input2, label,
+                                       self.margin, self.reduction)
 
 
 class TripletMarginLoss(Layer):
@@ -206,21 +192,9 @@ class TripletMarginLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, positive, negative):  # noqa: A002
-        import jax.numpy as jnp
-
-        def dist(a, b):
-            return jnp.power(
-                jnp.sum(jnp.power(jnp.abs(a - b) + self.epsilon, self.p),
-                        axis=-1),
-                1.0 / self.p,
-            )
-
-        d_pos = dist(input, positive)
-        d_neg = dist(input, negative)
-        if self.swap:
-            d_neg = jnp.minimum(d_neg, dist(positive, negative))
-        loss = jnp.maximum(0.0, d_pos - d_neg + self.margin)
-        return _reduce(loss, self.reduction)
+        return F.triplet_margin_loss(
+            input, positive, negative, self.margin, self.p,
+            self.epsilon, self.swap, self.reduction)
 
 
 class SoftMarginLoss(Layer):
@@ -229,11 +203,7 @@ class SoftMarginLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax
-
-        # softplus(-y*x): stable for large |x| (log1p(exp(.)) overflows)
-        loss = jax.nn.softplus(-label * input)
-        return _reduce(loss, self.reduction)
+        return F.soft_margin_loss(input, label, self.reduction)
 
 
 class HingeEmbeddingLoss(Layer):
@@ -243,12 +213,8 @@ class HingeEmbeddingLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax.numpy as jnp
-
-        loss = jnp.where(
-            label > 0, input, jnp.maximum(0.0, self.margin - input)
-        )
-        return _reduce(loss, self.reduction)
+        return F.hinge_embedding_loss(input, label, self.margin,
+                                      self.reduction)
 
 
 class PoissonNLLLoss(Layer):
@@ -259,18 +225,9 @@ class PoissonNLLLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax.numpy as jnp
-
-        if self.log_input:
-            loss = jnp.exp(input) - label * input
-        else:
-            loss = input - label * jnp.log(input + self.epsilon)
-        if self.full:
-            # Stirling approximation for label! (label > 1 only)
-            stirling = (label * jnp.log(label) - label
-                        + 0.5 * jnp.log(2.0 * jnp.pi * label))
-            loss = loss + jnp.where(label > 1, stirling, 0.0)
-        return _reduce(loss, self.reduction)
+        return F.poisson_nll_loss(input, label, self.log_input,
+                                  self.full, self.epsilon,
+                                  self.reduction)
 
 
 class GaussianNLLLoss(Layer):
@@ -280,13 +237,8 @@ class GaussianNLLLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label, variance):  # noqa: A002
-        import jax.numpy as jnp
-
-        var = jnp.maximum(variance, self.epsilon)
-        loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
-        if self.full:
-            loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
-        return _reduce(loss, self.reduction)
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
 
 
 class MultiLabelSoftMarginLoss(Layer):
@@ -296,11 +248,5 @@ class MultiLabelSoftMarginLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
-        import jax
-        import jax.numpy as jnp
-
-        loss = -(label * jax.nn.log_sigmoid(input)
-                 + (1 - label) * jax.nn.log_sigmoid(-input))
-        if self.weight is not None:
-            loss = loss * self.weight
-        return _reduce(jnp.mean(loss, axis=-1), self.reduction)
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)

@@ -30,9 +30,10 @@ class InputSpec:
     def from_tensor(cls, tensor, name=None):
         return cls(tuple(tensor.shape), tensor.dtype, name)
 
-    def to_symbolic_struct(self, prefix="d"):
+    def to_symbolic_struct(self, prefix="d", scope=None):
         """jax.ShapeDtypeStruct with export-symbolic dims for the None
-        entries (batch-polymorphic StableHLO)."""
+        entries (batch-polymorphic StableHLO). All specs of one export
+        must share ``scope`` — mixing scopes is a jax.export error."""
         from jax import export as jexport
 
         if None not in self.shape:
@@ -41,7 +42,7 @@ class InputSpec:
             f"{prefix}{i}" if d is None else str(d)
             for i, d in enumerate(self.shape))
         return jax.ShapeDtypeStruct(
-            jexport.symbolic_shape(spec_str), self.dtype)
+            jexport.symbolic_shape(spec_str, scope=scope), self.dtype)
 
     def to_struct(self, batch_size=None):
         """Resolve to a jax.ShapeDtypeStruct; ``batch_size`` fills a

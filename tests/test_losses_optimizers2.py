@@ -542,3 +542,85 @@ class TestGradientMerge:
             0, cfg.vocab_size, (8, 16)))
         with pytest.raises(ValueError, match="not divisible"):
             ts3.run({"input_ids": ids, "labels": ids})
+
+
+class TestFunctionalLossForms:
+    """F.* loss spellings vs torch (the layer classes are already
+    covered; these check the functional forms paddle users call)."""
+
+    def setup_method(self, _):
+        rng = np.random.default_rng(11)
+        self.x = rng.normal(size=(6, 4)).astype(np.float32)
+        self.y = rng.normal(size=(6, 4)).astype(np.float32)
+
+    def test_kl_div(self):
+        logp = np.log(np.abs(self.x) / np.abs(self.x).sum(-1, keepdims=True))
+        q = np.abs(self.y) / np.abs(self.y).sum(-1, keepdims=True)
+        ours = F.kl_div(jnp.asarray(logp), jnp.asarray(q),
+                        reduction="batchmean")
+        ref = torch.nn.functional.kl_div(
+            torch.tensor(logp), torch.tensor(q), reduction="batchmean")
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+    def test_smooth_l1_delta(self):
+        # paddle smooth_l1 with delta: 0.5 d^2/delta vs d - delta/2
+        ours = F.smooth_l1_loss(jnp.asarray(self.x), jnp.asarray(self.y),
+                                delta=2.0, reduction="none")
+        ref = torch.nn.functional.smooth_l1_loss(
+            torch.tensor(self.x), torch.tensor(self.y), beta=2.0,
+            reduction="none")
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_focal_loss_vs_numpy(self):
+        t = (self.y > 0).astype(np.float32)
+        ours = float(F.sigmoid_focal_loss(
+            jnp.asarray(self.x), jnp.asarray(t), reduction="sum"))
+        p = 1 / (1 + np.exp(-self.x.astype(np.float64)))
+        ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = 0.25 * t + 0.75 * (1 - t)
+        ref = (a_t * (1 - p_t) ** 2 * ce).sum()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_dice_log_square(self):
+        probs = np.abs(self.x) / np.abs(self.x).sum(-1, keepdims=True)
+        labels = np.random.default_rng(3).integers(0, 4, (6, 1))
+        d = float(F.dice_loss(jnp.asarray(probs), jnp.asarray(labels)))
+        assert 0.0 < d < 1.0
+        pr = 1 / (1 + np.exp(-self.x))
+        t = (self.y > 0).astype(np.float32)
+        ll = np.asarray(F.log_loss(jnp.asarray(pr), jnp.asarray(t)))
+        ref = -(t * np.log(pr + 1e-4) + (1 - t) * np.log(1 - pr + 1e-4))
+        np.testing.assert_allclose(ll, ref, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(F.square_error_cost(jnp.asarray(self.x),
+                                           jnp.asarray(self.y))),
+            (self.x - self.y) ** 2, rtol=1e-6)
+
+    def test_functional_matches_layers(self):
+        """the F.* forms agree with the (already torch-verified) layer
+        classes."""
+        lab = np.where(self.y > 0, 1.0, -1.0).astype(np.float32)
+        pairs = [
+            (F.soft_margin_loss(jnp.asarray(self.x), jnp.asarray(lab)),
+             nn.SoftMarginLoss()(jnp.asarray(self.x), jnp.asarray(lab))),
+            (F.hinge_embedding_loss(jnp.asarray(self.x),
+                                    jnp.asarray(lab)),
+             nn.HingeEmbeddingLoss()(jnp.asarray(self.x),
+                                     jnp.asarray(lab))),
+            (F.margin_ranking_loss(jnp.asarray(self.x),
+                                   jnp.asarray(self.y),
+                                   jnp.asarray(lab)),
+             nn.MarginRankingLoss()(jnp.asarray(self.x),
+                                    jnp.asarray(self.y),
+                                    jnp.asarray(lab))),
+            (F.gaussian_nll_loss(jnp.asarray(self.x), jnp.asarray(self.y),
+                                 jnp.asarray(np.abs(self.y) + 0.1)),
+             nn.GaussianNLLLoss()(jnp.asarray(self.x),
+                                  jnp.asarray(self.y),
+                                  jnp.asarray(np.abs(self.y) + 0.1))),
+        ]
+        for ours, layer_out in pairs:
+            np.testing.assert_allclose(float(ours), float(layer_out),
+                                       rtol=1e-6)

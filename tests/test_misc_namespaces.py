@@ -583,3 +583,30 @@ class TestInputSpec:
         t = jnp.zeros((2, 3), jnp.float32)
         spec = static.InputSpec.from_tensor(t, name="t")
         assert spec.shape == (2, 3) and spec.name == "t"
+
+    def test_multi_dynamic_input_export(self, tmp_path):
+        """two dynamic-batch inputs share one symbolic scope."""
+        from paddle_tpu import jit, static
+
+        pt.seed(0)
+
+        class TwoIn(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pt.nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.lin(a) + self.lin(b)
+
+        m = TwoIn()
+        path = str(tmp_path / "two")
+        jit.save(m, path, input_spec=[
+            static.InputSpec([None, 4], "float32"),
+            static.InputSpec([None, 4], "float32"),
+        ])
+        loaded = jit.load(path)
+        for bsz in (2, 5):
+            a = jnp.ones((bsz, 4))
+            np.testing.assert_allclose(
+                np.asarray(loaded(a, a * 2)),
+                np.asarray(m(a, a * 2)), rtol=1e-5)
