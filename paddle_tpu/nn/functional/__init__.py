@@ -1077,3 +1077,87 @@ def log_loss(input, label, epsilon=1e-4):  # noqa: A002
 
 def square_error_cost(input, label):  # noqa: A002
     return jnp.square(_v(input) - _v(label))
+
+
+# ---------------------------------------------------------------------------
+# remaining activation functional forms (parity: paddle.nn.functional —
+# the activation Layer classes keep their own thin forwards; these are
+# the F.* spellings)
+# ---------------------------------------------------------------------------
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(_v(x))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(_v(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    # jax.nn.elu guards expm1 against overflow in the untaken branch
+    # (bare where leaks NaN grads at large positive x)
+    return scale * jax.nn.elu(_v(x), alpha)
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(_v(x), alpha)
+
+
+def hardshrink(x, threshold=0.5):
+    x = _v(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold=0.5):
+    x = _v(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def tanhshrink(x):
+    x = _v(x)
+    return x - jnp.tanh(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(_v(x), min, max)
+
+
+def thresholded_relu(x, threshold=1.0):
+    x = _v(x)
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def prelu(x, weight):
+    """weight: scalar-shaped [1] or per-channel [C] (paddle NCHW
+    channel-1 convention for >2-D inputs)."""
+    x, w = _v(x), _v(weight)
+    if w.size > 1 and x.ndim > 2:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True,
+          rng_key=None):
+    """Randomized leaky ReLU: U[lower, upper] slope in training, the
+    midpoint at inference (paddle semantics)."""
+    x = _v(x)
+    if not training:
+        return jnp.where(x > 0, x, (lower + upper) / 2.0 * x)
+    key = rng_key if rng_key is not None else \
+        random_mod.next_rng_key("rrelu")
+    slope = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    return jnp.where(x > 0, x, slope.astype(x.dtype) * x)
+
+
+def maxout(x, groups, axis=1):
+    """Parity: paddle.nn.functional.maxout — max over ``groups``-sized
+    channel blocks."""
+    x = _v(x)
+    axis = axis % x.ndim          # negative axis: normalize BEFORE the
+    c = x.shape[axis]             # slice-splice below
+    if c % groups:
+        raise ValueError(f"maxout: channels {c} not divisible by "
+                         f"groups {groups}")
+    shape = list(x.shape)
+    shape[axis: axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)

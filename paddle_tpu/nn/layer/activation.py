@@ -124,15 +124,7 @@ class PReLU(Layer):
             (num_parameters,), default_initializer=I.Constant(init))
 
     def forward(self, x):
-        import jax.numpy as jnp
-
-        a = self.weight.value
-        if a.shape[0] > 1:
-            # per-channel: broadcast along the channel (axis 1) dim
-            shape = [1] * x.ndim
-            shape[1] = a.shape[0]
-            a = a.reshape(shape)
-        return jnp.where(x > 0, x, a * x)
+        return F.prelu(x, self.weight)
 
 
 class SELU(Layer):

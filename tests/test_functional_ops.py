@@ -136,3 +136,95 @@ def test_embedding_padding_idx():
     emb = nn.Embedding(10, 4, padding_idx=0)
     y = emb(jnp.asarray([[0, 1]]))
     np.testing.assert_allclose(np.asarray(y[0, 0]), np.zeros(4))
+
+
+class TestActivationFunctionalForms:
+    """Round-3: the F.* activation spellings vs torch."""
+
+    def setup_method(self, _):
+        self.x = np.random.default_rng(0).normal(
+            size=(4, 6)).astype(np.float32) * 2
+
+    def _cmp(self, ours, ref, **tol):
+        tol.setdefault("rtol", 1e-5)
+        tol.setdefault("atol", 1e-6)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), **tol)
+
+    def test_vs_torch(self):
+        import torch
+
+        x = jnp.asarray(self.x)
+        t = torch.tensor(self.x)
+        self._cmp(F.log_sigmoid(x),
+                  torch.nn.functional.logsigmoid(t))
+        self._cmp(F.softsign(x), torch.nn.functional.softsign(t))
+        self._cmp(F.selu(x), torch.nn.functional.selu(t))
+        self._cmp(F.celu(x, 1.3), torch.nn.functional.celu(t, 1.3))
+        self._cmp(F.hardshrink(x, 0.4),
+                  torch.nn.functional.hardshrink(t, 0.4))
+        self._cmp(F.softshrink(x, 0.4),
+                  torch.nn.functional.softshrink(t, 0.4))
+        self._cmp(F.tanhshrink(x), torch.nn.functional.tanhshrink(t))
+        self._cmp(F.hardtanh(x, -0.7, 0.9),
+                  torch.nn.functional.hardtanh(t, -0.7, 0.9))
+        w = np.asarray([0.2], np.float32)
+        self._cmp(F.prelu(x, jnp.asarray(w)),
+                  torch.nn.functional.prelu(t, torch.tensor(w)))
+
+    def test_prelu_channelwise(self):
+        import torch
+
+        x4 = np.random.default_rng(1).normal(
+            size=(2, 3, 4, 4)).astype(np.float32)
+        w = np.asarray([0.1, 0.2, 0.3], np.float32)
+        ours = F.prelu(jnp.asarray(x4), jnp.asarray(w))
+        ref = torch.nn.functional.prelu(torch.tensor(x4),
+                                        torch.tensor(w))
+        self._cmp(ours, ref)
+
+    def test_rrelu_bounds_and_eval(self):
+        x = jnp.asarray(self.x)
+        y = np.asarray(F.rrelu(x, 0.1, 0.3, training=True,
+                               rng_key=jax.random.PRNGKey(0)))
+        neg = self.x < 0
+        ratio = y[neg] / self.x[neg]
+        assert (ratio >= 0.1 - 1e-6).all() and (ratio <= 0.3 + 1e-6).all()
+        y_eval = np.asarray(F.rrelu(x, 0.1, 0.3, training=False))
+        np.testing.assert_allclose(
+            y_eval[neg], 0.2 * self.x[neg], rtol=1e-6)
+
+    def test_maxout(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 12))
+        out = np.asarray(F.maxout(x, groups=3, axis=1))
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out[0], [2, 5, 8, 11])
+        with pytest.raises(ValueError):
+            F.maxout(x, groups=5)
+
+    def test_thresholded_relu(self):
+        x = jnp.asarray([-1.0, 0.5, 1.5])
+        np.testing.assert_allclose(
+            np.asarray(F.thresholded_relu(x, 1.0)), [0.0, 0.0, 1.5])
+
+    def test_maxout_negative_axis(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 12))
+        np.testing.assert_allclose(
+            np.asarray(F.maxout(x, groups=3, axis=-1)),
+            np.asarray(F.maxout(x, groups=3, axis=1)))
+
+    def test_selu_grad_large_input(self):
+        g = jax.grad(lambda v: jnp.sum(F.selu(v)))(
+            jnp.asarray([100.0, -1.0]))
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_prelu_layer_delegates(self):
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+
+        pt.seed(0)
+        layer = nn.PReLU(num_parameters=3, init=0.3)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 3, 4)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(layer(x)),
+            np.asarray(F.prelu(x, layer.weight)), rtol=1e-6)
