@@ -27,6 +27,7 @@
 #include <deque>
 #include <fcntl.h>
 #include <memory>
+#include <map>
 #include <mutex>
 #include <numeric>
 #include <random>
@@ -58,11 +59,14 @@ struct Dataset {
   int64_t batch_size = 1;
   bool drop_last = true;
   int64_t epoch_batches = 0;
-  std::atomic<int64_t> produced{0};
 
-  // prefetch machinery
+  // prefetch machinery. Batches are delivered IN INDEX ORDER: workers
+  // complete out of order under load, so a plain FIFO queue makes the
+  // epoch's batch sequence scheduling-dependent (breaks same-seed
+  // determinism); the ready-map + next_deliver cursor restores it.
   std::vector<std::thread> workers;
-  std::deque<Batch> queue;
+  std::map<int64_t, Batch> ready;
+  int64_t next_deliver = 0;
   std::mutex mu;
   std::condition_variable cv_push, cv_pop;
   size_t max_queue = 8;
@@ -71,7 +75,12 @@ struct Dataset {
   ~Dataset() { shutdown(); }
 
   void shutdown() {
-    stopping.store(true);
+    {
+      // store+notify under mu: a lock-free store can land between a
+      // waiter's predicate check and its block, losing the wakeup
+      std::lock_guard<std::mutex> g(mu);
+      stopping.store(true);
+    }
     cv_push.notify_all();
     cv_pop.notify_all();
     for (auto& t : workers)
@@ -79,7 +88,7 @@ struct Dataset {
     workers.clear();
     {
       std::lock_guard<std::mutex> g(mu);
-      queue.clear();
+      ready.clear();
     }
     if (data) {
       munmap(const_cast<uint8_t*>(data), bytes);
@@ -124,10 +133,16 @@ struct Dataset {
       Batch b;
       fill(b, bi * batch_size);
       std::unique_lock<std::mutex> lk(mu);
-      cv_push.wait(lk, [&] { return stopping.load() || queue.size() < max_queue; });
+      // bounded lookahead relative to the delivery cursor — the batch
+      // the consumer needs next (bi == next_deliver) is never blocked,
+      // so this cannot deadlock
+      cv_push.wait(lk, [&] {
+        return stopping.load() ||
+               bi < next_deliver + static_cast<int64_t>(max_queue);
+      });
       if (stopping.load()) return;
-      queue.push_back(std::move(b));
-      cv_pop.notify_one();
+      ready.emplace(bi, std::move(b));
+      cv_pop.notify_all();
     }
   }
 };
@@ -176,8 +191,11 @@ int ptdl_start_epoch(int h, int64_t seed, int64_t batch_size, int drop_last,
                      int shuffle, int nthreads) {
   Dataset* ds = get(h);
   if (!ds || batch_size <= 0) return -1;
-  // stop any previous epoch's workers
-  ds->stopping.store(true);
+  // stop any previous epoch's workers (store under mu — see shutdown)
+  {
+    std::lock_guard<std::mutex> g(ds->mu);
+    ds->stopping.store(true);
+  }
   ds->cv_push.notify_all();
   ds->cv_pop.notify_all();
   for (auto& t : ds->workers)
@@ -185,7 +203,8 @@ int ptdl_start_epoch(int h, int64_t seed, int64_t batch_size, int drop_last,
   ds->workers.clear();
   {
     std::lock_guard<std::mutex> g(ds->mu);
-    ds->queue.clear();
+    ds->ready.clear();
+    ds->next_deliver = 0;
   }
   ds->stopping.store(false);
 
@@ -201,7 +220,6 @@ int ptdl_start_epoch(int h, int64_t seed, int64_t batch_size, int drop_last,
                           ? ds->num_seqs / batch_size
                           : (ds->num_seqs + batch_size - 1) / batch_size;
   ds->next_index.store(0);
-  ds->produced.store(0);
   int n = nthreads > 0 ? nthreads : 2;
   for (int i = 0; i < n; ++i)
     ds->workers.emplace_back([ds] { ds->worker_loop(); });
@@ -214,15 +232,26 @@ int64_t ptdl_next_batch(int h, int32_t* out, int64_t* out_indices) {
   Dataset* ds = get(h);
   if (!ds) return -1;
   std::unique_lock<std::mutex> lk(ds->mu);
-  ds->cv_pop.wait(lk, [&] {
-    return ds->stopping.load() || !ds->queue.empty() ||
-           ds->produced.load() >= ds->epoch_batches;
-  });
-  if (ds->queue.empty()) return 0;  // exhausted
-  Batch b = std::move(ds->queue.front());
-  ds->queue.pop_front();
-  ds->produced.fetch_add(1);
-  ds->cv_push.notify_one();
+  Batch b;
+  for (;;) {
+    if (ds->next_deliver >= ds->epoch_batches) return 0;  // exhausted
+    const int64_t want = ds->next_deliver;
+    // multi-consumer safe: a second caller waiting on the same index
+    // wakes when the cursor moves past it and retries on the new head
+    ds->cv_pop.wait(lk, [&] {
+      return ds->stopping.load() || ds->ready.count(want) != 0 ||
+             ds->next_deliver != want;
+    });
+    if (ds->stopping.load() && ds->ready.count(want) == 0) return 0;
+    if (ds->next_deliver != want) continue;  // lost the race; retry
+    auto it = ds->ready.find(want);
+    b = std::move(it->second);
+    ds->ready.erase(it);
+    ds->next_deliver = want + 1;
+    break;
+  }
+  ds->cv_push.notify_all();
+  ds->cv_pop.notify_all();
   lk.unlock();
   std::memcpy(out, b.tokens.data(), b.tokens.size() * sizeof(int32_t));
   if (out_indices)

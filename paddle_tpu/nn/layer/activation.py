@@ -129,9 +129,7 @@ class PReLU(Layer):
 
 class SELU(Layer):
     def forward(self, x):
-        import jax
-
-        return jax.nn.selu(x)
+        return F.selu(x)
 
 
 class CELU(Layer):
@@ -140,23 +138,17 @@ class CELU(Layer):
         self.alpha = alpha
 
     def forward(self, x):
-        import jax
-
-        return jax.nn.celu(x, self.alpha)
+        return F.celu(x, self.alpha)
 
 
 class LogSigmoid(Layer):
     def forward(self, x):
-        import jax
-
-        return jax.nn.log_sigmoid(x)
+        return F.log_sigmoid(x)
 
 
 class Softsign(Layer):
     def forward(self, x):
-        import jax
-
-        return jax.nn.soft_sign(x)
+        return F.softsign(x)
 
 
 class Hardshrink(Layer):
@@ -165,9 +157,7 @@ class Hardshrink(Layer):
         self.threshold = threshold
 
     def forward(self, x):
-        import jax.numpy as jnp
-
-        return jnp.where(jnp.abs(x) > self.threshold, x, 0.0)
+        return F.hardshrink(x, self.threshold)
 
 
 class Softshrink(Layer):
@@ -176,17 +166,12 @@ class Softshrink(Layer):
         self.threshold = threshold
 
     def forward(self, x):
-        import jax.numpy as jnp
-
-        t = self.threshold
-        return jnp.where(x > t, x - t, jnp.where(x < -t, x + t, 0.0))
+        return F.softshrink(x, self.threshold)
 
 
 class Tanhshrink(Layer):
     def forward(self, x):
-        import jax.numpy as jnp
-
-        return x - jnp.tanh(x)
+        return F.tanhshrink(x)
 
 
 
@@ -196,6 +181,4 @@ class ThresholdedReLU(Layer):
         self.threshold = threshold
 
     def forward(self, x):
-        import jax.numpy as jnp
-
-        return jnp.where(x > self.threshold, x, 0.0)
+        return F.thresholded_relu(x, self.threshold)
