@@ -258,6 +258,70 @@ def bench_llama_train(tpu_diags):
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__),
                              "BENCH_BASELINE.json")
+DETAILS_PATH = os.path.join(os.path.dirname(__file__),
+                            "BENCH_DETAILS.json")
+MAX_LINE_BYTES = 2000
+
+
+def _compact_line(result):
+    """Build the driver-facing JSON line: always parseable, < 2KB.
+
+    Round 3 lost its headline because the printed line carried full
+    tracebacks + per-secondary probe diagnostics and defeated the
+    driver's tail parse. Full diagnostics now go to BENCH_DETAILS.json;
+    the printed line keeps scalars only, with errors truncated hard.
+    """
+    details_error = None
+    try:
+        with open(DETAILS_PATH, "w") as f:
+            json.dump(result, f, indent=1, default=str)
+    except Exception as e:
+        details_error = repr(e)[:120]
+
+    def _err_msg(e):
+        e = e or {}
+        msg = (e.get("error") or e.get("stderr") or e.get("reason")
+               or e.get("traceback")
+               or (f"timeout after {e['timeout_s']}s"
+                   if "timeout_s" in e else ""))
+        return str(msg).strip()[-120:]
+
+    out = {k: result.get(k)
+           for k in ("metric", "value", "unit", "vs_baseline")}
+    extra = result.get("extra", {}) or {}
+    keep = {k: extra[k] for k in
+            ("platform", "n_chips", "device_kind", "params", "batch",
+             "seq", "remat", "step_ms", "mfu_est", "loss") if k in extra}
+    if result.get("unit") == "error":
+        keep["error"] = _err_msg(extra)
+    if details_error:
+        keep["details_error"] = details_error
+    if "tpu_probe" in extra:
+        keep["tpu_probe"] = "tpu unavailable; see BENCH_DETAILS.json"
+    sec = extra.get("secondary")
+    if sec:
+        keep["secondary"] = {}
+        for name, r in sec.items():
+            row = {"metric": r.get("metric"), "value": r.get("value"),
+                   "unit": r.get("unit")}
+            if "vs_baseline" in r:
+                row["vs_baseline"] = r["vs_baseline"]
+            if r.get("unit") in ("error", "skipped"):
+                row["error"] = _err_msg(r.get("extra"))
+            keep["secondary"][name] = row
+    out["extra"] = keep
+
+    line = json.dumps(out)
+    # belt-and-braces: progressively shed detail until the line fits
+    if len(line) > MAX_LINE_BYTES and "secondary" in keep:
+        for row in keep["secondary"].values():
+            row.pop("error", None)
+        line = json.dumps(out)
+    if len(line) > MAX_LINE_BYTES:
+        out["extra"] = {k: keep[k] for k in ("platform", "n_chips")
+                        if k in keep}
+        line = json.dumps(out)
+    return line
 
 
 def _load_baseline():
@@ -416,6 +480,12 @@ def main():
             env["JAX_PLATFORMS"] = "cpu"
             env["_BENCH_DIAGS"] = json.dumps(
                 {"tpu_unavailable": True, "attempts": diags})
+    else:
+        # CPU was requested explicitly: scrub the tunnel plugin too, or
+        # every child pays a multi-minute PJRT-init stall when the
+        # tunnel is down (round-4 find: the headline child burned its
+        # whole timeout inside plugin registration).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
 
     result = _run_one_config("llama", env, HEADLINE_TIMEOUT)
     if "--no-secondary" not in argv:
@@ -423,7 +493,7 @@ def main():
             _run_secondary_configs(env)
     _maybe_write_baseline(result)
     _apply_baseline_ratio(result)
-    print(json.dumps(result))
+    print(_compact_line(result))
 
 
 if __name__ == "__main__":

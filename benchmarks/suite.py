@@ -89,7 +89,7 @@ def bench_vit(tpu_diags):
     pt.seed(0)
     model = ViT(cfg)
     imgs = jnp.asarray(np.random.default_rng(0).standard_normal(
-        (batch, cfg.image_size, cfg.image_size, cfg.num_channels)),
+        (batch, cfg.num_channels, cfg.image_size, cfg.image_size)),
         jnp.float32)
     labels = jnp.asarray(
         np.random.default_rng(1).integers(0, cfg.num_classes, (batch,)))
@@ -116,7 +116,7 @@ def bench_unet(tpu_diags):
     model = UNet2DConditionModel(cfg)
     size = cfg.sample_size
     x = jnp.asarray(np.random.default_rng(0).standard_normal(
-        (batch, size, size, cfg.in_channels)), jnp.float32)
+        (batch, cfg.in_channels, size, size)), jnp.float32)
     t = jnp.asarray(np.random.default_rng(1).integers(0, 1000, (batch,)))
     ctx = jnp.asarray(np.random.default_rng(2).standard_normal(
         (batch, 77, cfg.cross_attention_dim)), jnp.float32)

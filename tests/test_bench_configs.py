@@ -1,0 +1,131 @@
+"""Benchmark-suite contract tests.
+
+Round-3 postmortem: the SD-UNet config shipped with an NHWC sample fed
+to an NCHW model and crashed on every backend, and the driver-facing
+JSON line ballooned past parseability. These tests pin both contracts:
+every BASELINE config must execute end-to-end on CPU, and the printed
+line must stay small and parseable no matter how much diagnostic bloat
+the run accumulates (reference: Paddle's benchmark suite smoke jobs,
+test/legacy_test pattern of running each trainer config tiny on CPU).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CONFIGS = ["moe", "vit", "unet", "mamba", "infer"]
+
+
+@pytest.mark.parametrize("name", CONFIGS)
+def test_config_runs_on_cpu(name):
+    """Each BASELINE secondary config must run end-to-end (model
+    construction, data layout, train/infer step) on the CPU smoke size —
+    so benchmark/model input contracts cannot drift silently."""
+    from benchmarks.suite import run_config
+
+    r = run_config(name)
+    assert r["unit"] not in ("error", "skipped"), r
+    assert r["value"] > 0, r
+    assert isinstance(r["metric"], str) and r["metric"]
+    # every result must be one JSON-serializable dict
+    json.dumps(r)
+
+
+def test_headline_cpu_smoke():
+    """The headline llama bench body itself (not via subprocess)."""
+    import bench
+
+    r = bench.bench_llama_train(None)
+    assert r["value"] > 0
+    assert r["unit"] == "tokens/s/chip"
+
+
+def _fat_result():
+    """A worst-case result dict shaped like round 3's failure: embedded
+    tracebacks and duplicated probe diagnostics in every secondary."""
+    probe = {"tpu_unavailable": True,
+             "attempts": [{"attempt": i, "rc": "timeout",
+                           "stderr_tail": "x" * 800} for i in range(2)]}
+    sec = {}
+    for name in CONFIGS:
+        sec[name] = {
+            "metric": f"bench_{name}_failed", "value": 0.0,
+            "unit": "error", "vs_baseline": 0.0,
+            "extra": {"error": "E" * 500, "traceback": "T" * 1500,
+                      "tpu_probe": probe},
+        }
+    return {
+        "metric": "llama_train_cpu_smoke_tokens_per_sec",
+        "value": 1234.5, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+        "extra": {"platform": "cpu", "n_chips": 1, "params": 10 ** 9,
+                  "step_ms": 10.0, "loss": 2.5, "tpu_probe": probe,
+                  "op_summary": {"top_ops": [{"name": "o" * 60}] * 8},
+                  "secondary": sec},
+    }
+
+
+def test_compact_line_contract(tmp_path, monkeypatch):
+    """The driver-facing line must stay < 2KB and parseable even when
+    every secondary fails with a full traceback; full diagnostics land
+    in BENCH_DETAILS.json."""
+    import bench
+
+    details = tmp_path / "BENCH_DETAILS.json"
+    monkeypatch.setattr(bench, "DETAILS_PATH", str(details))
+    line = bench._compact_line(_fat_result())
+    assert len(line) < 2048, len(line)
+    parsed = json.loads(line)
+    assert parsed["metric"] == "llama_train_cpu_smoke_tokens_per_sec"
+    assert parsed["value"] == 1234.5
+    # secondaries survive compaction with truncated errors
+    sec = parsed["extra"]["secondary"]
+    assert set(sec) == set(CONFIGS)
+    for row in sec.values():
+        assert len(row.get("error", "")) <= 120
+    # full diagnostics preserved in the side file
+    full = json.loads(details.read_text())
+    assert full["extra"]["secondary"]["moe"]["extra"]["traceback"] == \
+        "T" * 1500
+
+
+def test_compact_line_headline_error(tmp_path, monkeypatch):
+    """A failed headline must carry its own truncated diagnostics on the
+    printed line (round-3 regression: only secondaries kept errors)."""
+    import bench
+
+    monkeypatch.setattr(bench, "DETAILS_PATH",
+                        str(tmp_path / "BENCH_DETAILS.json"))
+    r = {"metric": "bench_llama_failed", "value": 0.0, "unit": "error",
+         "vs_baseline": 0.0,
+         "extra": {"rc": 1, "stderr": "S" * 900,
+                   "secondary": {"mamba": {
+                       "metric": "bench_mamba_timeout", "value": 0.0,
+                       "unit": "error", "extra": {"timeout_s": 420}}}}}
+    parsed = json.loads(bench._compact_line(r))
+    assert parsed["extra"]["error"] == "S" * 120
+    assert parsed["extra"]["secondary"]["mamba"]["error"] == \
+        "timeout after 420s"
+
+
+def test_compact_line_healthy_result(tmp_path, monkeypatch):
+    """A green TPU-shaped result keeps its headline scalars."""
+    import bench
+
+    monkeypatch.setattr(bench, "DETAILS_PATH",
+                        str(tmp_path / "BENCH_DETAILS.json"))
+    r = {"metric": "llama876m_train_tokens_per_sec_per_chip",
+         "value": 21083.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+         "extra": {"platform": "tpu", "n_chips": 1, "mfu_est": 0.563,
+                   "step_ms": 388.0,
+                   "secondary": {"infer": {"metric": "infer_p50_ttft_ms",
+                                           "value": 12.0, "unit": "ms",
+                                           "vs_baseline": 1.0,
+                                           "extra": {"platform": "tpu"}}}}}
+    parsed = json.loads(bench._compact_line(r))
+    assert parsed["extra"]["mfu_est"] == 0.563
+    assert parsed["extra"]["secondary"]["infer"]["value"] == 12.0
+    assert "error" not in parsed["extra"]["secondary"]["infer"]
