@@ -1,4 +1,4 @@
-from . import dtype, functional, initializer, random
+from . import dtype, functional, initializer, meta, random
 from .functional import (
     bind_params,
     extract_buffers,

@@ -1,0 +1,77 @@
+"""Abstract ("meta") parameter initialization.
+
+Parity: ``paddle.LazyGuard`` (upstream: python/paddle/nn/initializer/
+lazy_init.py) — construct a Layer tree without allocating parameter
+storage, so a 70B-parameter model can be *described* on a host that could
+never hold it.
+
+TPU-native design: the placeholder is ``jax.ShapeDtypeStruct``, which
+every JAX AOT entry point (``jax.eval_shape``, ``jit(...).lower``)
+accepts directly. A meta-constructed model can therefore be lowered and
+compiled against a ``jax.sharding.Mesh`` — per-device HBM planning via
+``compiled.memory_analysis()`` — with zero bytes of parameter memory,
+where the reference's LazyGuard only defers to a later ``initialize()``.
+The ``init_fn`` each Parameter keeps means the tree can still be
+materialized later (``materialize``), matching LazyInit's contract.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from . import dtype as dtype_mod
+from . import initializer as init_mod
+from .module import Layer
+from .parameter import Parameter
+
+_ACTIVE = [False]
+
+
+def in_meta_init() -> bool:
+    return _ACTIVE[0]
+
+
+@contextlib.contextmanager
+def meta_init():
+    """Inside this context, ``Layer.create_parameter`` produces
+    Parameters whose ``.value`` is a ``jax.ShapeDtypeStruct`` — no
+    initializer runs, no memory is allocated. Buffers (rope caches,
+    norm running stats) stay concrete: they are small and often
+    computed, not initialized."""
+    orig = Layer.create_parameter
+
+    def create_abstract(self, shape, dtype=None, default_initializer=None,
+                        is_bias=False, spec=None, name=None):
+        dt = dtype_mod.convert_dtype(dtype or self._dtype)
+        default = (init_mod.Constant(0.0) if is_bias
+                   else init_mod.XavierNormal())
+        init = init_mod.resolve(default_initializer, default)
+        value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape), dt)
+        return Parameter(value, name=name, spec=spec, init_fn=init)
+
+    Layer.create_parameter = create_abstract
+    _ACTIVE[0] = True
+    try:
+        yield
+    finally:
+        Layer.create_parameter = orig
+        _ACTIVE[0] = False
+
+
+def is_abstract(value) -> bool:
+    return isinstance(value, jax.ShapeDtypeStruct)
+
+
+def materialize(layer: Layer, seed: int = 0) -> None:
+    """Run the kept ``init_fn`` for every abstract Parameter (parity:
+    LazyInit's deferred ``initialize()``)."""
+    key = jax.random.PRNGKey(seed)
+    for _, p in layer.named_parameters():
+        if is_abstract(p.value):
+            if p.init_fn is None:
+                raise RuntimeError(
+                    f"meta parameter {p.name!r} has no init_fn")
+            key, sub = jax.random.split(key)
+            p.value = p.init_fn(sub, tuple(p.value.shape), p.value.dtype)

@@ -240,7 +240,12 @@ class Layer:
         dt = dtype_mod.convert_dtype(dtype)
         for _, p in self.named_parameters():
             if dtype_mod.is_floating_dtype(p.value.dtype):
-                p.value = p.value.astype(dt)
+                if isinstance(p.value, jax.ShapeDtypeStruct):
+                    # meta-initialized (core.meta): recast the abstract
+                    # placeholder; nothing to allocate
+                    p.value = jax.ShapeDtypeStruct(p.value.shape, dt)
+                else:
+                    p.value = p.value.astype(dt)
         for layer in self.sublayers(include_self=True):
             for bname, buf in list(layer._buffers.items()):
                 if buf is not None and dtype_mod.is_floating_dtype(buf.dtype):

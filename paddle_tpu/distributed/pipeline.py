@@ -495,11 +495,19 @@ class PipelineLayer(Layer):
         self._stacked_names = []
         for name, p in protos:
             init = p.init_fn or I.XavierNormal()
-            vals = [p.value]
-            for _ in range(num_layers - 1):
-                key = random_mod.next_rng_key("params")
-                vals.append(init(key, p.shape, p.dtype))
-            stacked = jnp.stack(vals, axis=0)
+            if isinstance(p.value, jax.ShapeDtypeStruct):
+                # meta-initialized prototype (core.meta.meta_init): the
+                # stacked trunk stays abstract — 80×70B-scale layers
+                # describable without allocating a byte (AOT memory
+                # planning path)
+                stacked = jax.ShapeDtypeStruct(
+                    (num_layers,) + tuple(p.value.shape), p.value.dtype)
+            else:
+                vals = [p.value]
+                for _ in range(num_layers - 1):
+                    key = random_mod.next_rng_key("params")
+                    vals.append(init(key, p.shape, p.dtype))
+                stacked = jnp.stack(vals, axis=0)
             spec = ("pp",) + tuple(
                 p.spec if p.spec is not None else [None] * p.ndim
             )
@@ -684,7 +692,7 @@ class PipelineTrainStep:
     """
 
     def __init__(self, module: PipelineModule, optimizer, mesh: Mesh,
-                 strategy=None, loss_fn=None):
+                 strategy=None, loss_fn=None, abstract: bool = False):
         self.module = module
         self.optimizer = optimizer
         self.mesh = mesh
@@ -716,9 +724,18 @@ class PipelineTrainStep:
         # shard_map handles the pp axis manually, GSPMD propagates the
         # rest through it (the trunk's stacked leading dim carries the
         # "pp" spec entry from PipelineLayer, so trunk weights live
-        # pre-sharded per stage too)
-        from .sharding import _filter_spec_for_mesh
+        # pre-sharded per stage too). With strategy.sharding stage>=3 the
+        # ZeRO-3 fsdp axis is folded in exactly as TrainStep does
+        # (param_partition_spec), so stage-3×tp×pp composes.
+        from .sharding import _filter_spec_for_mesh, param_partition_spec
 
+        use_zero3 = (
+            strategy is not None
+            and getattr(strategy, "sharding", False)
+            and getattr(strategy, "sharding_stage", 0) >= 3
+            and "fsdp" in mesh.shape and mesh.shape["fsdp"] > 1
+        )
+        self.abstract = abstract
         self.param_shardings = {}
         for n in self.params:
             # trunk params appear in named_parameters() under the same
@@ -728,11 +745,67 @@ class PipelineTrainStep:
             if spec is None:
                 spec = (None,) * jnp.ndim(self.params[n])
             spec = _filter_spec_for_mesh(tuple(spec), mesh)
-            sh = NamedSharding(mesh, P(*spec))
+            if use_zero3:
+                pspec = param_partition_spec(
+                    n, tuple(self.params[n].shape), spec, strategy)
+            else:
+                pspec = P(*spec)
+            sh = NamedSharding(mesh, pspec)
             self.param_shardings[n] = sh
-            self.params[n] = jax.device_put(self.params[n], sh)
-        self.opt_state = optimizer.init(self.params)
+            if abstract:
+                v = self.params[n]
+                self.params[n] = jax.ShapeDtypeStruct(
+                    tuple(v.shape), v.dtype, sharding=sh)
+            else:
+                self.params[n] = jax.device_put(self.params[n], sh)
+        if abstract:
+            # mirror the eager path's sharding semantics: zeros_like on a
+            # committed array inherits its sharding, so any state leaf
+            # shaped like its parameter gets the parameter's sharding
+            state_shape = jax.eval_shape(optimizer.init, self.params)
+
+            def _attach(name, leaf):
+                sh = self.param_shardings.get(name)
+                if sh is not None and tuple(leaf.shape) == tuple(
+                        self.params[name].shape):
+                    return jax.ShapeDtypeStruct(
+                        tuple(leaf.shape), leaf.dtype, sharding=sh)
+                return jax.ShapeDtypeStruct(
+                    tuple(leaf.shape), leaf.dtype,
+                    sharding=NamedSharding(mesh, P()))
+
+            self.opt_state = {"step": jax.ShapeDtypeStruct(
+                tuple(state_shape["step"].shape), state_shape["step"].dtype,
+                sharding=NamedSharding(mesh, P()))}
+            self.opt_state["slots"] = {
+                n: {k: _attach(n, v) for k, v in slots.items()}
+                for n, slots in state_shape["slots"].items()}
+            if "master" in state_shape:
+                self.opt_state["master"] = {
+                    n: _attach(n, v)
+                    for n, v in state_shape["master"].items()}
+        else:
+            self.opt_state = optimizer.init(self.params)
         self._step = jax.jit(self._make_step())
+
+    def lower(self, x_shapes, aux_shapes):
+        """AOT-lower the pipelined step with abstract inputs (use with
+        ``abstract=True``); ``.compile().memory_analysis()`` yields the
+        per-device byte plan for configs larger than host memory."""
+        from .sharding import mesh_context
+
+        def _sds(v, shard_batch):
+            entries = [None] * len(v.shape)
+            if shard_batch and len(v.shape) and "dp" in self.mesh.shape:
+                entries[0] = "dp"
+            return jax.ShapeDtypeStruct(
+                tuple(v.shape), v.dtype,
+                sharding=NamedSharding(self.mesh, P(*entries)))
+
+        x = jax.tree_util.tree_map(lambda v: _sds(v, True), x_shapes)
+        aux = jax.tree_util.tree_map(lambda v: _sds(v, True), aux_shapes)
+        with mesh_context(self.mesh):
+            return self._step.lower(self.params, self.opt_state, x, aux)
 
     def _seq_param_names(self, entries):
         names = set()
@@ -754,10 +827,23 @@ class PipelineTrainStep:
             with bind_params(module, first_params):
                 return module._apply_seq(module.pre, x_mb)
 
+        # strategy.recompute → per-LAYER jax.checkpoint inside the chunk
+        # scan. The chunk-level remat in pipeline_1f1b_step alone is not
+        # enough at scale: the chunk's backward re-materializes every
+        # layer's internals at once (attention scores, MLP intermediates
+        # for all layers_per_stage layers live simultaneously). Nesting a
+        # checkpoint per scanned layer caps the peak at one layer's
+        # internals + the chunk's layer-boundary activations — the
+        # memory shape the reference's per-layer RecomputeLayer gives its
+        # pipeline (fleet.meta_parallel pp_layers + recompute).
+        per_layer_remat = bool(getattr(self.strategy, "recompute", False))
+        apply_one = (jax.checkpoint(module.trunk._apply_one)
+                     if per_layer_remat else module.trunk._apply_one)
+
         def stage_fn(chunk_params, h):
             # chunk leaves: [per_chunk, ...] — scan the prototype over them
             def one(carry, layer_params):
-                return module.trunk._apply_one(layer_params, carry), None
+                return apply_one(layer_params, carry), None
 
             out, _ = jax.lax.scan(one, h, chunk_params)
             return out
@@ -840,6 +926,10 @@ class PipelineTrainStep:
     def run(self, x, aux):
         from .sharding import mesh_context
 
+        if self.abstract:
+            raise RuntimeError(
+                "PipelineTrainStep(abstract=True) holds no real "
+                "parameters; use lower() for AOT compilation")
         with mesh_context(self.mesh):
             self.params, self.opt_state, loss = self._step(
                 self.params, self.opt_state, x, aux)

@@ -87,37 +87,56 @@ class TrainStep:
         batch_seq_axis: Optional[int] = 1,
         donate: bool = True,
         rng_seed: int = 0,
+        abstract: bool = False,
     ):
+        """``abstract=True`` builds the full sharded step WITHOUT
+        materializing parameters or optimizer state — params may be
+        ``jax.ShapeDtypeStruct`` (core.meta.meta_init). Use ``lower()``
+        for AOT compilation / per-device memory planning of configs far
+        larger than host memory (the 70B north-star path); ``run()`` is
+        unavailable."""
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.strategy = strategy or DistributedStrategy()
         self.loss_fn = loss_fn
         self.batch_seq_axis = batch_seq_axis
+        self.abstract = abstract
 
         self._param_objs = extract_param_objs(model, trainable_only=True)
         self.param_shardings = _param_shardings(
             self._param_objs, mesh, self.strategy
         )
-        # place params
-        self.params = {
-            n: jax.device_put(p.value, self.param_shardings[n])
-            for n, p in self._param_objs.items()
-        }
+        if abstract:
+            self.params = {
+                n: (p.value if isinstance(p.value, jax.ShapeDtypeStruct)
+                    else jax.ShapeDtypeStruct(
+                        tuple(p.value.shape), p.value.dtype))
+                for n, p in self._param_objs.items()
+            }
+        else:
+            # place params
+            self.params = {
+                n: jax.device_put(p.value, self.param_shardings[n])
+                for n, p in self._param_objs.items()
+            }
         # sharded optimizer state, created on-device under jit
         state_shape = jax.eval_shape(optimizer.init, self.params)
         self.state_shardings = _state_shardings(
             state_shape, self._param_objs, mesh, self.strategy
         )
-        with mesh_context(mesh):
-            self.opt_state = jax.jit(
-                optimizer.init, out_shardings=self.state_shardings
-            )(self.params)
+        if abstract:
+            self.opt_state = state_shape
+        else:
+            with mesh_context(mesh):
+                self.opt_state = jax.jit(
+                    optimizer.init, out_shardings=self.state_shardings
+                )(self.params)
 
-        # keep the Layer tree pointing at the live arrays: device_put may
-        # alias the original buffers, and step donation would otherwise
-        # leave Parameters referencing deleted arrays
-        self.sync_to_model()
+            # keep the Layer tree pointing at the live arrays: device_put
+            # may alias the original buffers, and step donation would
+            # otherwise leave Parameters referencing deleted arrays
+            self.sync_to_model()
 
         self.step_count = 0
         self._rng_key = jax.random.PRNGKey(rng_seed)
@@ -221,7 +240,38 @@ class TrainStep:
             out[k] = jax.device_put(v, sh)
         return out
 
+    def lower(self, batch_shapes: Dict):
+        """AOT-lower the full sharded train step against abstract inputs.
+
+        ``batch_shapes``: dict of arrays or ShapeDtypeStructs. Returns a
+        ``jax.stages.Lowered``; ``.compile().memory_analysis()`` gives
+        the per-device argument/temp byte plan (parity: the memory
+        estimation pass of the reference's static auto-parallel engine,
+        distributed/auto_parallel/static/engine.py)."""
+        batch = {
+            k: jax.ShapeDtypeStruct(
+                tuple(v.shape), v.dtype,
+                sharding=NamedSharding(
+                    self.mesh,
+                    batch_spec(
+                        len(v.shape),
+                        self.batch_seq_axis if len(v.shape) > 1 else None,
+                        self.strategy,
+                    ),
+                ),
+            )
+            for k, v in batch_shapes.items()
+        }
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        with mesh_context(self.mesh):
+            return self._step.lower(self.params, self.opt_state, batch, rng)
+
     def run(self, batch: Dict, sharded: bool = False):
+        if self.abstract:
+            raise RuntimeError(
+                "TrainStep(abstract=True) holds no real parameters; "
+                "use lower() for AOT compilation, or rebuild without "
+                "abstract for execution")
         if not sharded:
             batch = self.shard_batch(batch)
         self._rng_key, sub = jax.random.split(self._rng_key)
