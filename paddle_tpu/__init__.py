@@ -15,6 +15,7 @@ from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401
+from . import errors  # noqa: F401
 from . import fft  # noqa: F401
 from . import generation  # noqa: F401
 from . import flags  # noqa: F401

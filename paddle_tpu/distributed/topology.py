@@ -209,6 +209,12 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     need = dp * pp * fsdp * ep * sep * tp
+    from ..errors import PreconditionNotMetError, enforce_ge
+
+    enforce_ge(len(devices), need,
+               f"available devices (mesh dp={dp} pp={pp} fsdp={fsdp} "
+               f"ep={ep} sep={sep} tp={tp} needs {need})",
+               PreconditionNotMetError)
     grid = np.array(devices[:need]).reshape(dp, pp, fsdp, ep, sep, tp)
     return Mesh(grid, AXIS_ORDER)
 

@@ -1,24 +1,133 @@
-"""paddle.fft parity surface over jnp.fft."""
+"""paddle.fft — discrete Fourier transforms.
+
+Parity: ``paddle.fft`` (upstream: python/paddle/fft.py) — the full
+fft/ifft/rfft/irfft/hfft/ihfft family in 1-D/2-D/N-D forms plus the
+helper functions, with paddle's exact signatures: ``x`` (not numpy's
+``a``) as the array argument, ``n``/``s`` length overrides, ``axis``/
+``axes`` placement, and ``norm`` in {"backward", "ortho", "forward"}
+(paddle's default "backward" == numpy/jnp's default None scaling).
+
+TPU-native notes: everything lowers to XLA's FFT HLO (ducc on CPU,
+the TPU FFT expansion on device); wrappers add paddle's argument
+validation (positive lengths, known norm) and otherwise stay
+zero-overhead pass-throughs, so there is no penalty versus calling
+``jnp.fft`` directly inside jit.
+"""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-fft = jnp.fft.fft
-ifft = jnp.fft.ifft
-fft2 = jnp.fft.fft2
-ifft2 = jnp.fft.ifft2
-fftn = jnp.fft.fftn
-ifftn = jnp.fft.ifftn
-rfft = jnp.fft.rfft
-irfft = jnp.fft.irfft
-rfft2 = jnp.fft.rfft2
-irfft2 = jnp.fft.irfft2
-rfftn = jnp.fft.rfftn
-irfftn = jnp.fft.irfftn
-hfft = jnp.fft.hfft
-ihfft = jnp.fft.ihfft
-fftfreq = jnp.fft.fftfreq
-rfftfreq = jnp.fft.rfftfreq
-fftshift = jnp.fft.fftshift
-ifftshift = jnp.fft.ifftshift
+from .errors import InvalidArgumentError
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise InvalidArgumentError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', "
+            f"'backward' or 'ortho'")
+    return norm
+
+
+def _check_n(n):
+    if n is not None and n <= 0:
+        raise InvalidArgumentError(
+            f"Invalid FFT argument n({n}), it should be positive.")
+    return n
+
+
+def _check_s(s):
+    if s is not None:
+        s = tuple(int(v) for v in s)
+        if any(v <= 0 for v in s):
+            raise InvalidArgumentError(
+                f"Invalid FFT argument s({s}), all entries should be "
+                "positive.")
+    return s
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(x, _check_n(n), axis, _check_norm(norm))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(x, _check_s(s), axes, _check_norm(norm))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(x, _check_s(s), axes, _check_norm(norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(x, _check_s(s), axes, _check_norm(norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(x, _check_s(s), axes, _check_norm(norm))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(x, _check_s(s), axes, _check_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(x, _check_s(s), axes, _check_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(x, _check_s(s), axes, _check_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(x, _check_s(s), axes, _check_norm(norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    _check_n(n)
+    out = jnp.fft.fftfreq(n, d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    _check_n(n)
+    out = jnp.fft.rfftfreq(n, d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes)

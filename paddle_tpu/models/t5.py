@@ -12,8 +12,11 @@ fuses into the logits einsum); cross-attention carries no bias and is
 flash-eligible. The bias itself is computed ONCE per stack from a static
 bucket table (host-free: jnp ops on broadcasted iotas) and reused by
 every layer, exactly the reference's shared `relative_attention_bias`.
-Decoding re-uses the encoder output; the decoder is re-run per step on
-the growing prefix (AOT-bucketed decode lives in the inference engine).
+Decoding re-uses the encoder output; ``decode_step`` is a real
+incremental path — per-layer self-attention KV caches plus cached
+encoder cross-attention K/V, one token per step at fixed shapes
+(``decode_step`` / ``_generate_cached`` below, tested in
+tests/test_t5.py). Re-running the full prefix is never required.
 """
 
 from __future__ import annotations

@@ -1,0 +1,53 @@
+"""Error taxonomy (paddle/common/errors.h parity): typed categories,
+builtin compatibility, enforce helpers, and adoption at raise sites."""
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import errors
+
+
+def test_categories_subclass_builtins():
+    assert issubclass(errors.InvalidArgumentError, ValueError)
+    assert issubclass(errors.NotFoundError, FileNotFoundError)
+    assert issubclass(errors.OutOfRangeError, IndexError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+    assert issubclass(errors.PermissionDeniedError, PermissionError)
+    assert issubclass(errors.ExecutionTimeoutError, TimeoutError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+    for n in errors.__all__:
+        obj = getattr(errors, n)
+        if isinstance(obj, type) and issubclass(obj, errors.Error):
+            assert obj is errors.Error or obj.code != "UNKNOWN"
+
+
+def test_enforce_helpers():
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.InvalidArgumentError, match="INVALID"):
+        errors.enforce(False, "nope")
+    with pytest.raises(errors.InvalidArgumentError, match="expected 3"):
+        errors.enforce_eq(2, 3, "count")
+    with pytest.raises(errors.InvalidArgumentError, match="must be > 0"):
+        errors.enforce_gt(0, 0, "n")
+    errors.enforce_ge(1, 1)
+    with pytest.raises(errors.InvalidArgumentError, match="one of"):
+        errors.enforce_in("x", ("a", "b"), "mode")
+    errors.enforce_shape_match((2, 3), (2, None))
+    with pytest.raises(errors.InvalidArgumentError, match="shape"):
+        errors.enforce_shape_match((2, 3), (2, 4))
+    with pytest.raises(errors.PreconditionNotMetError):
+        errors.enforce(False, "state", errors.PreconditionNotMetError)
+
+
+def test_adopted_sites():
+    # fft validation raises the typed error (still a ValueError)
+    import jax.numpy as jnp
+
+    with pytest.raises(errors.InvalidArgumentError):
+        pt.fft.fft(jnp.ones(4), norm="bogus")
+    # build_mesh with too few devices: PreconditionNotMet, coded message
+    from paddle_tpu import distributed as dist
+
+    with pytest.raises(errors.PreconditionNotMetError,
+                       match="PRECONDITION_NOT_MET"):
+        dist.build_mesh(tp=512)

@@ -1,0 +1,84 @@
+"""paddle.fft parity: signatures (x/n/axis/norm keywords), norm modes,
+length overrides, validation — numerics vs numpy.fft."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import fft
+
+
+@pytest.fixture
+def x():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((4, 16)) + 1j * rng.standard_normal((4, 16))
+
+
+def test_fft_keywords_and_norms(x):
+    for norm in ("backward", "ortho", "forward"):
+        got = fft.fft(x=jnp.asarray(x), n=16, axis=-1, norm=norm)
+        want = np.fft.fft(x, n=16, axis=-1, norm=norm)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_rfft_irfft_roundtrip():
+    r = np.random.default_rng(1).standard_normal((3, 32))
+    spec = fft.rfft(x=jnp.asarray(r), norm="ortho")
+    np.testing.assert_allclose(
+        np.asarray(spec), np.fft.rfft(r, norm="ortho"), atol=1e-5)
+    back = fft.irfft(spec, n=32, norm="ortho")
+    np.testing.assert_allclose(np.asarray(back), r, atol=1e-5)
+
+
+def test_fft_n_truncates_and_pads(x):
+    np.testing.assert_allclose(
+        np.asarray(fft.fft(jnp.asarray(x), n=8)),
+        np.fft.fft(x, n=8), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fft.fft(jnp.asarray(x), n=32)),
+        np.fft.fft(x, n=32), atol=1e-5)
+
+
+def test_2d_and_nd(x):
+    np.testing.assert_allclose(
+        np.asarray(fft.fft2(jnp.asarray(x), s=(4, 8), norm="forward")),
+        np.fft.fft2(x, s=(4, 8), norm="forward"), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fft.ifftn(jnp.asarray(x), axes=(0, 1))),
+        np.fft.ifftn(x, axes=(0, 1)), atol=1e-5)
+    r = np.random.default_rng(2).standard_normal((4, 6, 8))
+    np.testing.assert_allclose(
+        np.asarray(fft.rfftn(jnp.asarray(r), s=(6, 8), axes=(1, 2))),
+        np.fft.rfftn(r, s=(6, 8), axes=(1, 2)), atol=1e-5)
+
+
+def test_hfft_ihfft():
+    r = np.random.default_rng(3).standard_normal((5, 9))
+    np.testing.assert_allclose(
+        np.asarray(fft.ihfft(jnp.asarray(r), norm="ortho")),
+        np.fft.ihfft(r, norm="ortho"), atol=1e-5)
+    c = np.fft.ihfft(r)
+    np.testing.assert_allclose(
+        np.asarray(fft.hfft(jnp.asarray(c), n=9)),
+        np.fft.hfft(c, n=9), atol=1e-5)
+
+
+def test_helpers_and_dtype():
+    f = fft.fftfreq(8, d=0.5, dtype="float64")
+    np.testing.assert_allclose(np.asarray(f), np.fft.fftfreq(8, 0.5))
+    rf = fft.rfftfreq(8, d=2.0)
+    np.testing.assert_allclose(np.asarray(rf), np.fft.rfftfreq(8, 2.0))
+    a = jnp.arange(8.0)
+    np.testing.assert_allclose(
+        np.asarray(fft.fftshift(a)), np.fft.fftshift(np.arange(8.0)))
+    np.testing.assert_allclose(
+        np.asarray(fft.ifftshift(fft.fftshift(a))), np.arange(8.0))
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="[Nn]orm"):
+        fft.fft(jnp.ones(4), norm="bogus")
+    with pytest.raises(ValueError, match="positive"):
+        fft.fft(jnp.ones(4), n=0)
+    with pytest.raises(ValueError, match="positive"):
+        fft.fft2(jnp.ones((4, 4)), s=(0, 4))
