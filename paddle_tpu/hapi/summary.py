@@ -60,6 +60,13 @@ def _collect(net: Layer, input_spec, dtypes, kwargs):
                         np.prod(p.shape)
                         for p in sub._parameters.values()
                         if p is not None)),
+                    # MAC-bearing params only (weight matrices/filters,
+                    # ndim>=2): paddle.flops counts 2*tokens*in*out and
+                    # excludes bias vectors from the multiply count
+                    "mac_params": int(sum(
+                        np.prod(p.shape)
+                        for p in sub._parameters.values()
+                        if p is not None and len(p.shape) >= 2)),
                     "in": _shapes_of(inputs),
                 })
                 return out
@@ -129,17 +136,17 @@ def _rule(*type_names):
 @_rule("Linear", "ColumnParallelLinear", "RowParallelLinear")
 def _linear_flops(rec):
     out = rec["out"][0]
-    params = rec["params"]
-    # 2 * tokens * in * out ≈ 2 * prod(out_shape[:-1]) * weight_size
+    # 2 * tokens * in * out: weight MACs only, bias add excluded
+    # (paddle.flops accounting)
     tokens = int(np.prod(out[:-1])) if len(out) > 1 else 1
-    return 2 * tokens * params
+    return 2 * tokens * rec["mac_params"]
 
 
 @_rule("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose")
 def _conv_flops(rec):
     out = rec["out"][0]
     spatial = int(np.prod(out[2:])) * out[0]
-    return 2 * spatial * rec["params"]
+    return 2 * spatial * rec["mac_params"]
 
 
 @_rule("Embedding", "VocabParallelEmbedding")

@@ -107,12 +107,17 @@ class ModelAverage:
 
 class EMA:
     """Exponential moving average of parameters (parity:
-    paddle.static.ExponentialMovingAverage, with the same bias-corrected
-    ``thres_steps``-free decay schedule: decay_t = min(decay,
-    (1+t)/(10+t)))."""
+    paddle.static.ExponentialMovingAverage). Like the reference, the
+    constant ``decay`` is used unless ``thres_steps`` is enabled, in
+    which case the warmup schedule decay_t = min(decay, (1+t)/(10+t))
+    applies — reference semantics where averaging ramps up from step
+    0 instead of starting at full decay."""
 
-    def __init__(self, decay=0.999, zero_debias=True):
+    def __init__(self, decay=0.999, thres_steps=None, zero_debias=True):
         self.decay = float(decay)
+        # non-None → warmup schedule (the reference takes a step
+        # Variable; here the internal step counter plays that role)
+        self.thres_steps = thres_steps
         self.zero_debias = zero_debias
 
     def init(self, params):
@@ -129,7 +134,10 @@ class EMA:
     def update(self, state, params):
         step = state["step"] + 1
         t = step.astype(jnp.float32)
-        decay = jnp.minimum(self.decay, (1.0 + t) / (10.0 + t))
+        if self.thres_steps is not None:
+            decay = jnp.minimum(self.decay, (1.0 + t) / (10.0 + t))
+        else:
+            decay = jnp.asarray(self.decay, jnp.float32)
 
         def upd(e, p):
             return decay * e + (1.0 - decay) * p.astype(jnp.float32)

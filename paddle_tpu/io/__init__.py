@@ -153,6 +153,12 @@ class RandomSampler(Sampler):
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator  # int seed or None
+        # persistent generator state: an int seed fixes the STREAM, not
+        # every epoch's permutation — successive __iter__ calls must
+        # reshuffle (reference semantics: paddle's generator state
+        # advances across epochs)
+        self._rng = np.random.default_rng(
+            generator if isinstance(generator, int) else None)
 
     @property
     def num_samples(self):
@@ -160,8 +166,7 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        seed = self.generator if isinstance(self.generator, int) else None
-        rng = np.random.default_rng(seed)
+        rng = self._rng
         if self.replacement:
             return iter(rng.integers(0, n, self.num_samples).tolist())
         if self.num_samples > n:

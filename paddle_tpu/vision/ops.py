@@ -99,7 +99,15 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """Parity: paddle.vision.ops.roi_align. x: [N, C, H, W]; boxes
     [K, 4] in input-image coords; boxes_num [N] gives each image's ROI
-    count (boxes are listed image-major)."""
+    count (boxes are listed image-major).
+
+    Documented deviation: with ``sampling_ratio=-1`` the reference picks
+    ``ceil(roi/output)`` per ROI; XLA's static shapes forbid per-ROI
+    grids, so ONE adaptive ratio — the max over the batch's ROIs,
+    capped at 8 — is used for all ROIs (each bin sampled at least as
+    densely as the reference, values can differ slightly for batches of
+    mixed ROI sizes), and under tracing the fallback is a fixed 2. Pass
+    an explicit ``sampling_ratio`` for exact reference numerics."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size

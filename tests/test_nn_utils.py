@@ -182,11 +182,13 @@ class TestSummaryFlops:
         net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
                             nn.Linear(16, 4))
         got = pt.flops(net, (2, 8))
-        expect = 2 * 2 * (8 * 16 + 16) + 2 * 2 * (16 * 4 + 4)
+        # paddle.flops counts weight MACs only (2*tokens*in*out); bias
+        # adds are excluded (round-3 advisor fix)
+        expect = 2 * 2 * (8 * 16) + 2 * 2 * (16 * 4)
         assert got == expect
         conv = nn.Conv2D(3, 8, 3, padding=1)
         got_c = pt.flops(conv, (1, 3, 16, 16))
-        expect_c = 2 * 16 * 16 * (8 * 3 * 9 + 8)
+        expect_c = 2 * 16 * 16 * (8 * 3 * 9)
         assert got_c == expect_c
 
 
@@ -247,7 +249,8 @@ class TestReviewRound3Fixes:
         net = nn.Sequential(nn.Linear(8, 4))
         info = pt.summary(net, [2, 8])     # paddle's canonical LIST form
         assert info["total_params"] == 8 * 4 + 4
-        assert pt.flops(net, [2, 8]) == 2 * 2 * (8 * 4 + 4)
+        # weight MACs only — bias excluded from the multiply count
+        assert pt.flops(net, [2, 8]) == 2 * 2 * (8 * 4)
 
     def test_renorm_negative_axis(self):
         x = np.random.default_rng(0).normal(size=(4, 5)).astype(
