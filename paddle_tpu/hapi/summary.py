@@ -67,6 +67,7 @@ def _collect(net: Layer, input_spec, dtypes, kwargs):
                         np.prod(p.shape)
                         for p in sub._parameters.values()
                         if p is not None and len(p.shape) >= 2)),
+                    "data_format": getattr(sub, "data_format", "NCHW"),
                     "in": _shapes_of(inputs),
                 })
                 return out
@@ -145,7 +146,10 @@ def _linear_flops(rec):
 @_rule("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose")
 def _conv_flops(rec):
     out = rec["out"][0]
-    spatial = int(np.prod(out[2:])) * out[0]
+    # batch * spatial positions, layout-aware (channels sit at index 1
+    # for NCHW-family formats, last otherwise)
+    ch_axis = 1 if rec.get("data_format", "NCHW").startswith("NC") else -1
+    spatial = int(np.prod(out)) // out[ch_axis]
     return 2 * spatial * rec["mac_params"]
 
 

@@ -190,6 +190,9 @@ class TestSummaryFlops:
         got_c = pt.flops(conv, (1, 3, 16, 16))
         expect_c = 2 * 16 * 16 * (8 * 3 * 9)
         assert got_c == expect_c
+        # layout-aware spatial count: NHWC must match NCHW
+        conv_nhwc = nn.Conv2D(3, 8, 3, padding=1, data_format="NHWC")
+        assert pt.flops(conv_nhwc, (1, 16, 16, 3)) == expect_c
 
 
 class TestDtypePreservation:
