@@ -55,17 +55,23 @@ def _train_throughput(model, data, loss_fn=None, iters=None, unit_count=0):
 
 
 def bench_moe(tpu_diags):
+    import os
+
     import paddle_tpu as pt
     from paddle_tpu.models import ErnieMoEConfig, ErnieMoEForCausalLM
 
     tpu = _platform() == "tpu"
+    # BENCH_MOE_DROPLESS=1 selects no-token-drop routing (grouped
+    # matmul / EP all-to-all dispatch) instead of the capacity path
+    dropless = os.environ.get("BENCH_MOE_DROPLESS", "0") == "1"
     cfg = (ErnieMoEConfig(
         vocab_size=32000, hidden_size=1024, num_hidden_layers=8,
         num_attention_heads=8, max_position_embeddings=1024,
         num_experts=8, moe_every=2, hidden_dropout_prob=0.0,
-        attention_probs_dropout_prob=0.0)
+        attention_probs_dropout_prob=0.0, moe_dropless=dropless)
         if tpu else ErnieMoEConfig.tiny(
-            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            moe_dropless=dropless))
     batch, seq = (4, 1024) if tpu else (2, 128)
     pt.seed(0)
     model = ErnieMoEForCausalLM(cfg)

@@ -235,35 +235,181 @@ def dropless_moe_apply(x, expert_idx, gates, w1, b1, w2, b2, act):
     return jnp.sum(y * gates[..., None].astype(y.dtype), axis=1)
 
 
+def dropless_moe_ep_apply(xf, gate_weight, w1, b1, w2, b2, act, top_k,
+                          mesh, ep_axis="ep"):
+    """Distributed dropless dispatch over the ``ep`` mesh axis.
+
+    Parity: the reference's ``global_scatter`` → per-expert FFN →
+    ``global_gather`` pipeline (paddle/fluid/operators/collective/
+    global_scatter_op.*, incubate moe) — tokens travel to the shard
+    owning their expert, are processed in ONE contiguous grouped matmul,
+    and travel back.
+
+    TPU-native form (static shapes, one SPMD program):
+      1. route + stable-sort local (token, k) assignments by expert id;
+      2. counts → the per-destination segment sizes; a dense
+         ``lax.all_to_all`` exchanges STATIC per-source slots of
+         N = t_local·top_k rows — every routed token always has a seat,
+         so the exchange is dropless *by construction* (the reference's
+         ragged NCCL alltoallv becomes a fixed-shape ICI collective;
+         ``lax.ragged_all_to_all`` sends only the filled prefixes and is
+         the drop-in TPU bandwidth upgrade, but XLA:CPU has no kernel
+         for it, and CI runs on the CPU mesh);
+      3. received rows re-sort into per-local-expert contiguous groups →
+         ``lax.ragged_dot`` (padding rows ride a zero-weight dummy
+         expert);
+      4. reverse all_to_all returns outputs to the source's sorted
+         positions; unsort; combine with gates.
+
+    xf: [t, m] with the token dim sharded over ``ep_axis`` (t % ep == 0);
+    w1/b1/w2/b2: [E, ...] sharded over ``ep_axis`` on the expert dim.
+    Mesh axes other than ``ep_axis`` stay under GSPMD (shard_map
+    ``axis_names``), so EP composes with dp/fsdp/tp.
+    Returns (y [t, m], aux scalar) with aux computed from GLOBAL routing
+    statistics (pmean over ep).
+    """
+    import functools
+
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape[ep_axis]
+    E = w1.shape[0]
+    if E % ep:
+        raise ValueError(f"num_experts {E} must divide ep degree {ep}")
+    e_loc = E // ep
+
+    def body(x_loc, gw, w1_loc, b1_loc, w2_loc, b2_loc):
+        n = x_loc.shape[0] * top_k
+        logits = x_loc.astype(jnp.float32) @ gw.astype(jnp.float32)
+        expert_idx, gates, _ = _dropless_topk_gating(logits, top_k)
+        # aux from global stats: pmean of per-shard densities == global
+        # means (equal token counts per shard)
+        probs = jax.nn.softmax(logits, axis=-1)
+        mask1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=probs.dtype)
+        density = lax.pmean(jnp.mean(mask1, 0), ep_axis)
+        proxy = lax.pmean(jnp.mean(probs, 0), ep_axis)
+        aux = jnp.sum(density * proxy) * E
+
+        flat_e = expert_idx.reshape(-1)
+        order = jnp.argsort(flat_e, stable=True)
+        sorted_e = flat_e[order]
+        xs = jnp.repeat(x_loc, top_k, axis=0)[order]
+
+        counts = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+        send_sizes = counts.reshape(ep, e_loc).sum(1).astype(jnp.int32)
+        input_offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_sizes)[:-1]])
+
+        # pack destination segments into static per-source slots
+        slot = jnp.arange(n)
+        src_idx = input_offsets[:, None] + slot[None, :]
+        valid = slot[None, :] < send_sizes[:, None]
+        send_buf = jnp.where(
+            valid[..., None], xs[jnp.clip(src_idx, 0, n - 1)], 0.0)
+        recv_buf = lax.all_to_all(send_buf, ep_axis, 0, 0)   # [ep, n, m]
+        cmat = lax.all_to_all(                               # [ep, e_loc]
+            counts.reshape(ep, e_loc), ep_axis, 0, 0)
+
+        b_rows = ep * n
+        buf = recv_buf.reshape(b_rows, -1)
+        vals = jnp.concatenate(
+            [jnp.arange(e_loc), jnp.array([e_loc])]).astype(jnp.int32)
+
+        def block_ids(crow):
+            cnt = jnp.concatenate(
+                [crow, (n - crow.sum())[None]]).astype(jnp.int32)
+            return jnp.repeat(vals, cnt, total_repeat_length=n)
+
+        ids = jax.vmap(block_ids)(cmat).reshape(b_rows)
+        order2 = jnp.argsort(ids, stable=True)
+        inv2 = jnp.argsort(order2, stable=True)
+        xs2 = buf[order2]
+        per_e = cmat.sum(0)
+        gsz = jnp.concatenate(
+            [per_e, (b_rows - per_e.sum())[None]]).astype(jnp.int32)
+
+        w1e = jnp.concatenate(
+            [w1_loc, jnp.zeros((1,) + w1_loc.shape[1:], w1_loc.dtype)])
+        b1e = jnp.concatenate(
+            [b1_loc, jnp.zeros((1,) + b1_loc.shape[1:], b1_loc.dtype)])
+        w2e = jnp.concatenate(
+            [w2_loc, jnp.zeros((1,) + w2_loc.shape[1:], w2_loc.dtype)])
+        b2e = jnp.concatenate(
+            [b2_loc, jnp.zeros((1,) + b2_loc.shape[1:], b2_loc.dtype)])
+
+        h = lax.ragged_dot(xs2, w1e, gsz)
+        h = h + jnp.repeat(b1e, gsz, axis=0, total_repeat_length=b_rows)
+        h = act(h)
+        y2 = lax.ragged_dot(h, w2e, gsz)
+        y2 = y2 + jnp.repeat(b2e, gsz, axis=0, total_repeat_length=b_rows)
+        # padding rows picked up dummy-expert bias: zero them
+        y2 = jnp.where((ids[order2] < e_loc)[:, None], y2, 0.0)
+
+        y_ret = lax.all_to_all(
+            y2[inv2].reshape(ep, n, -1), ep_axis, 0, 0)
+        # row r of the sorted order returned from dest j = e//e_loc at
+        # slot r - input_offsets[j]
+        j_r = (sorted_e // e_loc).astype(jnp.int32)
+        p_r = jnp.arange(n) - input_offsets[j_r]
+        y_sorted = y_ret[j_r, p_r]
+        inv = jnp.argsort(order, stable=True)
+        y = y_sorted[inv].reshape(-1, top_k, y_sorted.shape[-1])
+        return (jnp.sum(y * gates[..., None].astype(y.dtype), axis=1),
+                aux)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis), P(ep_axis),
+                  P(ep_axis)),
+        out_specs=(P(ep_axis), P()),
+        axis_names=frozenset({ep_axis}),
+        check_vma=False,
+    )
+    return f(xf, gate_weight, w1, b1, w2, b2)
+
+
 class DroplessMoELayer(MoELayer):
     """MoELayer with exact (no-drop) routing via grouped matmuls.
 
-    Tradeoff vs the capacity path: no token is ever dropped and no
-    [t, e, c] dispatch tensors exist, but the grouped matmul keeps the
-    expert weights unsharded along the expert dim (ragged_dot's group
-    dim cannot shard under GSPMD), so use the capacity path when
-    ep_degree > 1. last_drop_fraction is always 0 here by construction.
+    Single shard (or ep degree 1): MegaBlocks-style sort + one
+    ``ragged_dot`` per projection, no [t, e, c] dispatch tensors.
+    With an active mesh whose ``ep`` degree > 1: sort-based all-to-all
+    dispatch over the ep axis (``dropless_moe_ep_apply``) — dropless
+    and expert-parallel compose, replacing the round-3 replicated-only
+    constraint. last_drop_fraction is always 0 by construction.
     """
 
-    def __init__(self, *args, **kwargs):
-        # ragged_dot's group dim cannot shard under GSPMD: expert weights
-        # stay REPLICATED (spec None on the expert dim), never "ep" —
-        # otherwise every layer call would all-gather the one tensor EP
-        # exists to shard. Use the capacity MoELayer for ep_degree > 1.
-        kwargs["expert_axis"] = None
-        super().__init__(*args, **kwargs)
-
     def forward(self, x):
+        from .sharding import current_mesh
+
         b, s, m = x.shape
         xf = x.reshape(b * s, m)
-        logits = (xf.astype(jnp.float32) @
-                  self.gate_weight.value.astype(jnp.float32))
-        expert_idx, gates, aux = _dropless_topk_gating(logits, self.top_k)
-        y = dropless_moe_apply(
-            xf, expert_idx, gates,
-            self.experts.w1.value, self.experts.b1.value,
-            self.experts.w2.value, self.experts.b2.value,
-            self.experts.act)
+        mesh = current_mesh()
+        ep = (mesh.shape.get(self.expert_axis, 1)
+              if mesh is not None and self.expert_axis else 1)
+        if ep > 1:
+            if (b * s) % ep:
+                from ..errors import InvalidArgumentError
+
+                raise InvalidArgumentError(
+                    f"dropless EP: token count {b * s} must be "
+                    f"divisible by ep degree {ep}")
+            y, aux = dropless_moe_ep_apply(
+                xf, self.gate_weight.value,
+                self.experts.w1.value, self.experts.b1.value,
+                self.experts.w2.value, self.experts.b2.value,
+                self.experts.act, self.top_k, mesh, self.expert_axis)
+        else:
+            logits = (xf.astype(jnp.float32) @
+                      self.gate_weight.value.astype(jnp.float32))
+            expert_idx, gates, aux = _dropless_topk_gating(
+                logits, self.top_k)
+            y = dropless_moe_apply(
+                xf, expert_idx, gates,
+                self.experts.w1.value, self.experts.b1.value,
+                self.experts.w2.value, self.experts.b2.value,
+                self.experts.act)
         self.last_aux_loss = aux * self.aux_loss_weight
         self.last_drop_fraction = jnp.zeros(())
         return y.reshape(b, s, m), self.last_aux_loss

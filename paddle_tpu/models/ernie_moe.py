@@ -33,6 +33,9 @@ class ErnieMoEConfig(GPTConfig):
     capacity_factor: float = 1.25
     gate: str = "gshard"
     aux_loss_weight: float = 1e-2
+    # dropless (no-token-drop) routing: grouped matmuls single-shard,
+    # sort-based all-to-all dispatch when the mesh has ep>1
+    moe_dropless: bool = False
 
     @classmethod
     def tiny(cls, **kw):
@@ -55,12 +58,23 @@ class ErnieMoEBlock(Layer):
         self.ln_2 = LayerNorm(config.hidden_size, config.layer_norm_epsilon)
         self.use_moe = use_moe
         if use_moe:
-            self.moe = MoELayer(
+            from ..distributed.moe import DroplessMoELayer
+
+            moe_cls = (DroplessMoELayer if config.moe_dropless
+                       else MoELayer)
+            if config.moe_dropless:
+                # dropless routing has no capacity knob; honor the gate
+                # choice through its routing width (switch == top-1)
+                kw = {"top_k": 1 if config.gate == "switch"
+                      else config.top_k}
+            else:
+                kw = {"gate": config.gate, "top_k": config.top_k,
+                      "capacity_factor": config.capacity_factor}
+            self.moe = moe_cls(
                 config.hidden_size, config.num_experts,
-                d_hidden=config.intermediate_size, gate=config.gate,
-                top_k=config.top_k,
-                capacity_factor=config.capacity_factor,
+                d_hidden=config.intermediate_size,
                 aux_loss_weight=config.aux_loss_weight,
+                **kw,
             )
         else:
             self.fc_in = ColumnParallelLinear(

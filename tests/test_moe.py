@@ -198,3 +198,83 @@ def test_dropless_moe_grads():
         assert bool(jnp.all(jnp.isfinite(grad))), name
     assert float(jnp.sum(jnp.abs(g["experts.w1"]))) > 0
     assert float(jnp.sum(jnp.abs(g["gate_weight"]))) > 0
+
+
+def test_dropless_ep_matches_single_shard():
+    """Sort-based all-to-all dispatch over an ep=2 mesh == the
+    single-shard dropless path (round-3 verdict: dropless x EP must
+    compose, parity with global_scatter/global_gather)."""
+    from paddle_tpu.distributed.moe import DroplessMoELayer
+
+    pt.seed(11)
+    layer = DroplessMoELayer(d_model=16, num_experts=4, d_hidden=32,
+                             top_k=2)
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((2, 8, 16)),
+                    jnp.float32)
+    ref, ref_aux = layer(x)  # no active mesh -> single-shard ragged path
+
+    mesh = dist.build_mesh(ep=2)
+    params = extract_params(layer)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    objs = dict(layer.named_parameters())
+    strategy = dist.DistributedStrategy()
+    sharded = {
+        n: jax.device_put(
+            v, NamedSharding(
+                mesh,
+                dist.param_partition_spec(n, v.shape, objs[n].spec,
+                                          strategy)))
+        for n, v in params.items()
+    }
+    # expert weights actually split over ep
+    assert "ep" in str(sharded["experts.w1"].sharding.spec)
+    with mesh_context(mesh):
+        y, aux = jax.jit(
+            lambda p, x: functional_call(layer, p, x))(sharded, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-4)
+
+
+def test_dropless_ep_composes_with_fsdp_and_grads():
+    """dropless EP inside a dp x fsdp x ep x tp mesh: output matches the
+    meshless reference and expert-weight grads flow."""
+    from paddle_tpu.distributed.moe import DroplessMoELayer
+
+    pt.seed(12)
+    layer = DroplessMoELayer(d_model=8, num_experts=4, d_hidden=16,
+                             top_k=2)
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((4, 4, 8)),
+                    jnp.float32)
+    ref, _ = layer(x)
+
+    mesh = dist.build_mesh(fsdp=2, ep=2, tp=2)
+    params = extract_params(layer)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    objs = dict(layer.named_parameters())
+    strategy = dist.DistributedStrategy()
+    sharded = {
+        n: jax.device_put(
+            v, NamedSharding(
+                mesh,
+                dist.param_partition_spec(n, v.shape, objs[n].spec,
+                                          strategy)))
+        for n, v in params.items()
+    }
+    with mesh_context(mesh):
+        y, _ = jax.jit(
+            lambda p, x: functional_call(layer, p, x))(
+                sharded,
+                jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp")))))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss(p):
+            out, aux = functional_call(layer, p, x)
+            return jnp.sum(out ** 2) + aux
+
+        g = jax.jit(jax.grad(loss))(sharded)
+    assert float(jnp.sum(jnp.abs(g["experts.w1"]))) > 0
+    assert float(jnp.sum(jnp.abs(g["gate_weight"]))) > 0
