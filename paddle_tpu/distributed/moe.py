@@ -268,8 +268,6 @@ def dropless_moe_ep_apply(xf, gate_weight, w1, b1, w2, b2, act, top_k,
     Returns (y [t, m], aux scalar) with aux computed from GLOBAL routing
     statistics (pmean over ep).
     """
-    import functools
-
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 

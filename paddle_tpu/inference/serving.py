@@ -283,7 +283,15 @@ class ContinuousBatchingEngine:
         return self._decode_nc
 
     # ---------------- scheduling ----------------
-    def _admit(self):
+    def _admit_dispatch(self):
+        """Dispatch prefill + cache-insert programs for every admissible
+        queued request WITHOUT syncing the host. JAX dispatch is async:
+        the programs queue on the device stream (after any in-flight
+        decode chunk, which donated the caches these inserts consume),
+        so admission costs the host only Python time. Returns the
+        pending (req, slot, first_token_future) list for
+        ``_admit_integrate``."""
+        pending = []
         while self._queue and self._free_slots():
             req = self._queue[0]
             slot = self._free_slots()[0]
@@ -294,7 +302,7 @@ class ContinuousBatchingEngine:
             # the sink page or pages owned by other slots
             need = max(n + req.max_new_tokens, self._bucket(n))
             if self.cfg.paged and not self.pool.alloc(slot, need):
-                if not self.active.any():
+                if not self.active.any() and not pending:
                     raise RuntimeError(
                         f"request {req.rid} needs "
                         f"{self.pool.pages_needed(need)} pages but the "
@@ -318,15 +326,28 @@ class ContinuousBatchingEngine:
             else:
                 self.caches = self._insert_contig()(
                     self.caches, filled, slot)
-            first = int(first_dev)  # scalar transfer, not [bucket, vocab]
+            # mark the slot taken now so the next iteration can't hand
+            # it out again; lengths/last_tok land at integrate
+            self.active[slot] = True
+            req.slot = slot
+            self._slot_req[slot] = req
+            pending.append((req, slot, first_dev))
+        return pending
+
+    def _admit_integrate(self, pending):
+        """Sync each admitted request's first token (a scalar transfer)
+        and finish its bookkeeping; the sequence joins the NEXT decode
+        chunk."""
+        for req, slot, first_dev in pending:
+            first = int(first_dev)  # scalar, not [1, bucket, vocab]
             req.ttft_ms = (time.perf_counter() - req._submit_t) * 1e3
             req.output.append(first)
-            req.slot = slot
-            self.active[slot] = True
-            self.seq_lens[slot] = n
+            self.seq_lens[slot] = req.prompt.size
             self.last_tok[slot] = first
-            self._slot_req[slot] = req
             self._maybe_finish(slot, first)
+
+    def _admit(self):
+        self._admit_integrate(self._admit_dispatch())
 
     def _maybe_finish(self, slot: int, tok: int):
         req = self._slot_req.get(slot)
@@ -386,21 +407,32 @@ class ContinuousBatchingEngine:
         return budget
 
     def step_chunk(self, max_chunk: int = 8) -> bool:
-        """Admit, then run ``max_chunk`` decode steps in ONE device
-        program — the host reads tokens back once per chunk instead of
-        per token (the per-token device→host sync was the round-2 decode
-        bottleneck). K is fixed, so exactly one decode program compiles
-        for the engine's lifetime; per-slot budgets freeze finished slots
+        """Run ``max_chunk`` decode steps in ONE device program, with
+        admission OVERLAPPED: the decode chunk is dispatched first (no
+        host sync), then prefill + cache-insert programs for queued
+        requests are dispatched behind it on the device stream, and only
+        then does the host read the chunk's tokens back. In-flight
+        decode never stalls on admission (the round-3 head-of-line
+        blocking), prefill host work (bucketing, padding) overlaps the
+        chunk's device time, and admitted sequences join the next chunk.
+        K is fixed, so exactly one decode program compiles for the
+        engine's lifetime; per-slot budgets freeze finished slots
         device-side and the host discards EOS/budget overshoot."""
-        self._admit()
         if not self.active.any():
-            return bool(self._queue)
+            # nothing decoding: plain blocking admission
+            self._admit()
+            if not self.active.any():
+                return bool(self._queue)
         K = max_chunk
+        # capture the chunk's view BEFORE admission: newly admitted
+        # slots must not decode mid-chunk (their lengths land at
+        # integrate)
+        chunk_slots = self.active.copy()
         budget = self._slot_budgets()
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
-        act = jnp.asarray(self.active)
+        act = jnp.asarray(chunk_slots)
         bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
               else jnp.zeros((1,), jnp.int32))
         caches = self.layer_caches if self.cfg.paged else self.caches
@@ -411,16 +443,23 @@ class ContinuousBatchingEngine:
             self.layer_caches = caches
         else:
             self.caches = caches
+        # admission dispatches behind the in-flight chunk (stream order:
+        # chunk → prefills → inserts into the chunk's output caches)
+        pending = self._admit_dispatch()
         toks_np = np.asarray(toks_all)  # ONE sync for K tokens
         for k in range(K):
             for slot in range(self.cfg.max_slots):
-                if not self.active[slot] or k >= budget[slot]:
+                # chunk_slots: was in this chunk; active: not finished
+                # (EOS) at an earlier k of this same chunk
+                if (not chunk_slots[slot] or not self.active[slot]
+                        or k >= budget[slot]):
                     continue
                 tok = int(toks_np[k, slot])
                 self._slot_req[slot].output.append(tok)
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
                 self._maybe_finish(slot, tok)
+        self._admit_integrate(pending)
         return True
 
     def run(self, prompts: Sequence, max_new_tokens: int = 32,

@@ -270,3 +270,31 @@ def test_chunk_budget_respects_limits():
         model, EngineConfig(max_slots=2, max_len=32, seq_buckets=(16,)))
     out = eng.run([np.arange(1, 5)], max_new_tokens=3, max_chunk=16)[0]
     assert len(out.output) == 3  # chunk clamped to the token budget
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_mid_decode_admission_overlap(paged):
+    """Requests arriving WHILE earlier sequences decode (the overlapped
+    admission path: chunk dispatched first, prefill behind it, pending
+    integrated after readback) produce exactly the sequential outputs."""
+    model, cfg = _model()
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n)
+               for n in (5, 4, 6, 3)]
+    pred = Predictor(model, Config())
+    refs = [pred.generate(p, max_new_tokens=10)[0] for p in prompts]
+
+    ecfg = EngineConfig(max_slots=2, max_len=64, seq_buckets=(16,),
+                        paged=paged, page_size=8)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    rids = [eng.add_request(prompts[0], 10)]
+    arrivals = iter(prompts[1:])
+    while eng.step_chunk(4) or eng._queue or eng.active.any():
+        # one new request lands after every chunk, mid-decode
+        nxt = next(arrivals, None)
+        if nxt is not None:
+            rids.append(eng.add_request(nxt, 10))
+    for rid, ref in zip(rids, refs):
+        req = eng._finished[rid]
+        assert req.done and req.ttft_ms is not None
+        np.testing.assert_array_equal(np.array(req.output), ref)
