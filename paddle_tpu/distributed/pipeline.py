@@ -399,6 +399,106 @@ def pipeline_1f1b_step(
     return loss, dfirst, dstage, dlast
 
 
+class SegmentPlan:
+    """A concrete stage/chunk partition of an L-layer trunk.
+
+    The stacked-parameter SPMD trunk stores all L layers on one leading
+    dim; lockstep ticks need every stage to scan the SAME number of
+    slots. A non-uniform partition (cost-balanced, or just L % parts
+    != 0) is realized by PADDING each chunk to M = max chunk size:
+
+    - ``pad_idx`` [parts, M]: gather indices into the logical [L] stack.
+      Real slot j < size_c maps to layer bounds[c]+j; padding slots
+      repeat the chunk's last layer (finite compute, output discarded).
+    - inside the scan, slot j applies its layer only when j < n_active
+      (``jnp.where`` to the carried activation otherwise), so padded
+      slots are exact no-ops forward AND backward (zero cotangent).
+    - ``unpad_idx`` [L]: positions of the real slots in the flattened
+      [parts*M] padded stack — the transpose mapping for gradients. The
+      duplicated padding indices receive only zeros under scatter-add,
+      so gather(grads, unpad_idx) is exact.
+
+    Parity: fleet pp_layers ``segment_layers`` with seg_method
+    "layer:.*" / cost_fn — the reference assigns whole layers to stages
+    (naturally ragged); here raggedness becomes masked padding because
+    stages march in SPMD lockstep.
+    """
+
+    def __init__(self, costs, parts: int):
+        import numpy as np
+
+        self.bounds = segment_layers(costs, parts)
+        self.parts = parts
+        self.sizes = [b - a for a, b in
+                      zip(self.bounds, self.bounds[1:])]
+        self.M = max(self.sizes)
+        self.uniform = min(self.sizes) == self.M
+        L = self.bounds[-1]
+        pad = np.zeros((parts, self.M), np.int32)
+        unpad = np.zeros((L,), np.int32)
+        for c, (a, s) in enumerate(zip(self.bounds, self.sizes)):
+            for j in range(self.M):
+                pad[c, j] = a + min(j, s - 1)
+            for j in range(s):
+                unpad[a + j] = c * self.M + j
+        self.pad_idx = pad
+        self.unpad_idx = unpad
+        self.sizes_f32 = np.asarray(self.sizes, np.float32)
+
+    def pack(self, tree):
+        """Logical [L, ...] stacked leaves → padded [parts, M, ...] with
+        a ``__n_active__`` [parts] leaf for the in-scan mask. Uniform
+        plans reshape (no gather, no mask leaf) — the existing fast
+        path."""
+        if self.uniform:
+            return jax.tree_util.tree_map(
+                lambda v: v.reshape(self.parts, self.M, *v.shape[1:]),
+                tree)
+        out = jax.tree_util.tree_map(lambda v: v[self.pad_idx], tree)
+        out["__n_active__"] = jnp.asarray(self.sizes_f32)
+        return out
+
+    def unpack_grads(self, tree):
+        """Padded [parts, M, ...] grads → logical [L, ...] (drops the
+        ``__n_active__`` cotangent)."""
+        if self.uniform:
+            return jax.tree_util.tree_map(
+                lambda v: v.reshape(self.parts * self.M, *v.shape[2:]),
+                tree)
+        return {
+            k: v.reshape(self.parts * self.M,
+                         *v.shape[2:])[self.unpad_idx]
+            for k, v in tree.items() if k != "__n_active__"
+        }
+
+
+def masked_chunk_scan(apply_one, chunk_params, h):
+    """Scan ``apply_one`` over a chunk's stacked layer params, honoring
+    the plan's padding mask: slot j is an exact identity (forward and
+    backward) when j >= chunk_params["__n_active__"]. Without the mask
+    leaf this is a plain scan (uniform plans)."""
+    n_act = chunk_params.get("__n_active__") \
+        if isinstance(chunk_params, dict) else None
+    if n_act is None:
+        def one(carry, lp):
+            return apply_one(lp, carry), None
+
+        out, _ = jax.lax.scan(one, h, chunk_params)
+        return out
+    weights = {k: v for k, v in chunk_params.items()
+               if k != "__n_active__"}
+    M = next(iter(weights.values())).shape[0]
+
+    def one(carry, xs):
+        j, lp = xs
+        out = apply_one(lp, carry)
+        return jnp.where(j < n_act, out, carry), None
+
+    out, _ = jax.lax.scan(
+        one, h, (jnp.arange(M, dtype=jnp.float32), weights))
+    return out
+
+
 def segment_layers(costs, num_stages: int):
     """Cost-balanced contiguous segmentation (parity: fleet pp_layers
     ``segment_layers`` with seg_method="layer:.*"/"uniform" — here the
@@ -480,10 +580,15 @@ class PipelineLayer(Layer):
     """
 
     def __init__(self, layer_desc: LayerDesc, num_layers: int,
-                 num_stages: Optional[int] = None, seg_method="uniform"):
+                 num_stages: Optional[int] = None, seg_method="uniform",
+                 costs=None):
         super().__init__()
         self.num_layers = num_layers
         self.num_stages = num_stages
+        # per-layer costs for seg balancing (PipelineModule sets these
+        # from cost_fn / seg_method); None → uniform
+        self.costs = list(costs) if costs is not None else None
+        self._plan_cache = {}
         self.prototype = layer_desc.build()
         # stack per-layer params: [L, *shape]
         protos = list(self.prototype.named_parameters())
@@ -537,26 +642,17 @@ class PipelineLayer(Layer):
         params = self.stage_params()
         pp = mesh.shape.get("pp", 1) if mesh is not None else 1
         if mesh is not None and pp > 1:
-            assert self.num_layers % pp == 0, (
-                "num_layers must divide evenly into pp stages"
-            )
-            per_stage = self.num_layers // pp
+            if pp not in self._plan_cache:
+                self._plan_cache[pp] = SegmentPlan(
+                    self.costs or [1.0] * self.num_layers, pp)
+            plan = self._plan_cache[pp]
 
             def stage_fn(stage_params, mb):
-                # stage_params leaves: [per_stage, ...]
-                def one(h, layer_params):
-                    return self._apply_one(layer_params, h), None
+                return masked_chunk_scan(self._apply_one,
+                                         stage_params, mb)
 
-                h, _ = jax.lax.scan(
-                    lambda h, lp: one(h, lp), mb, stage_params
-                )
-                return h
-
-            # reshape leading dim [L] -> [pp, per_stage] then feed pp dim
-            stacked = {
-                k: v.reshape(pp, per_stage, *v.shape[1:])
-                for k, v in params.items()
-            }
+            # leading dim [L] -> padded [pp, M] (reshape when uniform)
+            stacked = plan.pack(params)
             if x.shape[0] % n_micro == 0:
                 mbs = x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
             else:
@@ -590,11 +686,10 @@ class PipelineModule(Layer):
     def __init__(self, descs, num_stages: Optional[int] = None,
                  seg_method: str = "uniform", cost_fn=None):
         super().__init__()
-        if seg_method != "uniform":
-            raise NotImplementedError(
-                f"seg_method={seg_method!r}: the stacked-parameter trunk "
-                "requires equal layers per stage; only 'uniform' is "
-                "supported (cost_fn is validated against it)")
+        if seg_method != "uniform" and not seg_method.startswith("layer:"):
+            raise ValueError(
+                f"seg_method={seg_method!r}: expected 'uniform' or "
+                "'layer:<regex>' (fleet pp_layers convention)")
         self.num_stages = num_stages
         self._shared = {}
         self._shared_fwd = {}
@@ -608,28 +703,38 @@ class PipelineModule(Layer):
         self.trunk_range = (lo, hi)
         self.pre_descs = descs[:lo]
         self.post_descs = descs[hi:]
+        # per-layer costs drive cost-balanced (possibly non-uniform)
+        # segmentation — realized as masked padding in the SPMD trunk
+        # (SegmentPlan); fleet seg_method="layer:<regex>" counts descs
+        # whose class name matches, cost_fn overrides
+        if cost_fn is not None:
+            self.trunk_costs = [float(cost_fn(d)) for d in descs[lo:hi]]
+            if not any(self.trunk_costs):
+                raise ValueError(
+                    "cost_fn returned 0 for every trunk layer — the "
+                    "balanced partition is degenerate")
+        elif seg_method.startswith("layer:"):
+            import re
+
+            pat = re.compile(seg_method[len("layer:"):])
+            self.trunk_costs = [
+                1.0 if pat.search(d.layer_cls.__name__) else 0.0
+                for d in descs[lo:hi]]
+            if not any(self.trunk_costs):
+                raise ValueError(
+                    f"seg_method={seg_method!r} matches no trunk layer "
+                    f"({descs[lo].layer_cls.__name__})")
+        else:
+            self.trunk_costs = [1.0] * (hi - lo)
         self.trunk = PipelineLayer(descs[lo], hi - lo,
-                                   num_stages=num_stages)
+                                   num_stages=num_stages,
+                                   costs=self.trunk_costs)
         self.pre = [self._build(d, f"pre_{i}")
                     for i, d in enumerate(self.pre_descs)]
         self.post = [self._build(d, f"post_{i}")
                      for i, d in enumerate(self.post_descs)]
-        # cost-based segmentation check: the stacked storage splits the
-        # trunk into EQUAL chunks, so a cost_fn whose balanced partition
-        # is non-uniform cannot be honored — fail loudly instead of
-        # silently imbalancing stages
         if num_stages:
-            costs = ([cost_fn(d) for d in descs[lo:hi]] if cost_fn
-                     else [1.0] * (hi - lo))
-            self.segments = segment_layers(costs, num_stages)
-            sizes = {b - a for a, b in zip(self.segments,
-                                           self.segments[1:])}
-            if len(sizes) > 1:
-                raise ValueError(
-                    f"cost-balanced segmentation {self.segments} is "
-                    "non-uniform; the stacked-parameter trunk requires "
-                    "equal layers per stage — pad the trunk or drop "
-                    "cost_fn")
+            self.segments = segment_layers(self.trunk_costs, num_stages)
 
     @staticmethod
     def _sig(d):
@@ -704,9 +809,12 @@ class PipelineTrainStep:
         self.n_micro = max(1, getattr(pcfg, "accumulate_steps", 1))
         pp = mesh.shape["pp"]
         L = module.trunk.num_layers
-        if L % (pp * self.vpp):
-            raise ValueError(
-                f"trunk layers {L} must divide pp*vpp = {pp * self.vpp}")
+        # cost-balanced chunking (SegmentPlan): uniform when L divides
+        # evenly and costs are flat (zero-overhead reshape), masked
+        # padding otherwise — L need not divide pp*vpp
+        costs = getattr(module, "trunk_costs", None) or [1.0] * L
+        self._plan_v = SegmentPlan(costs, pp * self.vpp)
+        self._plan_pp = SegmentPlan(costs, pp)
 
         # flat param dicts (optimizer-compatible)
         pre_names = self._seq_param_names(module.pre)
@@ -744,6 +852,15 @@ class PipelineTrainStep:
             spec = getattr(obj, "spec", None)
             if spec is None:
                 spec = (None,) * jnp.ndim(self.params[n])
+            active_plan = (self._plan_v if self.schedule.upper()
+                           in ("1F1B", "VPP") else self._plan_pp)
+            if (n.startswith("trunk.") and not active_plan.uniform
+                    and tuple(spec)[:1] == ("pp",)):
+                # non-uniform plan: the logical [L] stack is not
+                # pp-divisible — keep it replicated on the leading dim;
+                # the in-jit pack() gather lands it in the shard_map's
+                # P("pp") layout
+                spec = (None,) + tuple(spec)[1:]
             spec = _filter_spec_for_mesh(tuple(spec), mesh)
             if use_zero3:
                 pspec = param_partition_spec(
@@ -841,12 +958,9 @@ class PipelineTrainStep:
                      if per_layer_remat else module.trunk._apply_one)
 
         def stage_fn(chunk_params, h):
-            # chunk leaves: [per_chunk, ...] — scan the prototype over them
-            def one(carry, layer_params):
-                return apply_one(layer_params, carry), None
-
-            out, _ = jax.lax.scan(one, h, chunk_params)
-            return out
+            # chunk leaves: [per_chunk(+pad), ...] — scan the prototype
+            # over them, honoring the plan's padding mask if present
+            return masked_chunk_scan(apply_one, chunk_params, h)
 
         def last_fn(last_params, y, aux):
             with bind_params(module, last_params):
@@ -879,10 +993,7 @@ class PipelineTrainStep:
                 lambda a: a.reshape(n_micro, a.shape[0] // n_micro,
                                     *a.shape[1:]), aux)
             if schedule.upper() in ("1F1B", "VPP"):
-                L = next(iter(trunk_params.values())).shape[0]
-                per_chunk = L // V
-                sp = {k: v.reshape(V, per_chunk, *v.shape[1:])
-                      for k, v in trunk_params.items()}
+                sp = self._plan_v.pack(trunk_params)
                 loss, dfirst, dstage, dlast = pipeline_1f1b_step(
                     first_fn, stage_fn, last_fn,
                     first_params, sp, last_params, mbs, aux_mbs,
@@ -895,9 +1006,8 @@ class PipelineTrainStep:
                     if n in dlast:  # tied params: sum both uses' grads
                         g = dlast[n] if g is None else g + dlast[n]
                     grads[n] = g
-                for k, v in dstage.items():
-                    grads[f"trunk.{k}"] = v.reshape(
-                        v.shape[0] * v.shape[1], *v.shape[2:])
+                for k, v in self._plan_v.unpack_grads(dstage).items():
+                    grads[f"trunk.{k}"] = v
             else:  # F-then-B: autodiff through the GPipe forward
                 def loss_of(p):
                     fpp = {n: p[n] for n in self._pre_names}
@@ -905,12 +1015,10 @@ class PipelineTrainStep:
                     tpp = {k[len("trunk."):]: v for k, v in p.items()
                            if k.startswith("trunk.")}
                     h0 = jax.vmap(lambda xm: first_fn(fpp, xm))(mbs)
-                    # stage slice leaves arrive [layers_per_stage, ...] —
-                    # exactly what stage_fn's layer scan consumes
+                    # stage slice leaves arrive [layers_per_stage(+pad),
+                    # ...] — exactly what stage_fn's masked scan consumes
                     ys = pipeline_apply(
-                        stage_fn,
-                        {k: v.reshape(pp, v.shape[0] // pp, *v.shape[1:])
-                         for k, v in tpp.items()},
+                        stage_fn, self._plan_pp.pack(tpp),
                         h0, mesh=mesh, n_micro=n_micro)
                     losses = jax.vmap(
                         lambda y, a: last_fn(lpp, y, a))(ys, aux_mbs)

@@ -274,6 +274,81 @@ def test_1f1b_vs_fthenb_same_trajectory():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("L,vpp", [(5, 1), (6, 2), (7, 1)])
+def test_nonuniform_segmentation_matches_sequential(L, vpp):
+    """Cost-balanced NON-uniform partition (L % (pp·vpp) != 0): masked
+    padding slots must be exact no-ops — 1F1B loss/grads and the
+    F-then-B trajectory must coincide with each other (both reduce to
+    the same sequential math). Parity: fleet pp_layers.segment_layers
+    raggedness."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.distributed.pipeline import PipelineTrainStep
+    from paddle_tpu.distributed.strategy import DistributedStrategy
+
+    mesh = dist.build_mesh(pp=2)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, 12, (4, 6)))
+    labels = jnp.asarray(rng.integers(0, 12, (4, 6)))
+
+    def loss_fn(logits, labels):
+        return jnp.mean((logits - jax.nn.one_hot(labels, 12)) ** 2)
+
+    traj = {}
+    for schedule in ("1F1B", "F-then-B"):
+        m = _tied_module(L=L)
+        strategy = DistributedStrategy()
+        strategy.pipeline_configs.schedule_mode = schedule
+        strategy.pipeline_configs.vpp_degree = vpp if schedule == "1F1B" \
+            else 1
+        strategy.pipeline_configs.accumulate_steps = 2
+        ts = PipelineTrainStep(m, opt.SGD(learning_rate=0.02), mesh,
+                               strategy, loss_fn)
+        if schedule == "1F1B":
+            assert not ts._plan_v.uniform  # the point of the test
+        traj[schedule] = [float(ts.run(ids, labels)) for _ in range(4)]
+    np.testing.assert_allclose(traj["1F1B"], traj["F-then-B"],
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_nonuniform_forward_matches_sequential():
+    """PipelineLayer forward with L=5 on pp=2 (padded stage of 3+2):
+    pipelined output must equal the sequential scan exactly."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.sharding import mesh_context
+
+    m = _tied_module(L=5)
+    mesh = dist.build_mesh(pp=2)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 12, (4, 6)))
+    with mesh_context(mesh):
+        out_pp = m(ids, n_micro=2, mesh=mesh)
+    out_seq = m(ids, n_micro=1, mesh=None)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_seq),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_seg_method_and_cost_fn():
+    """seg_method='layer:<regex>' and cost_fn drive the recorded
+    segmentation (fleet convention); bad regexes fail loudly."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.pipeline import (
+        LayerDesc, PipelineModule)
+
+    pt.seed(0)
+    descs = ([LayerDesc(nn.Embedding, 12, 16)] * 1
+             + [LayerDesc(nn.Linear, 16, 16) for _ in range(5)])
+    m = PipelineModule(descs, num_stages=2, seg_method="layer:Linear")
+    assert m.segments == [0, 3, 5]  # balanced 3+2 split
+    m2 = PipelineModule(descs, num_stages=2,
+                        cost_fn=lambda d: 1.0)
+    assert m2.segments == [0, 3, 5]
+    with pytest.raises(ValueError):
+        PipelineModule(descs, num_stages=2, seg_method="layer:Conv2D")
+    with pytest.raises(ValueError):
+        PipelineModule(descs, num_stages=2, seg_method="bogus")
+
+
 def test_llama_pipeline_module_trains():
     """Flagship-path PP: the Llama PipelineModule (tied embeddings)
     trains under 1F1B on a pp=2 mesh and its loss matches the F-then-B
