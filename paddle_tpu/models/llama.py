@@ -59,6 +59,12 @@ class LlamaConfig:
     sep_attention: str = "ulysses"
     use_recompute: bool = False
     recompute_policy: str = "dots_with_no_batch_dims_saveable"
+    # chunked fused head+CE loss: full [b, s, vocab] f32 logits (the
+    # largest train-step activation) never materialize. 0 = off. Leave
+    # off when the model fits — the per-chunk dW accumulation + logits
+    # recompute cost ~8% of step time at 876M/v5e; turn on (e.g. 512)
+    # for large-vocab/long-seq configs where the head dominates peak HBM
+    fused_head_loss_chunk: int = 0
     dtype: str = "float32"
     initializer_range: float = 0.02
 
@@ -327,12 +333,26 @@ class LlamaForCausalLM(Layer):
             )
             return self.logits(hidden), new_caches
         hidden = self.model(input_ids, position_ids)
-        logits = self.logits(hidden)
         if labels is None:
-            return logits
-        # next-token LM loss, fp32 softmax over the (tp-sharded) vocab
-        shift_logits = logits[:, :-1, :]
+            return self.logits(hidden)
         shift_labels = labels[:, 1:]
+        if self.config.fused_head_loss_chunk:
+            # chunked head+CE: math-identical to the full-logits path
+            # (softmax is row-wise) but peak memory is one seq chunk
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            shift_hidden = hidden[:, :-1, :]
+            if self.lm_head is not None:
+                return fused_linear_cross_entropy(
+                    shift_hidden, self.lm_head.weight.value, shift_labels,
+                    ignore_index=-100,
+                    seq_chunk=self.config.fused_head_loss_chunk)
+            return fused_linear_cross_entropy(
+                shift_hidden, self.model.embed_tokens.weight.value,
+                shift_labels, transpose_weight=True, ignore_index=-100,
+                seq_chunk=self.config.fused_head_loss_chunk)
+        # next-token LM loss, fp32 softmax over the (tp-sharded) vocab
+        shift_logits = self.logits(hidden)[:, :-1, :]
         return F.cross_entropy(shift_logits, shift_labels, ignore_index=-100)
 
     def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):

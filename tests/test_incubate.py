@@ -83,6 +83,67 @@ class TestFusedFunctional:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestFusedLinearCrossEntropy:
+    """Chunked head+CE must be EXACT vs cross_entropy(linear(x)) —
+    softmax is row-wise so sequence chunking changes no math — including
+    gradients (the chunk body is remat'd; dW accumulates across the
+    scan), ignore_index masking, non-multiple seq lengths, and the
+    [V, H] tied-embedding weight layout."""
+
+    def _setup(self, S=37):
+        rng = np.random.default_rng(0)
+        B, H, V = 3, 16, 29
+        x = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((H, V)) * 0.3, jnp.float32)
+        y = jnp.asarray(rng.integers(0, V, (B, S)))
+        y = y.at[0, 3].set(-100).at[2, 10].set(-100)
+        return x, w, y
+
+    def test_matches_reference_with_grads(self):
+        x, w, y = self._setup()
+
+        def ref(x, w):
+            return F.cross_entropy(x @ w, y, ignore_index=-100)
+
+        def fused(x, w):
+            return IF.fused_linear_cross_entropy(x, w, y, seq_chunk=8)
+
+        l1, (gx1, gw1) = jax.value_and_grad(ref, argnums=(0, 1))(x, w)
+        l2, (gx2, gw2) = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_transpose_weight_and_bias(self):
+        x, w, y = self._setup(S=16)
+        bias = jnp.asarray(
+            np.random.default_rng(1).standard_normal(w.shape[1]) * 0.1,
+            jnp.float32)
+        ref = F.cross_entropy(x @ w + bias, y, ignore_index=-100)
+        out = IF.fused_linear_cross_entropy(
+            x, w.T, y, bias=bias, transpose_weight=True, seq_chunk=8)
+        np.testing.assert_allclose(float(ref), float(out), rtol=1e-6)
+
+    def test_llama_config_flag(self):
+        """fused_head_loss_chunk routes the CausalLM loss through the
+        chunked head; loss must match the default full-logits path."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        rng = np.random.default_rng(2)
+        losses = {}
+        for chunk in (0, 4):
+            pt.seed(0)
+            cfg = LlamaConfig.tiny(use_flash_attention=False,
+                                   fused_head_loss_chunk=chunk)
+            model = LlamaForCausalLM(cfg)
+            ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 10)))
+            losses[chunk] = float(model(ids, labels=ids))
+            rng = np.random.default_rng(2)  # same ids both configs
+        np.testing.assert_allclose(losses[0], losses[4], rtol=1e-6)
+
+
 class TestWrapperOptimizers:
     def _params(self):
         return {"w": jnp.ones((4,), jnp.float32)}
