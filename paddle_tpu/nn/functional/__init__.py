@@ -1,1163 +1,99 @@
 """Functional ops (parity: paddle.nn.functional).
 
-Thin, jit-friendly wrappers over jax.numpy/lax. Where the reference routes
-through hand-written CUDA kernels (paddle/phi/kernels/gpu/,
-paddle/phi/kernels/fusion/), XLA fusion covers the same ground on TPU; the
-genuinely hot fused paths (flash attention, rope/rmsnorm at long seq,
-paged decode) live in paddle_tpu.kernels as Pallas implementations and are
-dispatched from here when available.
+Thin, jit-friendly wrappers over jax.numpy/lax, organized by domain in
+the reference's own module layout (python/paddle/nn/functional/
+{activation,common,conv,pooling,loss,norm,vision,input,
+flash_attention}.py). Where the reference routes through hand-written
+CUDA kernels (paddle/phi/kernels/gpu/, paddle/phi/kernels/fusion/), XLA
+fusion covers the same ground on TPU; the genuinely hot fused paths
+(flash attention, rope/rmsnorm at long seq, paged decode) live in
+paddle_tpu.kernels as Pallas implementations and are dispatched from
+here when available.
 """
 
-from __future__ import annotations
-
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from ...core import random as random_mod
-from ...core.parameter import Parameter
-
-
-def _v(x):
-    return x.value if isinstance(x, Parameter) else x
-
-def _f32up(x):
-    """Upcast to AT LEAST float32 for stable statistics — never downcast
-    (fp64 inputs, e.g. the OpTest finite-difference harness, stay fp64)."""
-    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
-
-
-# ---------------------------------------------------------------------------
-# linear / embedding
-# ---------------------------------------------------------------------------
-def linear(x, weight, bias=None):
-    """y = x @ W (+ b). Weight layout [in_features, out_features] (paddle
-    convention, phi kernel matmul_kernel)."""
-    x, weight = _v(x), _v(weight)
-    y = jnp.matmul(x, weight)
-    if bias is not None:
-        y = y + _v(bias)
-    return y
-
-
-def embedding(x, weight, padding_idx=None):
-    x, weight = _v(x), _v(weight)
-    out = jnp.take(weight, x, axis=0)
-    if padding_idx is not None:
-        mask = (x == padding_idx)[..., None]
-        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
-    return out
-
-
-# ---------------------------------------------------------------------------
-# activations
-# ---------------------------------------------------------------------------
-def relu(x):
-    return jax.nn.relu(_v(x))
-
-
-def relu6(x):
-    return jax.nn.relu6(_v(x))
-
-
-def gelu(x, approximate=False):
-    return jax.nn.gelu(_v(x), approximate=approximate)
-
-
-def silu(x):
-    return jax.nn.silu(_v(x))
-
-
-swish = silu
-
-
-def sigmoid(x):
-    return jax.nn.sigmoid(_v(x))
-
-
-def tanh(x):
-    return jnp.tanh(_v(x))
-
-
-def leaky_relu(x, negative_slope=0.01):
-    return jax.nn.leaky_relu(_v(x), negative_slope)
-
-
-def elu(x, alpha=1.0):
-    return jax.nn.elu(_v(x), alpha)
-
-
-def softplus(x, beta=1.0, threshold=20.0):
-    return jax.nn.softplus(_v(x) * beta) / beta
-
-
-def hardswish(x):
-    return jax.nn.hard_swish(_v(x))
-
-
-def hardsigmoid(x):
-    x = _v(x)
-    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
-
-
-def mish(x):
-    return jax.nn.mish(_v(x))
-
-
-def softmax(x, axis=-1):
-    return jax.nn.softmax(_v(x), axis=axis)
-
-
-def log_softmax(x, axis=-1):
-    return jax.nn.log_softmax(_v(x), axis=axis)
-
-
-def glu(x, axis=-1):
-    return jax.nn.glu(_v(x), axis=axis)
-
-
-def swiglu(x, y=None):
-    """Parity: phi fusion swiglu — silu(x) * y (split x in half if y None)."""
-    x = _v(x)
-    if y is None:
-        x, y = jnp.split(x, 2, axis=-1)
-    return jax.nn.silu(x) * _v(y)
-
-
-# ---------------------------------------------------------------------------
-# normalization
-# ---------------------------------------------------------------------------
-def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
-    x = _v(x)
-    # compute statistics in fp32 for bf16 inputs (parity: phi layer_norm
-    # kernel accumulates in float)
-    xf = _f32up(x)
-    mean = jnp.mean(xf, axis=-1, keepdims=True)
-    var = jnp.var(xf, axis=-1, keepdims=True)
-    y = (xf - mean) * lax.rsqrt(var + epsilon)
-    y = y.astype(x.dtype)
-    if weight is not None:
-        y = y * _v(weight)
-    if bias is not None:
-        y = y + _v(bias)
-    return y
-
-
-def rms_norm(x, weight=None, epsilon=1e-6):
-    """Parity: phi fusion rms_norm kernel."""
-    x = _v(x)
-    xf = _f32up(x)
-    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
-    if weight is not None:
-        y = y * _v(weight)
-    return y
-
-
-def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
-    x = _v(x)
-    if data_format == "NHWC":
-        x = jnp.moveaxis(x, -1, 1)
-    n, c = x.shape[:2]
-    spatial = x.shape[2:]
-    g = num_groups
-    xf = _f32up(x).reshape(n, g, c // g, *spatial)
-    axes = tuple(range(2, xf.ndim))
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.var(xf, axis=axes, keepdims=True)
-    y = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, *spatial).astype(x.dtype)
-    if weight is not None:
-        y = y * _v(weight).reshape(1, c, *([1] * len(spatial)))
-    if bias is not None:
-        y = y + _v(bias).reshape(1, c, *([1] * len(spatial)))
-    if data_format == "NHWC":
-        y = jnp.moveaxis(y, 1, -1)
-    return y
-
-
-# ---------------------------------------------------------------------------
-# dropout
-# ---------------------------------------------------------------------------
-def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
-    x = _v(x)
-    if not training or p == 0.0:
-        if mode == "downscale_in_infer" and not training:
-            return x * (1.0 - p)
-        return x
-    if p == 1.0:
-        return jnp.zeros_like(x)
-    key = rng_key if rng_key is not None else random_mod.next_rng_key("dropout")
-    keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, x.shape)
-    if mode == "upscale_in_train":
-        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
-    return jnp.where(mask, x, jnp.zeros((), x.dtype))
-
-
-# ---------------------------------------------------------------------------
-# losses
-# ---------------------------------------------------------------------------
-def cross_entropy(
-    logits,
-    label,
-    soft_label: bool = False,
-    ignore_index: int = -100,
-    reduction: str = "mean",
-    axis: int = -1,
-    label_smoothing: float = 0.0,
-):
-    """Parity: F.cross_entropy (softmax_with_cross_entropy phi kernel).
-
-    Computes in fp32 regardless of input dtype (matching the fused kernel's
-    accumulation behavior).
-    """
-    logits = _f32up(_v(logits))
-    if axis not in (-1, logits.ndim - 1):
-        # normalize to class-dim-last so gathers/one-hots line up
-        logits = jnp.moveaxis(logits, axis, -1)
-        if soft_label:
-            label = jnp.moveaxis(_v(label), axis, -1)
-        axis = -1
-    logp = jax.nn.log_softmax(logits, axis=axis)
-    if soft_label:
-        target = _v(label).astype(logits.dtype)
-        loss = -jnp.sum(target * logp, axis=axis)
-        valid = jnp.ones(loss.shape, jnp.float32)
-    else:
-        label = _v(label)
-        num_classes = logits.shape[axis]
-        if label_smoothing > 0.0:
-            onehot = jax.nn.one_hot(label, num_classes, dtype=jnp.float32)
-            smooth = (
-                onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
-            )
-            loss = -jnp.sum(smooth * logp, axis=axis)
-        else:
-            safe_label = jnp.where(label == ignore_index, 0, label)
-            loss = -jnp.take_along_axis(
-                logp, safe_label[..., None], axis=axis
-            ).squeeze(axis)
-        valid = (label != ignore_index).astype(jnp.float32)
-        loss = loss * valid
-    if reduction == "none":
-        return loss
-    if reduction == "sum":
-        return jnp.sum(loss)
-    denom = jnp.maximum(jnp.sum(valid), 1.0)
-    return jnp.sum(loss) / denom
-
-
-def mse_loss(input, label, reduction="mean"):  # noqa: A002
-    d = (_v(input) - _v(label)) ** 2
-    if reduction == "none":
-        return d
-    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
-
-
-def l1_loss(input, label, reduction="mean"):  # noqa: A002
-    d = jnp.abs(_v(input) - _v(label))
-    if reduction == "none":
-        return d
-    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
-
-
-def nll_loss(log_probs, label, reduction="mean", ignore_index=-100):
-    logp = _v(log_probs)
-    label = _v(label)
-    safe = jnp.where(label == ignore_index, 0, label)
-    loss = -jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
-    valid = (label != ignore_index).astype(loss.dtype)
-    loss = loss * valid
-    if reduction == "none":
-        return loss
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
-
-
-def binary_cross_entropy_with_logits(logits, label, reduction="mean"):
-    logits = _f32up(_v(logits))
-    label = _v(label).astype(logits.dtype)
-    loss = jnp.maximum(logits, 0) - logits * label + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-    if reduction == "none":
-        return loss
-    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
-
-
-# ---------------------------------------------------------------------------
-# attention
-# ---------------------------------------------------------------------------
-def scaled_dot_product_attention(
-    query,
-    key,
-    value,
-    attn_mask=None,
-    dropout_p: float = 0.0,
-    is_causal: bool = False,
-    scale: Optional[float] = None,
-    training: bool = True,
-):
-    """Reference attention in pure XLA. Layout: [batch, seq, heads, dim]
-    (paddle flash_attention layout, phi flash_attn kernel).
-
-    The Pallas flash-attention kernel (paddle_tpu.kernels.flash_attention)
-    is preferred on TPU for long sequences; this is the numerics reference
-    and the general fallback (arbitrary masks, GQA).
-    """
-    q, k, v = _v(query), _v(key), _v(value)
-    b, sq, hq, d = q.shape
-    hk = k.shape[2]
-    if hq != hk:  # grouped-query attention: repeat kv heads
-        rep = hq // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = scale if scale is not None else d ** -0.5
-    # [b, h, sq, sk]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = _f32up(logits)
-    if is_causal:
-        sk = k.shape[1]
-        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(causal, logits, jnp.float32(-1e30))
-    if attn_mask is not None:
-        m = _v(attn_mask)
-        if m.dtype == jnp.bool_:
-            logits = jnp.where(m, logits, jnp.float32(-1e30))
-        else:
-            logits = logits + m.astype(logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    if dropout_p > 0.0 and training:
-        probs = dropout(probs, dropout_p, training=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-
-
-def flash_attention(
-    query, key, value, dropout=0.0, causal=False, *, training=True, **kw
-):
-    """Parity: paddle.nn.functional.flash_attention.flash_attention.
-
-    Dispatches to the Pallas TPU kernel when running on TPU with supported
-    shapes, else the XLA reference path.
-    """
-    from ...kernels import flash_attention as fa
-
-    return fa.flash_attention(
-        _v(query), _v(key), _v(value), causal=causal,
-        dropout_p=dropout, training=training,
-    )
-
-
-# ---------------------------------------------------------------------------
-# conv / pool
-# ---------------------------------------------------------------------------
-def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW"):
-    """Weight layout [out_c, in_c/groups, kh, kw] (paddle convention)."""
-    x, weight = _v(x), _v(weight)
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(dilation, int):
-        dilation = (dilation, dilation)
-    if isinstance(padding, int):
-        padding = [(padding, padding), (padding, padding)]
-    elif isinstance(padding, str):
-        padding = padding.upper()
-    elif isinstance(padding, (list, tuple)) and len(padding) == 2 and all(
-        isinstance(p, int) for p in padding
-    ):
-        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"),
-    )
-    y = lax.conv_general_dilated(
-        x, weight, window_strides=stride, padding=padding,
-        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    )
-    y = y.astype(x.dtype)
-    if bias is not None:
-        b = _v(bias)
-        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
-        y = y + b.reshape(shape)
-    return y
-
-
-def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
-    x = _v(x)
-    if isinstance(kernel_size, int):
-        kernel_size = (kernel_size, kernel_size)
-    stride = stride or kernel_size
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = [(padding, padding), (padding, padding)]
-    if data_format == "NCHW":
-        window = (1, 1) + tuple(kernel_size)
-        strides = (1, 1) + tuple(stride)
-        pads = [(0, 0), (0, 0)] + list(padding)
-    else:
-        window = (1,) + tuple(kernel_size) + (1,)
-        strides = (1,) + tuple(stride) + (1,)
-        pads = [(0, 0)] + list(padding) + [(0, 0)]
-    return lax.reduce_window(
-        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
-        lax.max, window, strides, pads,
-    )
-
-
-def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
-    x = _v(x)
-    if isinstance(kernel_size, int):
-        kernel_size = (kernel_size, kernel_size)
-    stride = stride or kernel_size
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = [(padding, padding), (padding, padding)]
-    if data_format == "NCHW":
-        window = (1, 1) + tuple(kernel_size)
-        strides = (1, 1) + tuple(stride)
-        pads = [(0, 0), (0, 0)] + list(padding)
-    else:
-        window = (1,) + tuple(kernel_size) + (1,)
-        strides = (1,) + tuple(stride) + (1,)
-        pads = [(0, 0)] + list(padding) + [(0, 0)]
-    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
-    counts = lax.reduce_window(
-        jnp.ones_like(x), 0.0, lax.add, window, strides, pads
-    )
-    return summed / counts
-
-
-def _adaptive_avg_matrix(out_len, in_len):
-    """[out, in] row-stochastic bin-average matrix with the reference's
-    adaptive bin edges: start = floor(i·in/out), end = ceil((i+1)·in/out).
-    Makes adaptive pooling two separable matmuls (MXU-shaped)."""
-    i = jnp.arange(out_len)
-    start = jnp.floor(i * in_len / out_len).astype(jnp.int32)
-    end = jnp.ceil((i + 1) * in_len / out_len).astype(jnp.int32)
-    j = jnp.arange(in_len)
-    mask = (j[None, :] >= start[:, None]) & (j[None, :] < end[:, None])
-    m = mask.astype(jnp.float32)
-    return m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
-
-
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
-    x = _v(x)
-    if isinstance(output_size, int):
-        output_size = (output_size, output_size)
-    if data_format == "NHWC":
-        return jnp.moveaxis(
-            adaptive_avg_pool2d(jnp.moveaxis(x, -1, 1), output_size), 1, -1)
-    h, w = x.shape[2], x.shape[3]
-    if h % output_size[0] == 0 and w % output_size[1] == 0:
-        k = (h // output_size[0], w // output_size[1])
-        return avg_pool2d(x, k, k, 0, data_format)
-    my = _adaptive_avg_matrix(output_size[0], h)
-    mx = _adaptive_avg_matrix(output_size[1], w)
-    return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
-
-
-# ---------------------------------------------------------------------------
-# misc
-# ---------------------------------------------------------------------------
-def one_hot(x, num_classes, dtype=jnp.float32):
-    return jax.nn.one_hot(_v(x), num_classes, dtype=dtype)
-
-
-def pad(x, pad_width, mode="constant", value=0.0):
-    x = _v(x)
-    if isinstance(pad_width, (list, tuple)) and pad_width and isinstance(
-        pad_width[0], int
-    ):
-        # paddle/torch flat style: first pair pads the LAST dim, second pair
-        # the second-to-last, etc.
-        pairs = list(zip(pad_width[0::2], pad_width[1::2]))
-        full = [(0, 0)] * (x.ndim - len(pairs)) + pairs[::-1]
-    else:
-        full = pad_width
-    if mode == "constant":
-        return jnp.pad(x, full, constant_values=value)
-    return jnp.pad(x, full, mode=mode)
-
-
-def normalize(x, p=2, axis=-1, epsilon=1e-12):
-    x = _v(x)
-    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
-    return x / jnp.maximum(norm, epsilon)
-
-
-def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCL"):
-    """Weight layout [out_c, in_c/groups, k] (paddle convention)."""
-    x, weight = _v(x), _v(weight)
-    if isinstance(stride, int):
-        stride = (stride,)
-    if isinstance(dilation, int):
-        dilation = (dilation,)
-    if isinstance(padding, int):
-        padding = [(padding, padding)]
-    elif isinstance(padding, str):
-        padding = padding.upper()
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCH", "OIH", "NCH") if data_format == "NCL" else
-        ("NHC", "OIH", "NHC"),
-    )
-    y = lax.conv_general_dilated(
-        x, weight, window_strides=stride, padding=padding,
-        rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
-    ).astype(x.dtype)
-    if bias is not None:
-        shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
-        y = y + _v(bias).reshape(shape)
-    return y
-
-
-def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCDHW"):
-    """Weight layout [out_c, in_c/groups, kd, kh, kw]."""
-    x, weight = _v(x), _v(weight)
-    if isinstance(stride, int):
-        stride = (stride,) * 3
-    if isinstance(dilation, int):
-        dilation = (dilation,) * 3
-    if isinstance(padding, int):
-        padding = [(padding, padding)] * 3
-    elif isinstance(padding, str):
-        padding = padding.upper()
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else
-        ("NDHWC", "OIDHW", "NDHWC"),
-    )
-    y = lax.conv_general_dilated(
-        x, weight, window_strides=stride, padding=padding,
-        rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
-    ).astype(x.dtype)
-    if bias is not None:
-        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" \
-            else (1, 1, 1, 1, -1)
-        y = y + _v(bias).reshape(shape)
-    return y
-
-
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1,
-                     data_format="NCHW"):
-    """Gradient/fractionally-strided conv (parity: F.conv2d_transpose).
-    Weight layout [in_c, out_c/groups, kh, kw] (paddle convention).
-    Implemented as conv_general_dilated with lhs_dilation=stride — the
-    exact transpose of the forward conv, which XLA maps to the MXU the
-    same way."""
-    x, weight = _v(x), _v(weight)
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(dilation, int):
-        dilation = (dilation, dilation)
-    if isinstance(padding, int):
-        padding = (padding, padding)
-    if isinstance(output_padding, int):
-        output_padding = (output_padding, output_padding)
-    kh, kw = weight.shape[-2:]
-    # transpose-conv padding: k - 1 - p on each side (+output_padding low)
-    pads = []
-    for (k, p, op, d) in ((kh, padding[0], output_padding[0], dilation[0]),
-                          (kw, padding[1], output_padding[1], dilation[1])):
-        eff_k = (k - 1) * d + 1
-        pads.append((eff_k - 1 - p, eff_k - 1 - p + op))
-    # weight [in, out/groups, kh, kw] → flip taps, swap to [out, in/groups]
-    w = jnp.flip(weight, axis=(-2, -1))
-    if groups == 1:
-        w = jnp.swapaxes(w, 0, 1)  # [out, in, kh, kw]
-    else:
-        i, og, khw = weight.shape[0], weight.shape[1], weight.shape[2:]
-        w = w.reshape(groups, i // groups, og, *khw)
-        w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups, *khw)
-    dn = lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else
-        ("NHWC", "OIHW", "NHWC"),
-    )
-    y = lax.conv_general_dilated(
-        x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
-        rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
-    ).astype(x.dtype)
-    if bias is not None:
-        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
-        y = y + _v(bias).reshape(shape)
-    return y
-
-
-def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
-    r = upscale_factor
-    if data_format == "NCHW":
-        b, c, h, w = x.shape
-        x = x.reshape(b, c // (r * r), r, r, h, w)
-        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
-        return x.reshape(b, c // (r * r), h * r, w * r)
-    b, h, w, c = x.shape
-    x = x.reshape(b, h, w, r, r, c // (r * r))
-    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
-    return x.reshape(b, h * r, w * r, c // (r * r))
-
-
-def cosine_similarity(x1, x2, axis=-1, eps=1e-8):
-    x1, x2 = _v(x1), _v(x2)
-    dot = jnp.sum(x1 * x2, axis=axis)
-    n1 = jnp.linalg.norm(x1, axis=axis)
-    n2 = jnp.linalg.norm(x2, axis=axis)
-    return dot / jnp.maximum(n1 * n2, eps)
-
-
-# ---------------------------------------------------------------------------
-# CTC loss
-# ---------------------------------------------------------------------------
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean", norm_by_times=False):
-    """Connectionist Temporal Classification loss.
-
-    Parity: paddle.nn.functional.ctc_loss (reference: the warpctc op,
-    paddle/phi/kernels/impl/warpctc_kernel_impl.h, built from the vendored
-    third_party warpctc — SURVEY §2.3). ``log_probs`` are UNNORMALIZED
-    logits of shape [max_time, batch, num_classes]; softmax is applied
-    internally, matching warpctc.
-
-    TPU design: warpctc's hand-scheduled CUDA alpha/beta kernels become a
-    single ``lax.scan`` over time of the log-semiring alpha recursion on
-    the extended (blank-interleaved) label sequence — static shapes,
-    batch-vectorized, masked for variable time/label lengths. The backward
-    pass is jax autodiff through the scan, which reproduces the classic
-    beta-recursion gradient without a hand-written kernel.
-    """
-    lp = jax.nn.log_softmax(_f32up(_v(log_probs)), axis=-1)
-    labels = _v(labels)
-    input_lengths = jnp.asarray(input_lengths, jnp.int32)
-    label_lengths = jnp.asarray(label_lengths, jnp.int32)
-    T, B, C = lp.shape
-    L = labels.shape[1]
-    S = 2 * L + 1
-    neg_inf = jnp.asarray(-1e30, lp.dtype)
-
-    # extended sequence: [blank, l0, blank, l1, ..., blank]
-    s_idx = jnp.arange(S)
-    lab_pos = jnp.clip((s_idx - 1) // 2, 0, L - 1)
-    is_label = (s_idx % 2) == 1
-    ext = jnp.where(is_label[None, :], labels[:, lab_pos], blank)  # [B, S]
-
-    # skip transition s-2 -> s allowed iff ext[s] is a label differing
-    # from ext[s-2]
-    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
-    skip_ok = is_label[None, :] & (ext != ext_m2) & (s_idx[None, :] >= 2)
-
-    # per-step emission log-probs for every extended position: [T, B, S]
-    emit = jnp.take_along_axis(
-        lp, jnp.broadcast_to(ext[None], (T, B, S)), axis=2
-    )
-
-    alpha0 = jnp.full((B, S), neg_inf)
-    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
-    if S > 1:
-        # first label only reachable if the sequence is non-empty
-        alpha0 = alpha0.at[:, 1].set(
-            jnp.where(label_lengths > 0, emit[0, :, 1], neg_inf)
-        )
-
-    def _shift(a, k):
-        return jnp.pad(a, ((0, 0), (k, 0)), constant_values=neg_inf)[:, :S]
-
-    def step(alpha, xs):
-        emit_t, t = xs
-        a1 = alpha
-        a2 = _shift(alpha, 1)
-        a3 = jnp.where(skip_ok, _shift(alpha, 2), neg_inf)
-        stacked = jnp.stack([a1, a2, a3])
-        m = jnp.max(stacked, axis=0)
-        new = m + jnp.log(
-            jnp.sum(jnp.exp(stacked - m[None]), axis=0)
-        ) + emit_t
-        # freeze alpha once past each sequence's input length
-        alpha = jnp.where((t < input_lengths)[:, None], new, alpha)
-        return alpha, None
-
-    alpha, _ = lax.scan(step, alpha0, (emit[1:], jnp.arange(1, T)))
-
-    last = 2 * label_lengths  # final blank position in the extended seq
-    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
-    a_prev = jnp.where(
-        label_lengths > 0,
-        jnp.take_along_axis(
-            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1
-        )[:, 0],
-        neg_inf,
-    )
-    m = jnp.maximum(a_last, a_prev)
-    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
-    loss = -ll
-    if norm_by_times:
-        loss = loss / jnp.maximum(input_lengths, 1).astype(loss.dtype)
-    if reduction == "mean":
-        # paddle: divide each loss by its label length, then mean
-        return jnp.mean(
-            loss / jnp.maximum(label_lengths, 1).astype(loss.dtype)
-        )
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
-
-
-# ---------------------------------------------------------------------------
-# interpolate / grid_sample
-# ---------------------------------------------------------------------------
-def _resize_src_index(out_len, in_len, align_corners, align_mode=0):
-    i = jnp.arange(out_len, dtype=jnp.float32)
-    if align_corners:
-        if out_len == 1:
-            return jnp.zeros((1,), jnp.float32)
-        return i * (in_len - 1) / (out_len - 1)
-    if align_mode == 1:   # paddle asymmetric mode: src = i·in/out
-        return jnp.clip(i * in_len / out_len, 0.0, in_len - 1.0)
-    return jnp.clip((i + 0.5) * in_len / out_len - 0.5, 0.0,
-                    in_len - 1.0)
-
-
-def _cubic_weights(out_len, in_len, align_corners, a=-0.75):
-    """Separable cubic-convolution matrix [out, in] with the torch/paddle
-    kernel (a = -0.75) and border-replicated taps."""
-    if align_corners:
-        src = _resize_src_index(out_len, in_len, True)
-    else:
-        # raw half-pixel coordinate (unclipped — edge taps replicate via
-        # the index clamp below)
-        i = jnp.arange(out_len, dtype=jnp.float32)
-        src = (i + 0.5) * in_len / out_len - 0.5
-    base = jnp.floor(src).astype(jnp.int32)
-    t = src - base
-
-    def k(x):
-        ax = jnp.abs(x)
-        w1 = (a + 2) * ax ** 3 - (a + 3) * ax ** 2 + 1
-        w2 = a * ax ** 3 - 5 * a * ax ** 2 + 8 * a * ax - 4 * a
-        return jnp.where(ax <= 1, w1, jnp.where(ax < 2, w2, 0.0))
-
-    m = jnp.zeros((out_len, in_len))
-    rows = jnp.arange(out_len)
-    for off in (-1, 0, 1, 2):
-        idx = jnp.clip(base + off, 0, in_len - 1)
-        m = m.at[rows, idx].add(k(t - off))
-    return m
-
-
-def _lin_weights(out_len, in_len, align_corners, align_mode=0):
-    """Separable 1-D interpolation matrix [out_len, in_len]."""
-    src = _resize_src_index(out_len, in_len, align_corners, align_mode)
-    lo = jnp.floor(src).astype(jnp.int32)
-    hi = jnp.minimum(lo + 1, in_len - 1)
-    w_hi = src - lo
-    m = jnp.zeros((out_len, in_len))
-    m = m.at[jnp.arange(out_len), lo].add(1.0 - w_hi)
-    m = m.at[jnp.arange(out_len), hi].add(w_hi)
-    return m
-
-
-def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, align_mode=0, data_format="NCHW"):
-    """Parity: paddle.nn.functional.interpolate — 3-D NCW (linear /
-    nearest), 4-D NCHW/NHWC (nearest / bilinear / bicubic / area), 5-D
-    NCDHW (trilinear / nearest).
-
-    TPU design: linear modes are separable [out, in] matmuls (MXU ops,
-    trivially fused by XLA) rather than gathers; nearest is a pure
-    gather; area is adaptive average pooling.
-    """
-    x = _v(x)
-    if data_format in ("NWC", "NHWC", "NDHWC"):
-        fmt = {"NWC": "NCW", "NHWC": "NCHW", "NDHWC": "NCDHW"}
-        return jnp.moveaxis(
-            interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor, mode,
-                        align_corners, align_mode, fmt[data_format]),
-            1, -1)
-    if x.ndim == 3:
-        n, c, w = x.shape
-        if size is not None:
-            ow = size if isinstance(size, int) else tuple(size)[0]
-        else:
-            sf = scale_factor if not isinstance(
-                scale_factor, (tuple, list)) else scale_factor[0]
-            ow = int(w * sf)
-        if mode == "nearest":
-            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
-            return x[:, :, ix]
-        if mode == "linear":
-            mx = _lin_weights(ow, w, align_corners, align_mode)
-            return jnp.einsum("Ow,ncw->ncO", mx, x).astype(x.dtype)
-        raise ValueError(f"interpolate 3-D: unknown mode {mode!r}")
-    if x.ndim == 5:
-        n, c, d, h, w = x.shape
-        if size is not None:
-            od, oh, ow = (size,) * 3 if isinstance(size, int) \
-                else tuple(size)
-        else:
-            sf = (scale_factor,) * 3 if not isinstance(
-                scale_factor, (tuple, list)) else scale_factor
-            od, oh, ow = int(d * sf[0]), int(h * sf[1]), int(w * sf[2])
-        if mode == "nearest":
-            iz = jnp.minimum(jnp.arange(od) * d // od, d - 1)
-            iy = jnp.minimum(jnp.arange(oh) * h // oh, h - 1)
-            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
-            return x[:, :, iz][:, :, :, iy][:, :, :, :, ix]
-        if mode == "trilinear":
-            mz = _lin_weights(od, d, align_corners, align_mode)
-            my = _lin_weights(oh, h, align_corners, align_mode)
-            mx = _lin_weights(ow, w, align_corners, align_mode)
-            return jnp.einsum(
-                "Dd,Hh,Ww,ncdhw->ncDHW", mz, my, mx, x
-            ).astype(x.dtype)
-        raise ValueError(f"interpolate 5-D: unknown mode {mode!r}")
-    n, c, h, w = x.shape
-    if size is not None:
-        oh, ow = (size, size) if isinstance(size, int) else tuple(size)
-    else:
-        sf = (scale_factor, scale_factor) if not isinstance(
-            scale_factor, (tuple, list)) else scale_factor
-        oh, ow = int(h * sf[0]), int(w * sf[1])
-    if mode == "nearest":
-        # paddle/torch nearest: floor(i * in/out)
-        iy = jnp.minimum((jnp.arange(oh) * h // oh), h - 1)
-        ix = jnp.minimum((jnp.arange(ow) * w // ow), w - 1)
-        return x[:, :, iy][:, :, :, ix]
-    if mode == "bilinear":
-        my = _lin_weights(oh, h, align_corners, align_mode)
-        mx = _lin_weights(ow, w, align_corners, align_mode)
-        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
-    if mode == "bicubic":
-        my = _cubic_weights(oh, h, align_corners)
-        mx = _cubic_weights(ow, w, align_corners)
-        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
-    if mode == "area":
-        return adaptive_avg_pool2d(x, (oh, ow))
-    raise ValueError(f"interpolate: unknown mode {mode!r}")
-
-
-def upsample(x, size=None, scale_factor=None, mode="nearest",
-             align_corners=False, align_mode=0, data_format="NCHW"):
-    return interpolate(x, size, scale_factor, mode, align_corners,
-                       align_mode, data_format)
-
-
-def _unnormalize_coord(g, size, align_corners):
-    if align_corners:
-        return (g + 1.0) * 0.5 * (size - 1)
-    return ((g + 1.0) * size - 1.0) * 0.5
-
-
-def _reflect_coord(p, size, align_corners):
-    if align_corners:
-        span = 2.0 * (size - 1)
-        if size == 1:
-            return jnp.zeros_like(p)
-        p = jnp.abs(jnp.mod(p, span))
-        return jnp.where(p > size - 1, span - p, p)
-    span = 2.0 * size
-    p = jnp.mod(p + 0.5, span)
-    p = jnp.abs(p)
-    p = jnp.where(p > size, span - p, p)
-    return jnp.clip(p - 0.5, 0.0, size - 1.0)
-
-
-def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
-                align_corners=True):
-    """Parity: paddle.nn.functional.grid_sample. x [N, C, H, W]; grid
-    [N, Hg, Wg, 2] with normalized (x, y) in [-1, 1]. One batched
-    bilinear gather — autodiff replaces the reference's atomic-add
-    backward kernel."""
-    if mode not in ("bilinear", "nearest"):
-        raise ValueError(f"grid_sample: unknown mode {mode!r}")
-    if padding_mode not in ("zeros", "border", "reflection"):
-        raise ValueError(
-            f"grid_sample: unknown padding_mode {padding_mode!r}")
-    x = _v(x)
-    grid = _v(grid)
-    n, c, h, w = x.shape
-    gx = _unnormalize_coord(_f32up(grid[..., 0]), w, align_corners)
-    gy = _unnormalize_coord(_f32up(grid[..., 1]), h, align_corners)
-    if padding_mode == "reflection":
-        gx = _reflect_coord(gx, w, align_corners)
-        gy = _reflect_coord(gy, h, align_corners)
-
-    def sample_one(feat, yy, xx):
-        if padding_mode == "zeros":
-            ring = jnp.pad(feat, ((0, 0), (1, 1), (1, 1)))
-            far = (yy < -1.0) | (yy > h) | (xx < -1.0) | (xx > w)
-            yy2 = jnp.clip(yy + 1.0, 0.0, h + 1.0)
-            xx2 = jnp.clip(xx + 1.0, 0.0, w + 1.0)
-            if mode == "nearest":
-                iy = jnp.round(yy2).astype(jnp.int32)
-                ix = jnp.round(xx2).astype(jnp.int32)
-                vals = ring[:, iy, ix]
-            else:
-                vals = _bilerp(ring, yy2, xx2)
-            return jnp.where(far[None], 0.0, vals)
-        yy2 = jnp.clip(yy, 0.0, h - 1.0)
-        xx2 = jnp.clip(xx, 0.0, w - 1.0)
-        if mode == "nearest":
-            return feat[:, jnp.round(yy2).astype(jnp.int32),
-                        jnp.round(xx2).astype(jnp.int32)]
-        return _bilerp(feat, yy2, xx2)
-
-    return jax.vmap(sample_one)(x, gy, gx).astype(x.dtype)
-
-
-def _bilerp(feat, y, x):
-    """feat [C, H, W]; y/x same-shaped float grids → [C, *grid]."""
-    H, W = feat.shape[-2:]
-    y0 = jnp.floor(y).astype(jnp.int32)
-    x0 = jnp.floor(x).astype(jnp.int32)
-    y1 = jnp.minimum(y0 + 1, H - 1)
-    x1 = jnp.minimum(x0 + 1, W - 1)
-    wy1 = y - y0
-    wx1 = x - x0
-    return (feat[:, y0, x0] * ((1 - wy1) * (1 - wx1))
-            + feat[:, y0, x1] * ((1 - wy1) * wx1)
-            + feat[:, y1, x0] * (wy1 * (1 - wx1))
-            + feat[:, y1, x1] * (wy1 * wx1))
-
-
-# ---------------------------------------------------------------------------
-# functional loss forms (parity: python/paddle/nn/functional/loss.py);
-# the corresponding nn.layer.loss classes delegate here
-# ---------------------------------------------------------------------------
-def _reduce_loss(loss, reduction):
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
-
-
-def kl_div(input, label, reduction="mean"):  # noqa: A002
-    """input is LOG-probabilities (paddle convention)."""
-    x, t = _v(input), _v(label)
-    loss = t * (jnp.log(jnp.clip(t, 1e-30)) - x)
-    if reduction == "batchmean":
-        return jnp.sum(loss) / x.shape[0]
-    return _reduce_loss(loss, reduction)
-
-
-def margin_ranking_loss(input, other, label, margin=0.0,
-                        reduction="mean"):  # noqa: A002
-    loss = jnp.maximum(
-        0.0, -_v(label) * (_v(input) - _v(other)) + margin)
-    return _reduce_loss(loss, reduction)
-
-
-def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
-    d = jnp.abs(_v(input) - _v(label))
-    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
-    return _reduce_loss(loss, reduction)
-
-
-def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
-                        epsilon=1e-6, swap=False,
-                        reduction="mean"):  # noqa: A002
-    def dist(a, b):
-        return jnp.power(
-            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
-            1.0 / p)
-
-    a, pos, neg = _v(input), _v(positive), _v(negative)
-    d_pos = dist(a, pos)
-    d_neg = dist(a, neg)
-    if swap:
-        d_neg = jnp.minimum(d_neg, dist(pos, neg))
-    return _reduce_loss(jnp.maximum(0.0, d_pos - d_neg + margin),
-                        reduction)
-
-
-def cosine_embedding_loss(input1, input2, label, margin=0.0,
-                          reduction="mean"):
-    x1, x2 = _v(input1), _v(input2)
-    if x1.ndim == 1:      # paddle accepts a single [M] pair
-        x1, x2 = x1[None], x2[None]
-    cos = cosine_similarity(x1, x2, axis=1)
-    loss = jnp.where(_v(label) > 0, 1.0 - cos,
-                     jnp.maximum(0.0, cos - margin))
-    return _reduce_loss(loss, reduction)
-
-
-def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
-    return _reduce_loss(jax.nn.softplus(-_v(label) * _v(input)),
-                        reduction)
-
-
-def hinge_embedding_loss(input, label, margin=1.0,
-                         reduction="mean"):  # noqa: A002
-    x = _v(input)
-    loss = jnp.where(_v(label) > 0, x, jnp.maximum(0.0, margin - x))
-    return _reduce_loss(loss, reduction)
-
-
-def poisson_nll_loss(input, label, log_input=True, full=False,
-                     epsilon=1e-8, reduction="mean"):  # noqa: A002
-    x, t = _v(input), _v(label)
-    if log_input:
-        loss = jnp.exp(x) - t * x
-    else:
-        loss = x - t * jnp.log(x + epsilon)
-    if full:
-        stirling = (t * jnp.log(t) - t
-                    + 0.5 * jnp.log(2.0 * jnp.pi * t))
-        loss = loss + jnp.where(t > 1, stirling, 0.0)
-    return _reduce_loss(loss, reduction)
-
-
-def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
-                      reduction="mean"):  # noqa: A002
-    var = jnp.maximum(_v(variance), epsilon)
-    loss = 0.5 * (jnp.log(var) + jnp.square(_v(input) - _v(label)) / var)
-    if full:
-        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
-    return _reduce_loss(loss, reduction)
-
-
-def multi_label_soft_margin_loss(input, label, weight=None,
-                                 reduction="mean"):  # noqa: A002
-    x, t = _v(input), _v(label)
-    loss = -(t * jax.nn.log_sigmoid(x)
-             + (1 - t) * jax.nn.log_sigmoid(-x))
-    if weight is not None:
-        loss = loss * _v(weight)
-    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
-
-
-def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
-                       gamma=2.0, reduction="sum"):
-    """Parity: paddle.nn.functional.sigmoid_focal_loss (RetinaNet)."""
-    x, t = _f32up(_v(logit)), _v(label).astype(jnp.float32)
-    p = jax.nn.sigmoid(x)
-    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
-    p_t = p * t + (1 - p) * (1 - t)
-    a_t = alpha * t + (1 - alpha) * (1 - t)
-    loss = a_t * jnp.power(1 - p_t, gamma) * ce
-    if normalizer is not None:
-        loss = loss / _v(normalizer)
-    return _reduce_loss(loss, reduction)
-
-
-def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
-    """Parity: paddle.nn.functional.dice_loss — input [N, ..., C]
-    probabilities, label [N, ..., 1] class ids."""
-    x = _v(input)
-    t = jax.nn.one_hot(jnp.squeeze(_v(label), -1), x.shape[-1],
-                       dtype=x.dtype)
-    reduce_dims = tuple(range(1, x.ndim))
-    inter = jnp.sum(x * t, axis=reduce_dims)
-    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(t, axis=reduce_dims)
-    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
-
-
-def log_loss(input, label, epsilon=1e-4):  # noqa: A002
-    """Parity: paddle.nn.functional.log_loss (probability input)."""
-    x, t = _v(input), _v(label)
-    return -(t * jnp.log(x + epsilon)
-             + (1 - t) * jnp.log(1 - x + epsilon))
-
-
-def square_error_cost(input, label):  # noqa: A002
-    return jnp.square(_v(input) - _v(label))
-
-
-# ---------------------------------------------------------------------------
-# remaining activation functional forms (parity: paddle.nn.functional —
-# the activation Layer classes keep their own thin forwards; these are
-# the F.* spellings)
-# ---------------------------------------------------------------------------
-def log_sigmoid(x):
-    return jax.nn.log_sigmoid(_v(x))
-
-
-def softsign(x):
-    return jax.nn.soft_sign(_v(x))
-
-
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
-    # jax.nn.elu guards expm1 against overflow in the untaken branch
-    # (bare where leaks NaN grads at large positive x)
-    return scale * jax.nn.elu(_v(x), alpha)
-
-
-def celu(x, alpha=1.0):
-    return jax.nn.celu(_v(x), alpha)
-
-
-def hardshrink(x, threshold=0.5):
-    x = _v(x)
-    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
-
-
-def softshrink(x, threshold=0.5):
-    x = _v(x)
-    return jnp.where(x > threshold, x - threshold,
-                     jnp.where(x < -threshold, x + threshold, 0.0))
-
-
-def tanhshrink(x):
-    x = _v(x)
-    return x - jnp.tanh(x)
-
-
-def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
-    return jnp.clip(_v(x), min, max)
-
-
-def thresholded_relu(x, threshold=1.0):
-    x = _v(x)
-    return jnp.where(x > threshold, x, 0.0)
-
-
-def prelu(x, weight):
-    """weight: scalar-shaped [1] or per-channel [C] (paddle NCHW
-    channel-1 convention for >2-D inputs)."""
-    x, w = _v(x), _v(weight)
-    if w.size > 1 and x.ndim > 2:
-        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
-    return jnp.where(x > 0, x, w * x)
-
-
-def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True,
-          rng_key=None):
-    """Randomized leaky ReLU: U[lower, upper] slope in training, the
-    midpoint at inference (paddle semantics)."""
-    x = _v(x)
-    if not training:
-        return jnp.where(x > 0, x, (lower + upper) / 2.0 * x)
-    key = rng_key if rng_key is not None else \
-        random_mod.next_rng_key("rrelu")
-    slope = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
-    return jnp.where(x > 0, x, slope.astype(x.dtype) * x)
-
-
-def maxout(x, groups, axis=1):
-    """Parity: paddle.nn.functional.maxout — max over ``groups``-sized
-    channel blocks."""
-    x = _v(x)
-    axis = axis % x.ndim          # negative axis: normalize BEFORE the
-    c = x.shape[axis]             # slice-splice below
-    if c % groups:
-        raise ValueError(f"maxout: channels {c} not divisible by "
-                         f"groups {groups}")
-    shape = list(x.shape)
-    shape[axis: axis + 1] = [c // groups, groups]
-    return jnp.max(x.reshape(shape), axis=axis + 1)
+from .activation import (  # noqa: F401
+    celu,
+    elu,
+    gelu,
+    glu,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    leaky_relu,
+    log_sigmoid,
+    log_softmax,
+    maxout,
+    mish,
+    prelu,
+    relu,
+    relu6,
+    rrelu,
+    selu,
+    sigmoid,
+    silu,
+    softmax,
+    softplus,
+    softshrink,
+    softsign,
+    swiglu,
+    swish,
+    tanh,
+    tanhshrink,
+    thresholded_relu,
+)
+from .common import (  # noqa: F401
+    _f32up,
+    _v,
+    cosine_similarity,
+    dropout,
+    interpolate,
+    linear,
+    pad,
+    upsample,
+)
+from .conv import (  # noqa: F401
+    conv1d,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+)
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention,
+)
+from .input import embedding, one_hot  # noqa: F401
+from .loss import (  # noqa: F401
+    binary_cross_entropy_with_logits,
+    cosine_embedding_loss,
+    cross_entropy,
+    ctc_loss,
+    dice_loss,
+    gaussian_nll_loss,
+    hinge_embedding_loss,
+    kl_div,
+    l1_loss,
+    log_loss,
+    margin_ranking_loss,
+    mse_loss,
+    multi_label_soft_margin_loss,
+    nll_loss,
+    poisson_nll_loss,
+    sigmoid_focal_loss,
+    smooth_l1_loss,
+    soft_margin_loss,
+    square_error_cost,
+    triplet_margin_loss,
+)
+from .norm import (  # noqa: F401
+    group_norm,
+    layer_norm,
+    normalize,
+    rms_norm,
+)
+from .pooling import (  # noqa: F401
+    adaptive_avg_pool2d,
+    avg_pool2d,
+    max_pool2d,
+)
+from .vision import _bilerp, grid_sample, pixel_shuffle  # noqa: F401

@@ -1,0 +1,160 @@
+"""Activation functional forms (parity: python/paddle/nn/functional/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_mod
+from .common import _v
+
+
+def relu(x):
+    return jax.nn.relu(_v(x))
+
+
+def relu6(x):
+    return jax.nn.relu6(_v(x))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(_v(x), approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(_v(x))
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(_v(x))
+
+
+def tanh(x):
+    return jnp.tanh(_v(x))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(_v(x), negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(_v(x), alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jax.nn.softplus(_v(x) * beta) / beta
+
+
+def hardswish(x):
+    return jax.nn.hard_swish(_v(x))
+
+
+def hardsigmoid(x):
+    x = _v(x)
+    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def mish(x):
+    return jax.nn.mish(_v(x))
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(_v(x), axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(_v(x), axis=axis)
+
+
+def glu(x, axis=-1):
+    return jax.nn.glu(_v(x), axis=axis)
+
+
+def swiglu(x, y=None):
+    """Parity: phi fusion swiglu — silu(x) * y (split x in half if y None)."""
+    x = _v(x)
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * _v(y)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(_v(x))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(_v(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    # jax.nn.elu guards expm1 against overflow in the untaken branch
+    # (bare where leaks NaN grads at large positive x)
+    return scale * jax.nn.elu(_v(x), alpha)
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(_v(x), alpha)
+
+
+def hardshrink(x, threshold=0.5):
+    x = _v(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold=0.5):
+    x = _v(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def tanhshrink(x):
+    x = _v(x)
+    return x - jnp.tanh(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(_v(x), min, max)
+
+
+def thresholded_relu(x, threshold=1.0):
+    x = _v(x)
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def prelu(x, weight):
+    """weight: scalar-shaped [1] or per-channel [C] (paddle NCHW
+    channel-1 convention for >2-D inputs)."""
+    x, w = _v(x), _v(weight)
+    if w.size > 1 and x.ndim > 2:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True,
+          rng_key=None):
+    """Randomized leaky ReLU: U[lower, upper] slope in training, the
+    midpoint at inference (paddle semantics)."""
+    x = _v(x)
+    if not training:
+        return jnp.where(x > 0, x, (lower + upper) / 2.0 * x)
+    key = rng_key if rng_key is not None else \
+        random_mod.next_rng_key("rrelu")
+    slope = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    return jnp.where(x > 0, x, slope.astype(x.dtype) * x)
+
+
+def maxout(x, groups, axis=1):
+    """Parity: paddle.nn.functional.maxout — max over ``groups``-sized
+    channel blocks."""
+    x = _v(x)
+    axis = axis % x.ndim          # negative axis: normalize BEFORE the
+    c = x.shape[axis]             # slice-splice below
+    if c % groups:
+        raise ValueError(f"maxout: channels {c} not divisible by "
+                         f"groups {groups}")
+    shape = list(x.shape)
+    shape[axis: axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)

@@ -1,0 +1,207 @@
+"""Shared helpers + common functional ops (parity: python/paddle/nn/functional/common.py — linear, dropout, pad,
+interpolate/upsample, cosine_similarity)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.parameter import Parameter
+from ...core import random as random_mod
+
+
+def _v(x):
+    return x.value if isinstance(x, Parameter) else x
+
+def _f32up(x):
+    """Upcast to AT LEAST float32 for stable statistics — never downcast
+    (fp64 inputs, e.g. the OpTest finite-difference harness, stay fp64)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). Weight layout [in_features, out_features] (paddle
+    convention, phi kernel matmul_kernel)."""
+    x, weight = _v(x), _v(weight)
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + _v(bias)
+    return y
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
+    x = _v(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    key = rng_key if rng_key is not None else random_mod.next_rng_key("dropout")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+def pad(x, pad_width, mode="constant", value=0.0):
+    x = _v(x)
+    if isinstance(pad_width, (list, tuple)) and pad_width and isinstance(
+        pad_width[0], int
+    ):
+        # paddle/torch flat style: first pair pads the LAST dim, second pair
+        # the second-to-last, etc.
+        pairs = list(zip(pad_width[0::2], pad_width[1::2]))
+        full = [(0, 0)] * (x.ndim - len(pairs)) + pairs[::-1]
+    else:
+        full = pad_width
+    if mode == "constant":
+        return jnp.pad(x, full, constant_values=value)
+    return jnp.pad(x, full, mode=mode)
+
+
+def cosine_similarity(x1, x2, axis=-1, eps=1e-8):
+    x1, x2 = _v(x1), _v(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def _resize_src_index(out_len, in_len, align_corners, align_mode=0):
+    i = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners:
+        if out_len == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * (in_len - 1) / (out_len - 1)
+    if align_mode == 1:   # paddle asymmetric mode: src = i·in/out
+        return jnp.clip(i * in_len / out_len, 0.0, in_len - 1.0)
+    return jnp.clip((i + 0.5) * in_len / out_len - 0.5, 0.0,
+                    in_len - 1.0)
+
+
+def _cubic_weights(out_len, in_len, align_corners, a=-0.75):
+    """Separable cubic-convolution matrix [out, in] with the torch/paddle
+    kernel (a = -0.75) and border-replicated taps."""
+    if align_corners:
+        src = _resize_src_index(out_len, in_len, True)
+    else:
+        # raw half-pixel coordinate (unclipped — edge taps replicate via
+        # the index clamp below)
+        i = jnp.arange(out_len, dtype=jnp.float32)
+        src = (i + 0.5) * in_len / out_len - 0.5
+    base = jnp.floor(src).astype(jnp.int32)
+    t = src - base
+
+    def k(x):
+        ax = jnp.abs(x)
+        w1 = (a + 2) * ax ** 3 - (a + 3) * ax ** 2 + 1
+        w2 = a * ax ** 3 - 5 * a * ax ** 2 + 8 * a * ax - 4 * a
+        return jnp.where(ax <= 1, w1, jnp.where(ax < 2, w2, 0.0))
+
+    m = jnp.zeros((out_len, in_len))
+    rows = jnp.arange(out_len)
+    for off in (-1, 0, 1, 2):
+        idx = jnp.clip(base + off, 0, in_len - 1)
+        m = m.at[rows, idx].add(k(t - off))
+    return m
+
+
+def _lin_weights(out_len, in_len, align_corners, align_mode=0):
+    """Separable 1-D interpolation matrix [out_len, in_len]."""
+    src = _resize_src_index(out_len, in_len, align_corners, align_mode)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_len - 1)
+    w_hi = src - lo
+    m = jnp.zeros((out_len, in_len))
+    m = m.at[jnp.arange(out_len), lo].add(1.0 - w_hi)
+    m = m.at[jnp.arange(out_len), hi].add(w_hi)
+    return m
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    """Parity: paddle.nn.functional.interpolate — 3-D NCW (linear /
+    nearest), 4-D NCHW/NHWC (nearest / bilinear / bicubic / area), 5-D
+    NCDHW (trilinear / nearest).
+
+    TPU design: linear modes are separable [out, in] matmuls (MXU ops,
+    trivially fused by XLA) rather than gathers; nearest is a pure
+    gather; area is adaptive average pooling.
+    """
+    x = _v(x)
+    if data_format in ("NWC", "NHWC", "NDHWC"):
+        fmt = {"NWC": "NCW", "NHWC": "NCHW", "NDHWC": "NCDHW"}
+        return jnp.moveaxis(
+            interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor, mode,
+                        align_corners, align_mode, fmt[data_format]),
+            1, -1)
+    if x.ndim == 3:
+        n, c, w = x.shape
+        if size is not None:
+            ow = size if isinstance(size, int) else tuple(size)[0]
+        else:
+            sf = scale_factor if not isinstance(
+                scale_factor, (tuple, list)) else scale_factor[0]
+            ow = int(w * sf)
+        if mode == "nearest":
+            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
+            return x[:, :, ix]
+        if mode == "linear":
+            mx = _lin_weights(ow, w, align_corners, align_mode)
+            return jnp.einsum("Ow,ncw->ncO", mx, x).astype(x.dtype)
+        raise ValueError(f"interpolate 3-D: unknown mode {mode!r}")
+    if x.ndim == 5:
+        n, c, d, h, w = x.shape
+        if size is not None:
+            od, oh, ow = (size,) * 3 if isinstance(size, int) \
+                else tuple(size)
+        else:
+            sf = (scale_factor,) * 3 if not isinstance(
+                scale_factor, (tuple, list)) else scale_factor
+            od, oh, ow = int(d * sf[0]), int(h * sf[1]), int(w * sf[2])
+        if mode == "nearest":
+            iz = jnp.minimum(jnp.arange(od) * d // od, d - 1)
+            iy = jnp.minimum(jnp.arange(oh) * h // oh, h - 1)
+            ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
+            return x[:, :, iz][:, :, :, iy][:, :, :, :, ix]
+        if mode == "trilinear":
+            mz = _lin_weights(od, d, align_corners, align_mode)
+            my = _lin_weights(oh, h, align_corners, align_mode)
+            mx = _lin_weights(ow, w, align_corners, align_mode)
+            return jnp.einsum(
+                "Dd,Hh,Ww,ncdhw->ncDHW", mz, my, mx, x
+            ).astype(x.dtype)
+        raise ValueError(f"interpolate 5-D: unknown mode {mode!r}")
+    n, c, h, w = x.shape
+    if size is not None:
+        oh, ow = (size, size) if isinstance(size, int) else tuple(size)
+    else:
+        sf = (scale_factor, scale_factor) if not isinstance(
+            scale_factor, (tuple, list)) else scale_factor
+        oh, ow = int(h * sf[0]), int(w * sf[1])
+    if mode == "nearest":
+        # paddle/torch nearest: floor(i * in/out)
+        iy = jnp.minimum((jnp.arange(oh) * h // oh), h - 1)
+        ix = jnp.minimum((jnp.arange(ow) * w // ow), w - 1)
+        return x[:, :, iy][:, :, :, ix]
+    if mode == "bilinear":
+        my = _lin_weights(oh, h, align_corners, align_mode)
+        mx = _lin_weights(ow, w, align_corners, align_mode)
+        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
+    if mode == "bicubic":
+        my = _cubic_weights(oh, h, align_corners)
+        mx = _cubic_weights(ow, w, align_corners)
+        return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
+    if mode == "area":
+        from .pooling import adaptive_avg_pool2d  # lazy: avoids cycle
+
+        return adaptive_avg_pool2d(x, (oh, ow))
+    raise ValueError(f"interpolate: unknown mode {mode!r}")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)

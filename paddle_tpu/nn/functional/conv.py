@@ -1,0 +1,152 @@
+"""Convolution functional forms (parity: python/paddle/nn/functional/conv.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .common import _v
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    """Weight layout [out_c, in_c/groups, k] (paddle convention)."""
+    x, weight = _v(x), _v(weight)
+    if isinstance(stride, int):
+        stride = (stride,)
+    if isinstance(dilation, int):
+        dilation = (dilation,)
+    if isinstance(padding, int):
+        padding = [(padding, padding)]
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCH", "OIH", "NCH") if data_format == "NCL" else
+        ("NHC", "OIH", "NHC"),
+    )
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+        else None,
+    ).astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
+        y = y + _v(bias).reshape(shape)
+    return y
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Weight layout [out_c, in_c/groups, kh, kw] (paddle convention)."""
+    x, weight = _v(x), _v(weight)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 and all(
+        isinstance(p, int) for p in padding
+    ):
+        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"),
+    )
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    y = y.astype(x.dtype)
+    if bias is not None:
+        b = _v(bias)
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        y = y + b.reshape(shape)
+    return y
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    """Weight layout [out_c, in_c/groups, kd, kh, kw]."""
+    x, weight = _v(x), _v(weight)
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(dilation, int):
+        dilation = (dilation,) * 3
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else
+        ("NDHWC", "OIDHW", "NDHWC"),
+    )
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+        else None,
+    ).astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" \
+            else (1, 1, 1, 1, -1)
+        y = y + _v(bias).reshape(shape)
+    return y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    """Gradient/fractionally-strided conv (parity: F.conv2d_transpose).
+    Weight layout [in_c, out_c/groups, kh, kw] (paddle convention).
+    Implemented as conv_general_dilated with lhs_dilation=stride — the
+    exact transpose of the forward conv, which XLA maps to the MXU the
+    same way."""
+    x, weight = _v(x), _v(weight)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(output_padding, int):
+        output_padding = (output_padding, output_padding)
+    kh, kw = weight.shape[-2:]
+    # transpose-conv padding: k - 1 - p on each side (+output_padding low)
+    pads = []
+    for (k, p, op, d) in ((kh, padding[0], output_padding[0], dilation[0]),
+                          (kw, padding[1], output_padding[1], dilation[1])):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + op))
+    # weight [in, out/groups, kh, kw] → flip taps, swap to [out, in/groups]
+    w = jnp.flip(weight, axis=(-2, -1))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)  # [out, in, kh, kw]
+    else:
+        i, og, khw = weight.shape[0], weight.shape[1], weight.shape[2:]
+        w = w.reshape(groups, i // groups, og, *khw)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups, *khw)
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else
+        ("NHWC", "OIHW", "NHWC"),
+    )
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+        else None,
+    ).astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        y = y + _v(bias).reshape(shape)
+    return y

@@ -1,0 +1,70 @@
+"""Attention functional forms (parity: python/paddle/nn/functional/flash_attention.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .common import _f32up, _v, dropout
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    training: bool = True,
+):
+    """Reference attention in pure XLA. Layout: [batch, seq, heads, dim]
+    (paddle flash_attention layout, phi flash_attn kernel).
+
+    The Pallas flash-attention kernel (paddle_tpu.kernels.flash_attention)
+    is preferred on TPU for long sequences; this is the numerics reference
+    and the general fallback (arbitrary masks, GQA).
+    """
+    q, k, v = _v(query), _v(key), _v(value)
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hq != hk:  # grouped-query attention: repeat kv heads
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    # [b, h, sq, sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = _f32up(logits)
+    if is_causal:
+        sk = k.shape[1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, jnp.float32(-1e30))
+    if attn_mask is not None:
+        m = _v(attn_mask)
+        if m.dtype == jnp.bool_:
+            logits = jnp.where(m, logits, jnp.float32(-1e30))
+        else:
+            logits = logits + m.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(
+    query, key, value, dropout=0.0, causal=False, *, training=True, **kw
+):
+    """Parity: paddle.nn.functional.flash_attention.flash_attention.
+
+    Dispatches to the Pallas TPU kernel when running on TPU with supported
+    shapes, else the XLA reference path.
+    """
+    from ...kernels import flash_attention as fa
+
+    return fa.flash_attention(
+        _v(query), _v(key), _v(value), causal=causal,
+        dropout_p=dropout, training=training,
+    )

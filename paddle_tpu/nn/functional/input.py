@@ -1,0 +1,21 @@
+"""Input encodings (parity: python/paddle/nn/functional/input.py — one_hot, embedding)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import _v
+
+
+def one_hot(x, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(_v(x), num_classes, dtype=dtype)
+
+
+def embedding(x, weight, padding_idx=None):
+    x, weight = _v(x), _v(weight)
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out

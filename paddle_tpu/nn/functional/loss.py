@@ -1,0 +1,330 @@
+"""Loss functional forms (parity: python/paddle/nn/functional/loss.py; ctc_loss replaces the vendored warpctc
+with a lax.scan log-semiring recursion)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import _f32up, _v, cosine_similarity
+
+
+def cross_entropy(
+    logits,
+    label,
+    soft_label: bool = False,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    axis: int = -1,
+    label_smoothing: float = 0.0,
+):
+    """Parity: F.cross_entropy (softmax_with_cross_entropy phi kernel).
+
+    Computes in fp32 regardless of input dtype (matching the fused kernel's
+    accumulation behavior).
+    """
+    logits = _f32up(_v(logits))
+    if axis not in (-1, logits.ndim - 1):
+        # normalize to class-dim-last so gathers/one-hots line up
+        logits = jnp.moveaxis(logits, axis, -1)
+        if soft_label:
+            label = jnp.moveaxis(_v(label), axis, -1)
+        axis = -1
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        target = _v(label).astype(logits.dtype)
+        loss = -jnp.sum(target * logp, axis=axis)
+        valid = jnp.ones(loss.shape, jnp.float32)
+    else:
+        label = _v(label)
+        num_classes = logits.shape[axis]
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(label, num_classes, dtype=jnp.float32)
+            smooth = (
+                onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+            )
+            loss = -jnp.sum(smooth * logp, axis=axis)
+        else:
+            safe_label = jnp.where(label == ignore_index, 0, label)
+            loss = -jnp.take_along_axis(
+                logp, safe_label[..., None], axis=axis
+            ).squeeze(axis)
+        valid = (label != ignore_index).astype(jnp.float32)
+        loss = loss * valid
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(loss) / denom
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    d = (_v(input) - _v(label)) ** 2
+    if reduction == "none":
+        return d
+    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    d = jnp.abs(_v(input) - _v(label))
+    if reduction == "none":
+        return d
+    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
+
+
+def nll_loss(log_probs, label, reduction="mean", ignore_index=-100):
+    logp = _v(log_probs)
+    label = _v(label)
+    safe = jnp.where(label == ignore_index, 0, label)
+    loss = -jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
+    valid = (label != ignore_index).astype(loss.dtype)
+    loss = loss * valid
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def binary_cross_entropy_with_logits(logits, label, reduction="mean"):
+    logits = _f32up(_v(logits))
+    label = _v(label).astype(logits.dtype)
+    loss = jnp.maximum(logits, 0) - logits * label + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Parity: paddle.nn.functional.ctc_loss (reference: the warpctc op,
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h, built from the vendored
+    third_party warpctc — SURVEY §2.3). ``log_probs`` are UNNORMALIZED
+    logits of shape [max_time, batch, num_classes]; softmax is applied
+    internally, matching warpctc.
+
+    TPU design: warpctc's hand-scheduled CUDA alpha/beta kernels become a
+    single ``lax.scan`` over time of the log-semiring alpha recursion on
+    the extended (blank-interleaved) label sequence — static shapes,
+    batch-vectorized, masked for variable time/label lengths. The backward
+    pass is jax autodiff through the scan, which reproduces the classic
+    beta-recursion gradient without a hand-written kernel.
+    """
+    lp = jax.nn.log_softmax(_f32up(_v(log_probs)), axis=-1)
+    labels = _v(labels)
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+    T, B, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+    # extended sequence: [blank, l0, blank, l1, ..., blank]
+    s_idx = jnp.arange(S)
+    lab_pos = jnp.clip((s_idx - 1) // 2, 0, L - 1)
+    is_label = (s_idx % 2) == 1
+    ext = jnp.where(is_label[None, :], labels[:, lab_pos], blank)  # [B, S]
+
+    # skip transition s-2 -> s allowed iff ext[s] is a label differing
+    # from ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    skip_ok = is_label[None, :] & (ext != ext_m2) & (s_idx[None, :] >= 2)
+
+    # per-step emission log-probs for every extended position: [T, B, S]
+    emit = jnp.take_along_axis(
+        lp, jnp.broadcast_to(ext[None], (T, B, S)), axis=2
+    )
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    if S > 1:
+        # first label only reachable if the sequence is non-empty
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(label_lengths > 0, emit[0, :, 1], neg_inf)
+        )
+
+    def _shift(a, k):
+        return jnp.pad(a, ((0, 0), (k, 0)), constant_values=neg_inf)[:, :S]
+
+    def step(alpha, xs):
+        emit_t, t = xs
+        a1 = alpha
+        a2 = _shift(alpha, 1)
+        a3 = jnp.where(skip_ok, _shift(alpha, 2), neg_inf)
+        stacked = jnp.stack([a1, a2, a3])
+        m = jnp.max(stacked, axis=0)
+        new = m + jnp.log(
+            jnp.sum(jnp.exp(stacked - m[None]), axis=0)
+        ) + emit_t
+        # freeze alpha once past each sequence's input length
+        alpha = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return alpha, None
+
+    alpha, _ = lax.scan(step, alpha0, (emit[1:], jnp.arange(1, T)))
+
+    last = 2 * label_lengths  # final blank position in the extended seq
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1
+        )[:, 0],
+        neg_inf,
+    )
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths, 1).astype(loss.dtype)
+    if reduction == "mean":
+        # paddle: divide each loss by its label length, then mean
+        return jnp.mean(
+            loss / jnp.maximum(label_lengths, 1).astype(loss.dtype)
+        )
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    """input is LOG-probabilities (paddle convention)."""
+    x, t = _v(input), _v(label)
+    loss = t * (jnp.log(jnp.clip(t, 1e-30)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(
+        0.0, -_v(label) * (_v(input) - _v(other)) + margin)
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.abs(_v(input) - _v(label))
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False,
+                        reduction="mean"):  # noqa: A002
+    def dist(a, b):
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
+            1.0 / p)
+
+    a, pos, neg = _v(input), _v(positive), _v(negative)
+    d_pos = dist(a, pos)
+    d_neg = dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    return _reduce_loss(jnp.maximum(0.0, d_pos - d_neg + margin),
+                        reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    x1, x2 = _v(input1), _v(input2)
+    if x1.ndim == 1:      # paddle accepts a single [M] pair
+        x1, x2 = x1[None], x2[None]
+    cos = cosine_similarity(x1, x2, axis=1)
+    loss = jnp.where(_v(label) > 0, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce_loss(jax.nn.softplus(-_v(label) * _v(input)),
+                        reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0,
+                         reduction="mean"):  # noqa: A002
+    x = _v(input)
+    loss = jnp.where(_v(label) > 0, x, jnp.maximum(0.0, margin - x))
+    return _reduce_loss(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):  # noqa: A002
+    x, t = _v(input), _v(label)
+    if log_input:
+        loss = jnp.exp(x) - t * x
+    else:
+        loss = x - t * jnp.log(x + epsilon)
+    if full:
+        stirling = (t * jnp.log(t) - t
+                    + 0.5 * jnp.log(2.0 * jnp.pi * t))
+        loss = loss + jnp.where(t > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):  # noqa: A002
+    var = jnp.maximum(_v(variance), epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(_v(input) - _v(label)) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
+    return _reduce_loss(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):  # noqa: A002
+    x, t = _v(input), _v(label)
+    loss = -(t * jax.nn.log_sigmoid(x)
+             + (1 - t) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * _v(weight)
+    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    """Parity: paddle.nn.functional.sigmoid_focal_loss (RetinaNet)."""
+    x, t = _f32up(_v(logit)), _v(label).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / _v(normalizer)
+    return _reduce_loss(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    """Parity: paddle.nn.functional.dice_loss — input [N, ..., C]
+    probabilities, label [N, ..., 1] class ids."""
+    x = _v(input)
+    t = jax.nn.one_hot(jnp.squeeze(_v(label), -1), x.shape[-1],
+                       dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * t, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(t, axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    """Parity: paddle.nn.functional.log_loss (probability input)."""
+    x, t = _v(input), _v(label)
+    return -(t * jnp.log(x + epsilon)
+             + (1 - t) * jnp.log(1 - x + epsilon))
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(_v(input) - _v(label))

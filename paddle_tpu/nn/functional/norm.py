@@ -1,0 +1,62 @@
+"""Normalization functional forms (parity: python/paddle/nn/functional/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .common import _f32up, _v
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    x = _v(x)
+    # compute statistics in fp32 for bf16 inputs (parity: phi layer_norm
+    # kernel accumulates in float)
+    xf = _f32up(x)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight)
+    if bias is not None:
+        y = y + _v(bias)
+    return y
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Parity: phi fusion rms_norm kernel."""
+    x = _v(x)
+    xf = _f32up(x)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight)
+    return y
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    x = _v(x)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = num_groups
+    xf = _f32up(x).reshape(n, g, c // g, *spatial)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, *spatial).astype(x.dtype)
+    if weight is not None:
+        y = y * _v(weight).reshape(1, c, *([1] * len(spatial)))
+    if bias is not None:
+        y = y + _v(bias).reshape(1, c, *([1] * len(spatial)))
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def normalize(x, p=2, axis=-1, epsilon=1e-12):
+    x = _v(x)
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)

@@ -1,0 +1,84 @@
+"""Pooling functional forms (parity: python/paddle/nn/functional/pooling.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .common import _v
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if data_format == "NCHW":
+        window = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + list(padding)
+    else:
+        window = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + list(padding) + [(0, 0)]
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, window, strides, pads,
+    )
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if data_format == "NCHW":
+        window = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + list(padding)
+    else:
+        window = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + list(padding) + [(0, 0)]
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, window, strides, pads
+    )
+    return summed / counts
+
+
+def _adaptive_avg_matrix(out_len, in_len):
+    """[out, in] row-stochastic bin-average matrix with the reference's
+    adaptive bin edges: start = floor(i·in/out), end = ceil((i+1)·in/out).
+    Makes adaptive pooling two separable matmuls (MXU-shaped)."""
+    i = jnp.arange(out_len)
+    start = jnp.floor(i * in_len / out_len).astype(jnp.int32)
+    end = jnp.ceil((i + 1) * in_len / out_len).astype(jnp.int32)
+    j = jnp.arange(in_len)
+    mask = (j[None, :] >= start[:, None]) & (j[None, :] < end[:, None])
+    m = mask.astype(jnp.float32)
+    return m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    x = _v(x)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if data_format == "NHWC":
+        return jnp.moveaxis(
+            adaptive_avg_pool2d(jnp.moveaxis(x, -1, 1), output_size), 1, -1)
+    h, w = x.shape[2], x.shape[3]
+    if h % output_size[0] == 0 and w % output_size[1] == 0:
+        k = (h // output_size[0], w // output_size[1])
+        return avg_pool2d(x, k, k, 0, data_format)
+    my = _adaptive_avg_matrix(output_size[0], h)
+    mx = _adaptive_avg_matrix(output_size[1], w)
+    return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)

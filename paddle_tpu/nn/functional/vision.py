@@ -1,0 +1,99 @@
+"""Vision functional forms (parity: python/paddle/nn/functional/vision.py — grid_sample, pixel_shuffle)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import _f32up, _v
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = x.reshape(b, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(b, c // (r * r), h * r, w * r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, h * r, w * r, c // (r * r))
+
+
+def _unnormalize_coord(g, size, align_corners):
+    if align_corners:
+        return (g + 1.0) * 0.5 * (size - 1)
+    return ((g + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect_coord(p, size, align_corners):
+    if align_corners:
+        span = 2.0 * (size - 1)
+        if size == 1:
+            return jnp.zeros_like(p)
+        p = jnp.abs(jnp.mod(p, span))
+        return jnp.where(p > size - 1, span - p, p)
+    span = 2.0 * size
+    p = jnp.mod(p + 0.5, span)
+    p = jnp.abs(p)
+    p = jnp.where(p > size, span - p, p)
+    return jnp.clip(p - 0.5, 0.0, size - 1.0)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Parity: paddle.nn.functional.grid_sample. x [N, C, H, W]; grid
+    [N, Hg, Wg, 2] with normalized (x, y) in [-1, 1]. One batched
+    bilinear gather — autodiff replaces the reference's atomic-add
+    backward kernel."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unknown mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample: unknown padding_mode {padding_mode!r}")
+    x = _v(x)
+    grid = _v(grid)
+    n, c, h, w = x.shape
+    gx = _unnormalize_coord(_f32up(grid[..., 0]), w, align_corners)
+    gy = _unnormalize_coord(_f32up(grid[..., 1]), h, align_corners)
+    if padding_mode == "reflection":
+        gx = _reflect_coord(gx, w, align_corners)
+        gy = _reflect_coord(gy, h, align_corners)
+
+    def sample_one(feat, yy, xx):
+        if padding_mode == "zeros":
+            ring = jnp.pad(feat, ((0, 0), (1, 1), (1, 1)))
+            far = (yy < -1.0) | (yy > h) | (xx < -1.0) | (xx > w)
+            yy2 = jnp.clip(yy + 1.0, 0.0, h + 1.0)
+            xx2 = jnp.clip(xx + 1.0, 0.0, w + 1.0)
+            if mode == "nearest":
+                iy = jnp.round(yy2).astype(jnp.int32)
+                ix = jnp.round(xx2).astype(jnp.int32)
+                vals = ring[:, iy, ix]
+            else:
+                vals = _bilerp(ring, yy2, xx2)
+            return jnp.where(far[None], 0.0, vals)
+        yy2 = jnp.clip(yy, 0.0, h - 1.0)
+        xx2 = jnp.clip(xx, 0.0, w - 1.0)
+        if mode == "nearest":
+            return feat[:, jnp.round(yy2).astype(jnp.int32),
+                        jnp.round(xx2).astype(jnp.int32)]
+        return _bilerp(feat, yy2, xx2)
+
+    return jax.vmap(sample_one)(x, gy, gx).astype(x.dtype)
+
+
+def _bilerp(feat, y, x):
+    """feat [C, H, W]; y/x same-shaped float grids → [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy1 = y - y0
+    wx1 = x - x0
+    return (feat[:, y0, x0] * ((1 - wy1) * (1 - wx1))
+            + feat[:, y0, x1] * ((1 - wy1) * wx1)
+            + feat[:, y1, x0] * (wy1 * (1 - wx1))
+            + feat[:, y1, x1] * (wy1 * wx1))

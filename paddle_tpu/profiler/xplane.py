@@ -32,13 +32,39 @@ _COLLECTIVE_MARKERS = (
     "all-to-all", "allreduce", "allgather", "collectivepermute",
     "send", "recv",
 )
-_MATMUL_MARKERS = ("dot", "conv", "matmul", "mxu", "gemm", "einsum")
+# "convolution", not "conv": the bare substring also matches dtype
+# "convert" fusions, booking cast time under matmul/conv
+_MATMUL_MARKERS = ("dot", "convolution", "matmul", "mxu", "gemm", "einsum")
 _COPY_MARKERS = ("copy", "transpose", "reshape", "bitcast", "dynamic-slice",
                  "dynamic-update-slice", "concatenate", "pad", "slice")
 _INFEED_MARKERS = ("infeed", "outfeed", "host-transfer")
 
 
-def categorize(op_name: str) -> str:
+# XLA trace events carry an ``hlo_category`` arg (e.g. "convolution
+# fusion" for a fused GEMM, "custom-call" for a Pallas kernel); prefer
+# it — name heuristics mislabel fusions ("bitcast_add_fusion" is a GEMM)
+_CONTAINER_CATEGORIES = ("while", "conditional", "call")
+
+
+def categorize(op_name: str, hlo_category: str = "") -> str:
+    c = (hlo_category or "").lower()
+    if c:
+        if any(m in c for m in _COLLECTIVE_MARKERS):
+            return "collective"
+        if ("convolution" in c or "dot" in c or "matmul" in c
+                or "einsum" in c):
+            return "matmul/conv"
+        if "custom-call" in c or "custom call" in c:
+            return "custom-call (pallas)"
+        if any(m in c for m in _INFEED_MARKERS):
+            return "infeed/outfeed"
+        if any(m in c for m in _COPY_MARKERS):
+            return "copy/layout"
+        if c != "fusion" and not c.endswith(" fusion"):
+            # a real XLA category we have no bucket for (e.g.
+            # "non-fusion elementwise") — surface it as-is; generic
+            # fusion categories fall through to the name heuristics
+            return c
     n = op_name.lower()
     if any(m in n for m in _COLLECTIVE_MARKERS):
         return "collective"
@@ -144,10 +170,16 @@ def device_op_summary(log_dir: str, top: int = 0
         if use_all and ("step" in tname or "framework" in tname):
             continue  # step markers duplicate the op time underneath
         name = e.get("name", "?")
+        args = e.get("args") or {}
+        hlo_cat = str(args.get("hlo_category", ""))
+        # while/cond wrapper events cover their body ops, which appear
+        # as separate events — counting both double-books the time
+        if hlo_cat.lower() in _CONTAINER_CATEGORIES:
+            continue
         dur_ms = float(e.get("dur", 0.0)) / 1e3  # chrome dur is in us
         row = agg.get(name)
         if row is None:
-            agg[name] = OpRow(name, dur_ms, 1, categorize(name))
+            agg[name] = OpRow(name, dur_ms, 1, categorize(name, hlo_cat))
         else:
             row.total_ms += dur_ms
             row.count += 1

@@ -211,6 +211,51 @@ def test_xplane_device_op_summary(tmp_path):
     assert s.rows[0].name == "fusion.dot.1"
 
 
+def test_xplane_hlo_category_attribution(tmp_path):
+    """The trace's ``hlo_category`` arg wins over name heuristics
+    (fused GEMMs named "bitcast_add_fusion" ARE matmuls; Pallas kernels
+    are custom-calls), and while/cond container events — which duplicate
+    the body ops they wrap — are excluded from the totals."""
+    import gzip
+    import json
+
+    from paddle_tpu.profiler import xplane
+
+    run = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_01"
+    run.mkdir(parents=True)
+    ev = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        # fused GEMM with a copy-looking name: hlo_category must win
+        {"ph": "X", "pid": 1, "tid": 10, "name": "bitcast_add_fusion.2",
+         "ts": 0, "dur": 1000.0,
+         "args": {"hlo_category": "convolution fusion"}},
+        # pallas flash attention
+        {"ph": "X", "pid": 1, "tid": 10, "name": "jvp__.7",
+         "ts": 2000, "dur": 2000.0,
+         "args": {"hlo_category": "custom-call"}},
+        # scan wrapper duplicating its body — excluded
+        {"ph": "X", "pid": 1, "tid": 10, "name": "while.9",
+         "ts": 0, "dur": 3000.0, "args": {"hlo_category": "while"}},
+        # an XLA category with no bucket surfaces as-is
+        {"ph": "X", "pid": 1, "tid": 10, "name": "rsqrt.4",
+         "ts": 5000, "dur": 500.0,
+         "args": {"hlo_category": "non-fusion elementwise"}},
+    ]
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": ev}, f)
+
+    s = xplane.device_op_summary(str(tmp_path))
+    rows = {r.name: r for r in s.rows}
+    assert "while.9" not in rows
+    assert rows["bitcast_add_fusion.2"].category == "matmul/conv"
+    assert rows["jvp__.7"].category == "custom-call (pallas)"
+    assert rows["rsqrt.4"].category == "non-fusion elementwise"
+    assert s.total_ms == 3.5
+
+
 def test_profiler_summary_with_real_trace(tmp_path):
     """End-to-end on the CPU backend: trace capture + summary must not
     crash and must state that the CPU trace has no device op events."""
