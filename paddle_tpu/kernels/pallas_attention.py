@@ -299,7 +299,10 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
         q = q_ref[0, 0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # matmul operands stay in the INPUT dtype (bf16 in training) with
+        # f32 accumulation — flash-v2 precision. f32 operands would run
+        # the MXU at half rate on v5e/v5p.
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
         q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
@@ -313,12 +316,12 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, q_block, k_block, n_kb,
                             k_seg, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dq_scratch[:] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -363,7 +366,8 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
         q = q_ref[0, 0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # input-dtype matmul operands, f32 accumulation (see dq kernel)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
         q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
@@ -375,19 +379,20 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
         if causal or window or q_seg is not None:
             s = _block_mask(s, i, j, q_block, k_block, causal, q_seg,
                             k_seg, window)
-        p = jnp.exp(s - lse)  # [q_block, k_block]
+        p = jnp.exp(s - lse)  # [q_block, k_block] f32
         # dv += p^T do
         dv_scratch[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            p.astype(q.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         # dk += ds^T q
         dk_scratch[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
