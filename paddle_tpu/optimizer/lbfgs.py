@@ -21,6 +21,30 @@ import jax.numpy as jnp
 from ..core.parameter import Parameter
 
 
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2):
+    """Minimizer of the cubic through (x1, f1, g1), (x2, f2, g2)
+    (torch/paddle ``_cubic_interpolate``); bisection when the cubic has
+    no real minimum in between."""
+    import math
+
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 * d1 - g1 * g2
+    xmin, xmax = min(x1, x2), max(x1, x2)
+    if d2_square >= 0:
+        d2 = math.sqrt(d2_square)
+        if x1 <= x2:
+            denom = g2 - g1 + 2 * d2
+            if denom != 0:
+                t = x2 - (x2 - x1) * ((g2 + d2 - d1) / denom)
+                return min(max(t, xmin), xmax)
+        else:
+            denom = g1 - g2 + 2 * d2
+            if denom != 0:
+                t = x1 - (x1 - x2) * ((g1 + d2 - d1) / denom)
+                return min(max(t, xmin), xmax)
+    return (xmin + xmax) / 2.0
+
+
 def _flatten(tensors):
     return jnp.concatenate([jnp.ravel(t.astype(jnp.float32)) for t in tensors])
 
@@ -114,23 +138,38 @@ class LBFGS:
         if lo_f > hi_f:
             lo_t, hi_t, lo_f, hi_f, lo_g, hi_g = \
                 hi_t, lo_t, hi_f, lo_f, hi_g, lo_g
-        # zoom phase (bisection with safeguard; cubic omitted — bisection
-        # converges a step or two slower but to the same point)
+        lo_gtd, hi_gtd = float(lo_g @ d), float(hi_g @ d)
+        # zoom phase: cubic interpolation with the torch/paddle
+        # insufficient-progress safeguard (falls back toward the bounds,
+        # then bisection) — matches _strong_wolfe closure-eval counts
+        insuf_progress = False
         while ls_iter < max_ls:
             if abs(hi_t - lo_t) * d_norm < self.tolerance_change:
                 break
-            t = 0.5 * (lo_t + hi_t)
+            xmin, xmax = min(lo_t, hi_t), max(lo_t, hi_t)
+            t = _cubic_interpolate(lo_t, lo_f, lo_gtd,
+                                   hi_t, hi_f, hi_gtd)
+            eps = 0.1 * (xmax - xmin)
+            if min(xmax - t, t - xmin) < eps:
+                if insuf_progress or t >= xmax or t <= xmin:
+                    t = xmax - eps if abs(t - xmax) < abs(t - xmin) \
+                        else xmin + eps
+                    insuf_progress = False
+                else:
+                    insuf_progress = True
+            else:
+                insuf_progress = False
             f_new, g_new = self._eval(closure, x + t * d)
             gtd_new = float(g_new @ d)
             ls_iter += 1
             if f_new > (f + c1 * t * gtd) or f_new >= lo_f:
-                hi_t, hi_f, hi_g = t, f_new, g_new
+                hi_t, hi_f, hi_g, hi_gtd = t, f_new, g_new, gtd_new
             else:
                 if abs(gtd_new) <= -c2 * gtd:
                     return f_new, g_new, t, ls_iter
                 if gtd_new * (hi_t - lo_t) >= 0:
-                    hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
-                lo_t, lo_f, lo_g = t, f_new, g_new
+                    hi_t, hi_f, hi_g, hi_gtd = lo_t, lo_f, lo_g, lo_gtd
+                lo_t, lo_f, lo_g, lo_gtd = t, f_new, g_new, gtd_new
         return lo_f, lo_g, lo_t, ls_iter
 
     # -- main ---------------------------------------------------------------

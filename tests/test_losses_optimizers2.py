@@ -458,6 +458,43 @@ class TestLBFGS:
         np.testing.assert_allclose(ours, wt.detach().numpy(),
                                    rtol=1e-3, atol=1e-4)
 
+    def test_strong_wolfe_parity_vs_torch(self):
+        """Cubic-interpolation zoom matches torch's _strong_wolfe: same
+        line-search evaluation sequence => same iterates on a
+        non-quadratic objective (not just the same limit point)."""
+        A, b, w0 = self._quad_setup()
+        from paddle_tpu.core.parameter import Parameter
+
+        p = Parameter(jnp.asarray(w0.copy()), name="w")
+        o = opt.LBFGS(learning_rate=1.0, max_iter=6,
+                      line_search_fn="strong_wolfe", parameters=[p])
+        Aj, bj = jnp.asarray(A), jnp.asarray(b)
+
+        def closure():
+            w = p.value
+            loss = 0.5 * w @ Aj @ w - bj @ w + 0.1 * jnp.sum(w ** 4)
+            p.grad = Aj @ w - bj + 0.4 * w ** 3
+            return loss
+
+        o.step(closure)
+        ours = np.asarray(p.value)
+
+        wt = torch.tensor(w0.copy(), requires_grad=True)
+        ot = torch.optim.LBFGS([wt], lr=1.0, max_iter=6,
+                               line_search_fn="strong_wolfe")
+        At, bt = torch.tensor(A), torch.tensor(b)
+
+        def tclosure():
+            ot.zero_grad()
+            loss = (0.5 * wt @ At @ wt - bt @ wt
+                    + 0.1 * torch.sum(wt ** 4))
+            loss.backward()
+            return loss
+
+        ot.step(tclosure)
+        np.testing.assert_allclose(ours, wt.detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
     def test_strong_wolfe_converges_rosenbrock(self):
         from paddle_tpu.core.parameter import Parameter
 
