@@ -101,36 +101,37 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     [K, 4] in input-image coords; boxes_num [N] gives each image's ROI
     count (boxes are listed image-major).
 
-    Documented deviation: with ``sampling_ratio=-1`` the reference picks
-    ``ceil(roi/output)`` per ROI; XLA's static shapes forbid per-ROI
-    grids, so ONE adaptive ratio — the max over the batch's ROIs,
-    capped at 8 — is used for all ROIs (each bin sampled at least as
-    densely as the reference, values can differ slightly for batches of
-    mixed ROI sizes), and under tracing the fallback is a fixed 2. Pass
-    an explicit ``sampling_ratio`` for exact reference numerics."""
+    ``sampling_ratio=-1`` follows the reference's PER-ROI adaptive rule
+    ``ceil(roi/output)`` exactly: the grid is statically sized to the
+    batch max ratio R (XLA static shapes), each ROI computes its own
+    sample positions from its own ratio, and padding slots are masked
+    out of the bin average — bit-matching reference bin averaging for
+    mixed-size batches. R caps at 16 (typical FPN ratios are 1-4);
+    under tracing, where the batch max is unknowable, R falls back to
+    4. Pass an explicit ``sampling_ratio`` to pin the grid."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
     x = jnp.asarray(x)
     boxes = jnp.asarray(boxes, jnp.float32)
     boxes_num = np.asarray(boxes_num)
-    if sampling_ratio > 0:
-        ratio = int(sampling_ratio)
+    adaptive = sampling_ratio <= 0
+    if not adaptive:
+        R = int(sampling_ratio)
     else:
-        # reference semantics: adaptive ceil(roi_size / output_size) per
-        # ROI. Static shapes forbid per-ROI grids, so take the max over
-        # the (concrete, eager) boxes — every bin is sampled at least as
-        # densely as the reference; under tracing fall back to 2
+        # static grid size = batch max of the per-ROI adaptive ratios
+        # (concrete/eager boxes); per-ROI masking below keeps numerics
+        # exact for every ROI whose ratio fits
         try:
             bnp = np.asarray(boxes)
             sizes = np.maximum(bnp[:, 2:] - bnp[:, :2], 1.0) * spatial_scale
-            ratio = int(min(8, max(
+            R = int(min(16, max(
                 1,
                 np.ceil(sizes[:, 1].max() / ph).max(),
                 np.ceil(sizes[:, 0].max() / pw).max(),
             )))
         except Exception:
-            ratio = 2
+            R = 4
     off = 0.5 if aligned else 0.0
 
     def one_roi(feat, box):
@@ -139,15 +140,23 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        # sample grid: [ph, ratio] × [pw, ratio]
+        if adaptive:  # this ROI's own ceil(roi/output), clipped to R
+            ry = jnp.clip(jnp.ceil(rh / ph), 1, R)
+            rx = jnp.clip(jnp.ceil(rw / pw), 1, R)
+        else:
+            ry = rx = jnp.float32(R)
+        j = jnp.arange(R, dtype=jnp.float32)
+        # sample grid [ph, R] x [pw, R]; slots j >= r are masked padding
         iy = (jnp.arange(ph)[:, None] * bin_h + y1
-              + (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+              + (j[None, :] + 0.5) * bin_h / ry)
         ix = (jnp.arange(pw)[:, None] * bin_w + x1
-              + (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
-        yy = jnp.broadcast_to(iy[:, :, None, None], (ph, ratio, pw, ratio))
-        xx = jnp.broadcast_to(ix[None, None, :, :], (ph, ratio, pw, ratio))
-        vals = _bilinear_sample(feat, yy, xx)     # [C, ph, r, pw, r]
-        return vals.mean(axis=(2, 4))             # [C, ph, pw]
+              + (j[None, :] + 0.5) * bin_w / rx)
+        yy = jnp.broadcast_to(iy[:, :, None, None], (ph, R, pw, R))
+        xx = jnp.broadcast_to(ix[None, None, :, :], (ph, R, pw, R))
+        vals = _bilinear_sample(feat, yy, xx)     # [C, ph, R, pw, R]
+        w = ((j[:, None] < ry) & (j[None, :] < rx)).astype(vals.dtype)
+        return (vals * w[None, None, :, None, :]).sum(axis=(2, 4)) \
+            / (ry * rx)                            # [C, ph, pw]
 
     img_idx = np.repeat(np.arange(len(boxes_num)), boxes_num)
     feats = x[jnp.asarray(img_idx)]               # [K, C, H, W]

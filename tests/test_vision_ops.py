@@ -88,6 +88,53 @@ class TestRoIAlign:
         assert np.isfinite(np.asarray(g)).all()
         assert np.abs(np.asarray(g)).sum() > 0
 
+    def test_adaptive_ratio_matches_per_roi_reference(self):
+        """sampling_ratio=-1 must follow the reference's PER-ROI
+        ceil(roi/output) rule exactly — a mixed-size batch where every
+        ROI gets a different adaptive ratio is checked bin-for-bin
+        against a dense numpy re-implementation."""
+        rng = np.random.default_rng(7)
+        feat = rng.normal(size=(1, 2, 16, 16)).astype(np.float32)
+        # roi sizes 2.4 / 7 / 12.8 on a 2x2 output -> ratios 2, 4, 7
+        boxes = np.array([[1.0, 1.0, 3.4, 3.4],
+                          [4.0, 2.0, 11.0, 9.0],
+                          [0.6, 2.1, 13.4, 14.9]], np.float32)
+        ph = pw = 2
+        out = np.asarray(ops.roi_align(
+            jnp.asarray(feat), jnp.asarray(boxes), [3], 2,
+            sampling_ratio=-1, aligned=True))
+
+        def bilin(img, y, x):
+            H, W = img.shape[-2:]
+            y = min(max(y, 0.0), H - 1.0)
+            x = min(max(x, 0.0), W - 1.0)
+            y0, x0 = int(np.floor(y)), int(np.floor(x))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            wy, wx = y - y0, x - x0
+            return (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                    + img[:, y0, x1] * (1 - wy) * wx
+                    + img[:, y1, x0] * wy * (1 - wx)
+                    + img[:, y1, x1] * wy * wx)
+
+        for k, box in enumerate(boxes):
+            x1, y1, x2, y2 = box - 0.5
+            rw, rh = max(x2 - x1, 1e-4), max(y2 - y1, 1e-4)
+            bh, bw = rh / ph, rw / pw
+            ry = int(np.ceil(rh / ph))
+            rx = int(np.ceil(rw / pw))
+            for i in range(ph):
+                for jj in range(pw):
+                    acc = np.zeros(2, np.float32)
+                    for sy in range(ry):
+                        for sx in range(rx):
+                            yy = y1 + i * bh + (sy + 0.5) * bh / ry
+                            xx = x1 + jj * bw + (sx + 0.5) * bw / rx
+                            acc += bilin(feat[0], yy, xx)
+                    ref = acc / (ry * rx)
+                    np.testing.assert_allclose(
+                        out[k, :, i, jj], ref, rtol=1e-5, atol=1e-5,
+                        err_msg=f"roi {k} bin ({i},{jj}) ratio ({ry},{rx})")
+
     def test_batch_routing(self):
         """ROIs index the right image via boxes_num."""
         f = np.zeros((2, 1, 4, 4), np.float32)
