@@ -246,9 +246,14 @@ def bench_infer(tpu_diags):
     # and arrival idle gaps — what the server delivers, not raw decode
     # speed; named accordingly)
     served_tps = total_toks / t_total
+    # request 0 entered an empty engine: its TTFT is the unloaded
+    # (prefill + admission) latency, vs the percentiles' under-load view
+    r0 = min(eng._finished)
+    unloaded = eng._finished[r0].ttft_ms
     return _result(
         "infer_p50_ttft_ms", float(np.percentile(ttfts, 50)), "ms",
         {"p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+         "unloaded_ttft_ms": round(unloaded, 2) if unloaded else None,
          "served_tokens_per_sec": round(served_tps, 1),
          "n_requests": len(reqs), "prompt_len": prompt_len,
          "new_tokens": new_tokens, "arrival_gap_ms": round(gap * 1e3, 2),
