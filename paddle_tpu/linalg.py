@@ -237,3 +237,19 @@ def pca_lowrank(x, q=None, center=True, niter=2):
     if center:
         a = a - jnp.mean(a, axis=-2, keepdims=True)
     return svd_lowrank(a, q=q, niter=niter)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Parity: paddle.linalg.cov."""
+    import jax.numpy as jnp
+
+    return jnp.cov(_v(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=None if fweights is None else _v(fweights),
+                   aweights=None if aweights is None else _v(aweights))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """Parity: paddle.linalg.corrcoef."""
+    import jax.numpy as jnp
+
+    return jnp.corrcoef(_v(x), rowvar=rowvar)

@@ -45,12 +45,16 @@ from .activation import (  # noqa: F401
 from .common import (  # noqa: F401
     _f32up,
     _v,
+    alpha_dropout,
     cosine_similarity,
     dropout,
+    fold,
     interpolate,
     linear,
     pad,
+    unfold,
     upsample,
+    zeropad2d,
 )
 from .conv import (  # noqa: F401
     conv1d,
@@ -88,6 +92,7 @@ from .loss import (  # noqa: F401
 from .norm import (  # noqa: F401
     group_norm,
     layer_norm,
+    local_response_norm,
     normalize,
     rms_norm,
 )

@@ -205,3 +205,81 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
              align_corners=False, align_mode=0, data_format="NCHW"):
     return interpolate(x, size, scale_factor, mode, align_corners,
                        align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (parity: F.unfold / the im2col phi kernel): x [N, C, H, W]
+    -> [N, C*kh*kw, L] columns, torch/paddle channel-major (c, kh, kw)
+    ordering. One lax.conv_general_dilated_patches call — XLA lowers it
+    to the same window-gather the reference's CUDA kernel hand-writes."""
+    x = _v(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pad = _pair(paddings)
+    if len(pad) == 2:
+        pads = [(pad[0], pad[0]), (pad[1], pad[1])]
+    else:
+        pads = [(pad[0], pad[1]), (pad[2], pad[3])]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), pads, rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n = x.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im (parity: F.fold) — the exact linear transpose of
+    ``unfold``, realized through jax.linear_transpose (overlapping
+    windows scatter-add)."""
+    x = _v(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    n, ckk, _ = x.shape
+    kh, kw = _pair(kernel_sizes)
+    c = ckk // (kh * kw)
+
+    def _unfold_img(img):
+        return unfold(img, kernel_sizes, strides, paddings, dilations)
+
+    spec = jax.ShapeDtypeStruct((n, c, oh, ow), x.dtype)
+    (out,) = jax.linear_transpose(_unfold_img, spec)(x)
+    return out
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (parity: F.alpha_dropout): dropped units
+    take the negative-saturation value and an affine correction keeps
+    mean/variance, so self-normalizing nets stay normalized."""
+    x = _v(x)
+    if not training or p == 0.0:
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    alpha_p = -1.7580993408473766  # -scale*alpha of SELU
+    q = 1.0 - p
+    a = (q + alpha_p * alpha_p * p * q) ** -0.5
+    b = -a * alpha_p * p
+    key = random_mod.next_rng_key("alpha_dropout")
+    keep = jax.random.bernoulli(key, q, x.shape)
+    return (a * jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
+            + jnp.asarray(b, x.dtype)).astype(x.dtype)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Parity: F.zeropad2d — padding [left, right, top, bottom]."""
+    x = _v(x)
+    left, right, top, bottom = padding
+    if data_format == "NCHW":
+        width = [(0, 0), (0, 0), (top, bottom), (left, right)]
+    else:
+        width = [(0, 0), (top, bottom), (left, right), (0, 0)]
+    return jnp.pad(x, width)

@@ -60,3 +60,23 @@ def normalize(x, p=2, axis=-1, epsilon=1e-12):
     x = _v(x)
     norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
     return x / jnp.maximum(norm, epsilon)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """Parity: F.local_response_norm (AlexNet LRN) — torch/paddle
+    semantics: divide by (k + alpha * mean-of-squares over a size-wide
+    channel window)^beta."""
+    x = _v(x)
+    if data_format.endswith("C"):
+        x = jnp.moveaxis(x, -1, 1)
+    sq = _f32up(x) * _f32up(x)
+    pads = [(0, 0), (size // 2, (size - 1) // 2)] + \
+        [(0, 0)] * (x.ndim - 2)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    summed = lax.reduce_window(sq, 0.0, lax.add, window,
+                               (1,) * x.ndim, pads)
+    y = (x / jnp.power(k + alpha * summed / size, beta)).astype(x.dtype)
+    if data_format.endswith("C"):
+        y = jnp.moveaxis(y, 1, -1)
+    return y

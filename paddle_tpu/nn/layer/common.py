@@ -342,3 +342,53 @@ class UpsamplingBilinear2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
         super().__init__(size, scale_factor, "bilinear",
                          align_corners=True, data_format=data_format)
+
+
+class Unfold(Layer):
+    """Parity: paddle.nn.Unfold (im2col)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F.unfold(x, k, strides=s, paddings=p, dilations=d)
+
+
+class Fold(Layer):
+    """Parity: paddle.nn.Fold (col2im)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._args
+        return F.fold(x, o, k, strides=s, paddings=p, dilations=d)
+
+
+class AlphaDropout(Layer):
+    """Parity: paddle.nn.AlphaDropout (SELU-preserving dropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class ZeroPad2D(Layer):
+    """Parity: paddle.nn.ZeroPad2D — padding [left, right, top, bottom]."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)

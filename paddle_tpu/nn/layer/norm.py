@@ -191,3 +191,17 @@ class SyncBatchNorm(BatchNorm2D):
                 if type(sub) is BatchNorm2D:
                     sub.__class__ = cls
         return layer
+
+
+class LocalResponseNorm(Layer):
+    """Parity: paddle.nn.LocalResponseNorm (AlexNet LRN)."""
+
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        size, alpha, beta, k, df = self._args
+        return F.local_response_norm(x, size, alpha=alpha, beta=beta,
+                                     k=k, data_format=df)

@@ -496,3 +496,313 @@ def multinomial(x, num_samples=1, replacement=False):
         g = jax.random.gumbel(next_rng_key("default"), logits.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
     return out if x.ndim > 1 else out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# long-tail surface (parity: python/paddle/tensor/{math,manipulation,
+# search,linalg}.py module-level APIs)
+# ---------------------------------------------------------------------------
+def mv(x, vec, name=None):
+    return jnp.matmul(_v(x), _v(vec))
+
+
+def bmm(x, y, name=None):
+    x, y = _v(x), _v(y)
+    if x.ndim != 3 or y.ndim != 3:
+        raise ValueError("bmm expects 3-D inputs")
+    return jnp.matmul(x, y)
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) (paddle.dist, scalar)."""
+    d = (_v(x) - _v(y)).ravel()
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(d.dtype)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row vectors of x [..., m, d] and
+    y [..., n, d] -> [..., m, n]."""
+    x, y = _v(x), _v(y)
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 1.0:
+        return jnp.sum(jnp.abs(diff), axis=-1)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(_v(y), x=_v(x), axis=axis)
+    return jnp.trapezoid(_v(y), dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _v(y)
+    y = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        xx = jnp.moveaxis(_v(x), axis, -1) if _v(x).ndim == y.ndim else _v(x)
+        w = jnp.diff(xx, axis=-1)
+    else:
+        w = 1.0 if dx is None else dx
+    steps = (y[..., 1:] + y[..., :-1]) * 0.5 * w
+    return jnp.moveaxis(jnp.cumsum(steps, axis=-1), -1, axis)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return jnp.nanmedian(_v(x), axis=axis, keepdims=keepdim)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    """k-th SMALLEST (1-based, paddle semantics) -> (values, indices)."""
+    x = _v(x)
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    kth_idx = jnp.take(idx, k - 1, axis=axis)
+    vals = jnp.take_along_axis(
+        x, jnp.expand_dims(kth_idx, axis), axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis)
+        return vals, kth_idx
+    return vals, jnp.expand_dims(kth_idx, axis)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Eager host-side op (dynamic output shape), like ``unique``."""
+    import numpy as np
+
+    a = np.asarray(_v(x))
+    if axis is None:
+        a = a.ravel()
+        ax = 0
+    else:
+        ax = axis
+    if a.shape[ax] == 0:
+        change = np.zeros((0,), bool)
+    else:
+        moved = np.moveaxis(a, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    starts = np.flatnonzero(change)
+    out = jnp.asarray(np.take(a, starts, axis=ax))
+    res = [out]
+    if return_inverse:
+        res.append(jnp.asarray(np.cumsum(change) - 1))
+    if return_counts:
+        counts = np.diff(np.append(starts, a.shape[ax]))
+        res.append(jnp.asarray(counts))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(_v(x), k=offset)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(_v(x))
+    return m, e.astype(jnp.int32)
+
+
+def ldexp(x, y, name=None):
+    return jnp.ldexp(_v(x), _v(y).astype(jnp.int32))
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(_v(x))
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(_v(x))
+
+
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, _v(x))
+
+
+def erfinv(x, name=None):
+    return jax.lax.erf_inv(_v(x))
+
+
+def i0(x, name=None):
+    return jax.scipy.special.i0(_v(x))
+
+
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(_v(x))
+
+
+def i1(x, name=None):
+    return jax.scipy.special.i1(_v(x))
+
+
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(_v(x))
+
+
+def sinc(x, name=None):
+    return jnp.sinc(_v(x))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    hist, edges = jnp.histogramdd(
+        _v(x), bins=bins, range=ranges, density=density,
+        weights=None if weights is None else _v(weights))
+    return hist, list(edges)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=dtype and dtype_mod.convert_dtype(dtype))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of ``mask`` with elements of ``value`` taken
+    in row-major order (paddle.masked_scatter)."""
+    x, mask, value = _v(x), _v(mask), _v(value)
+    mask = jnp.broadcast_to(mask, x.shape)
+    flat_m = mask.ravel()
+    take = jnp.cumsum(flat_m) - 1
+    src = value.ravel()
+    picked = jnp.take(src, jnp.clip(take, 0, src.size - 1))
+    return jnp.where(flat_m, picked.astype(x.dtype),
+                     x.ravel()).reshape(x.shape)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x, value = _v(x), _v(value)
+    idx = tuple(_v(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _v(x)
+    axis = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape = tuple(x.shape[axis] // known if s == -1 else s
+                      for s in shape)
+    return x.reshape(x.shape[:axis] + shape + x.shape[axis + 1:])
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(jnp.array_split(_v(x), num_or_indices, axis=axis)) \
+        if isinstance(num_or_indices, int) \
+        else list(jnp.split(_v(x), list(num_or_indices), axis=axis))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = _v(x)
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (paddle.as_strided) as an explicit gather — jax
+    arrays have no aliasing views, so this materializes."""
+    x = _v(x).ravel()
+    shape = tuple(int(s) for s in shape)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * int(st)
+    return jnp.take(x, idx.reshape(shape))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (paddle.Tensor.unfold): output
+    gains a trailing window dim of length ``size``."""
+    x = _v(x)
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    win = starts[:, None] + jnp.arange(size)[None, :]   # [n, size]
+    out = jnp.take(x, win.reshape(-1), axis=axis)
+    out = out.reshape(x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view: reshape, or bitcast reinterpretation for a dtype."""
+    x = _v(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(tuple(shape_or_dtype))
+    dt = dtype_mod.convert_dtype(shape_or_dtype)
+    if jnp.dtype(dt).itemsize == x.dtype.itemsize:
+        return jax.lax.bitcast_convert_type(x, dt)
+    # differing widths: fold/expand the trailing dim like paddle
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x).view(np.dtype(dt)))
+
+
+def view_as(x, other, name=None):
+    return _v(x).reshape(_v(other).shape)
+
+
+def is_tensor(x):
+    return isinstance(x, (jax.Array, Parameter))
+
+
+def rank(x, name=None):
+    return jnp.asarray(jnp.ndim(_v(x)))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# inplace-spelled APIs: jax arrays are immutable, so these return the
+# result (documented functional semantics; the trailing-underscore
+# spelling exists for call-site parity)
+def reshape_(x, shape, name=None):
+    return jnp.reshape(_v(x), shape)
+
+
+def squeeze_(x, axis=None, name=None):
+    return jnp.squeeze(_v(x), axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return jnp.expand_dims(_v(x), axis)
+
+
+def clip_(x, min=None, max=None, name=None):  # noqa: A002
+    return jnp.clip(_v(x), min, max)

@@ -1,0 +1,206 @@
+"""Long-tail tensor/nn surface (parity: python/paddle/tensor/ module
+APIs + nn layers) — numerics pinned to torch / numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestMathOps:
+    def setup_method(self, _):
+        self.rng = np.random.default_rng(0)
+
+    def test_mv_bmm_dist_cdist(self):
+        a = self.rng.standard_normal((3, 4)).astype(np.float32)
+        v = self.rng.standard_normal((4,)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.mv(jnp.asarray(a), jnp.asarray(v))),
+                                   a @ v, rtol=1e-5)
+        x = self.rng.standard_normal((2, 3, 4)).astype(np.float32)
+        y = self.rng.standard_normal((2, 4, 5)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.bmm(jnp.asarray(x), jnp.asarray(y))),
+                                   np.einsum("bij,bjk->bik", x, y), rtol=1e-5)
+        with pytest.raises(ValueError):
+            pt.bmm(jnp.asarray(a), jnp.asarray(a))
+        p_ = self.rng.standard_normal((5, 3)).astype(np.float32)
+        q_ = self.rng.standard_normal((4, 3)).astype(np.float32)
+        ref = torch.cdist(torch.tensor(p_), torch.tensor(q_), p=2.0).numpy()
+        np.testing.assert_allclose(
+            np.asarray(pt.cdist(jnp.asarray(p_), jnp.asarray(q_))), ref,
+            rtol=1e-4, atol=1e-5)
+        ref = torch.dist(torch.tensor(p_), torch.tensor(p_ * 2), p=3).numpy()
+        np.testing.assert_allclose(
+            float(pt.dist(jnp.asarray(p_), jnp.asarray(p_ * 2), p=3)), ref,
+            rtol=1e-5)
+
+    def test_special_functions(self):
+        x = jnp.asarray(self.rng.uniform(0.1, 3.0, (50,)).astype(np.float32))
+        t = torch.tensor(np.asarray(x))
+        for ours, theirs in ((pt.lgamma, torch.lgamma),
+                             (pt.digamma, torch.digamma),
+                             (pt.i0, torch.i0),
+                             (pt.sinc, torch.sinc)):
+            np.testing.assert_allclose(np.asarray(ours(x)),
+                                       theirs(t).numpy(), rtol=2e-4,
+                                       atol=1e-5)
+        u = jnp.asarray(self.rng.uniform(-0.9, 0.9, (50,)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(pt.erfinv(u)),
+                                   torch.erfinv(torch.tensor(np.asarray(u))).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        m, e = pt.frexp(jnp.asarray([8.0, 0.5, -3.0]))
+        mm, ee = torch.frexp(torch.tensor([8.0, 0.5, -3.0]))
+        np.testing.assert_allclose(np.asarray(m), mm.numpy())
+        np.testing.assert_array_equal(np.asarray(e), ee.numpy())
+        np.testing.assert_allclose(
+            np.asarray(pt.ldexp(jnp.asarray([1.5, 2.0]), jnp.asarray([2, 3]))),
+            np.ldexp([1.5, 2.0], [2, 3]))
+
+    def test_trapezoid(self):
+        y = self.rng.standard_normal((4, 7)).astype(np.float32)
+        x = np.sort(self.rng.standard_normal((7,))).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(pt.trapezoid(jnp.asarray(y), x=jnp.asarray(x))),
+            np.trapezoid(y, x=x, axis=-1), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(pt.cumulative_trapezoid(jnp.asarray(y), dx=0.5)),
+            torch.cumulative_trapezoid(torch.tensor(y), dx=0.5).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_kthvalue_nanmedian(self):
+        x = self.rng.standard_normal((3, 9)).astype(np.float32)
+        vals, idx = pt.kthvalue(jnp.asarray(x), 4, axis=1)
+        tv, ti = torch.kthvalue(torch.tensor(x), 4, dim=1)
+        np.testing.assert_allclose(np.asarray(vals), tv.numpy())
+        np.testing.assert_array_equal(np.asarray(idx), ti.numpy())
+        xn = x.copy()
+        xn[0, :2] = np.nan
+        np.testing.assert_allclose(
+            float(pt.nanmedian(jnp.asarray(xn))), np.nanmedian(xn))
+
+    def test_cov_corrcoef_logspace(self):
+        from paddle_tpu import linalg
+
+        x = self.rng.standard_normal((3, 40)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.cov(jnp.asarray(x))),
+                                   np.cov(x), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.corrcoef(jnp.asarray(x))), np.corrcoef(x),
+            rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.logspace(0, 3, 4)),
+                                   [1.0, 10.0, 100.0, 1000.0], rtol=1e-5)
+
+    def test_histogramdd(self):
+        x = self.rng.standard_normal((100, 2)).astype(np.float32)
+        hist, edges = pt.histogramdd(jnp.asarray(x), bins=5)
+        ref_h, ref_e = np.histogramdd(x, bins=5)
+        np.testing.assert_allclose(np.asarray(hist), ref_h)
+        assert len(edges) == 2
+
+
+class TestManipulation:
+    def setup_method(self, _):
+        self.rng = np.random.default_rng(1)
+
+    def test_masked_scatter_index_put(self):
+        x = jnp.zeros((2, 3))
+        mask = jnp.asarray([[True, False, True], [False, True, False]])
+        out = pt.masked_scatter(x, mask, jnp.asarray([1.0, 2.0, 3.0, 9.0]))
+        ref = torch.zeros(2, 3).masked_scatter_(
+            torch.tensor(np.asarray(mask)),
+            torch.tensor([1.0, 2.0, 3.0, 9.0])).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref)
+        y = pt.index_put(jnp.zeros((3, 3)),
+                         (jnp.asarray([0, 2]), jnp.asarray([1, 2])),
+                         jnp.asarray([5.0, 7.0]))
+        assert y[0, 1] == 5.0 and y[2, 2] == 7.0
+        y2 = pt.index_put(y, (jnp.asarray([0]), jnp.asarray([1])),
+                          jnp.asarray([5.0]), accumulate=True)
+        assert y2[0, 1] == 10.0
+
+    def test_splits_unflatten_diagflat(self):
+        x = jnp.asarray(self.rng.standard_normal((6, 4, 2)).astype(np.float32))
+        for ours, ref in ((pt.vsplit(x, 3), np.vsplit(np.asarray(x), 3)),
+                          (pt.hsplit(x, 2), np.hsplit(np.asarray(x), 2)),
+                          (pt.dsplit(x, 2), np.dsplit(np.asarray(x), 2)),
+                          (pt.tensor_split(x, 4), np.array_split(np.asarray(x), 4))):
+            for a, b in zip(ours, ref):
+                np.testing.assert_allclose(np.asarray(a), b)
+        u = pt.unflatten(x, 0, (2, 3))
+        assert u.shape == (2, 3, 4, 2)
+        u2 = pt.unflatten(x, 1, (-1, 2))
+        assert u2.shape == (6, 2, 2, 2)
+        np.testing.assert_allclose(np.asarray(pt.diagflat(jnp.asarray([1.0, 2.0]))),
+                                   np.diagflat([1.0, 2.0]))
+
+    def test_as_strided_unfold_view(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32))
+        out = pt.as_strided(x, (3, 4), (8, 2), offset=1)
+        ref = np.lib.stride_tricks.as_strided(
+            np.arange(24, dtype=np.float32)[1:], (3, 4), (32, 8))
+        np.testing.assert_allclose(np.asarray(out), ref)
+        t = torch.arange(24, dtype=torch.float32).reshape(4, 6)
+        ours = pt.unfold(x.reshape(4, 6), 1, 3, 2)
+        np.testing.assert_allclose(np.asarray(ours),
+                                   t.unfold(1, 3, 2).numpy())
+        v = pt.view(jnp.asarray([1.0, -2.0]), "int32")
+        ref_v = torch.tensor([1.0, -2.0]).view(torch.int32).numpy()
+        np.testing.assert_array_equal(np.asarray(v), ref_v)
+        assert pt.view_as(x, jnp.zeros((4, 6))).shape == (4, 6)
+
+    def test_unique_consecutive(self):
+        x = jnp.asarray([1, 1, 2, 2, 2, 3, 1, 1])
+        out, inv, cnt = pt.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        to, ti, tc = torch.unique_consecutive(
+            torch.tensor(np.asarray(x)), return_inverse=True,
+            return_counts=True)
+        np.testing.assert_array_equal(np.asarray(out), to.numpy())
+        np.testing.assert_array_equal(np.asarray(inv), ti.numpy())
+        np.testing.assert_array_equal(np.asarray(cnt), tc.numpy())
+
+    def test_inplace_spellings_and_misc(self):
+        x = jnp.zeros((2, 3))
+        assert pt.reshape_(x, [6]).shape == (6,)
+        assert pt.squeeze_(jnp.zeros((1, 3)), 0).shape == (3,)
+        assert pt.unsqueeze_(x, 0).shape == (1, 2, 3)
+        assert float(pt.clip_(jnp.asarray([5.0]), max=1.0)[0]) == 1.0
+        assert pt.is_tensor(x) and not pt.is_tensor([1, 2])
+        assert int(pt.rank(x)) == 2
+
+
+class TestNewLayers:
+    def test_fold_unfold_layers_roundtrip(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((2, 3, 6, 6)).astype(np.float32))
+        cols = nn.Unfold(2, strides=2)(x)
+        back = nn.Fold((6, 6), 2, strides=2)(cols)
+        # non-overlapping windows: fold(unfold(x)) == x
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   atol=1e-6)
+
+    def test_lrn_layer_vs_torch(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 7, 5, 5)).astype(np.float32)
+        ours = np.asarray(nn.LocalResponseNorm(5)(jnp.asarray(x)))
+        ref = torch.nn.LocalResponseNorm(5)(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    def test_alpha_dropout_and_zeropad(self):
+        import paddle_tpu as pt_
+
+        pt_.seed(0)
+        layer = nn.AlphaDropout(0.4)
+        big = jnp.asarray(np.random.default_rng(4)
+                          .standard_normal((100000,)).astype(np.float32))
+        out = np.asarray(layer(big))
+        # SELU-preserving: mean ~0, std ~1
+        assert abs(out.mean()) < 0.02 and abs(out.std() - 1.0) < 0.03
+        layer.eval()
+        np.testing.assert_allclose(np.asarray(layer(big)), np.asarray(big))
+        zp = nn.ZeroPad2D([1, 2, 3, 4])(jnp.zeros((1, 1, 2, 2)))
+        assert zp.shape == (1, 1, 9, 5)
