@@ -13,21 +13,40 @@ from .auto_parallel import (  # noqa: F401
     Replicate,
     Shard,
     dtensor_from_fn,
+    get_mesh,
     get_placements,
     reshard,
+    set_mesh,
     shard_layer,
+    shard_optimizer,
     shard_tensor,
 )
 from .collective import (  # noqa: F401
+    Group,
+    P2POp,
     ReduceOp,
     all_gather,
     all_gather_object,
     all_reduce,
     alltoall,
+    alltoall_single,
     barrier,
+    batch_isend_irecv,
     broadcast,
     broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_group,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    reduce,
     reduce_scatter,
+    scatter,
+    send,
+    wait,
 )
 from . import checkpoint  # noqa: F401
 from .env import (  # noqa: F401

@@ -184,3 +184,33 @@ def get_placements(x, mesh: ProcessMesh):
         for ax in axes:
             placements[mesh.dim_names.index(ax)] = Shard(tensor_dim)
     return placements
+
+
+_GLOBAL_MESH = [None]
+
+
+def set_mesh(mesh):
+    """Parity: paddle.distributed.set_mesh — record the global
+    ProcessMesh used by the auto-parallel APIs."""
+    _GLOBAL_MESH[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    """Parity: paddle.distributed.get_mesh."""
+    return _GLOBAL_MESH[0]
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Parity: paddle.distributed.shard_optimizer.
+
+    The reference walks optimizer state dicts and re-places each slot
+    on the mesh; here optimizer slots are created with
+    ``zeros_like(param)`` inside the jitted step, so GSPMD gives every
+    slot its parameter's sharding automatically — exactly the placement
+    ``shard_fn`` (e.g. ShardOptimizer stage-3) would assign. The wrapper
+    exists for call-site parity and applies ``shard_fn`` to any
+    already-materialized state."""
+    if shard_fn is not None and hasattr(optimizer, "_state"):
+        optimizer._state = shard_fn(optimizer._state)
+    return optimizer

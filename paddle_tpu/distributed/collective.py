@@ -266,3 +266,232 @@ def broadcast_object_list(object_list, src: int = 0, group=None):
             f"{len(gathered)} process(es)")
     object_list[:] = gathered[src]
     return object_list
+
+
+# ---------------------------------------------------------------------------
+# groups (parity: paddle.distributed.new_group / Group). A TPU "group"
+# is a mesh axis: arbitrary rank sets have no NCCL communicator to
+# build — they must correspond to one axis's subgroups of the active
+# mesh (the topology the reference's HCG builds its groups from too).
+# ---------------------------------------------------------------------------
+class Group:
+    """A communicator handle bound to one mesh axis."""
+
+    _registry: dict = {}
+    _next_id = [1]
+
+    def __init__(self, axis: str, ranks=None):
+        self.axis = axis
+        self.ranks = ranks
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+        Group._registry[self.id] = self
+
+    @property
+    def nranks(self):
+        return _active_mesh().shape[self.axis]
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, id={self.id})"
+
+
+def _axis_subgroups(mesh: Mesh, axis: str):
+    """Device-id rank sets forming each subgroup of ``axis``."""
+    import numpy as np
+
+    ax = mesh.axis_names.index(axis)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    moved = np.moveaxis(ids, ax, -1).reshape(-1, ids.shape[ax])
+    return [tuple(int(r) for r in row) for row in moved]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None):
+    """Create a Group. Pass ``axis=`` to bind a mesh axis directly, or
+    ``ranks`` matching one of an axis's subgroups (the only rank sets a
+    mesh topology can serve — anything else raises loudly)."""
+    if axis is not None:
+        return Group(axis, ranks)
+    mesh = _active_mesh()
+    if ranks is None:
+        return Group(mesh.axis_names[0])
+    want = tuple(int(r) for r in ranks)
+    for ax in mesh.axis_names:
+        if want in _axis_subgroups(mesh, ax):
+            return Group(ax, want)
+    raise ValueError(
+        f"new_group(ranks={ranks}): rank set matches no mesh-axis "
+        f"subgroup of {dict(mesh.shape)} — TPU groups are mesh axes")
+
+
+def get_group(gid: int):
+    return Group._registry.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        Group._registry.clear()
+    else:
+        Group._registry.pop(getattr(group, "id", None), None)
+
+
+def is_initialized():
+    return get_hybrid_communicate_group() is not None
+
+
+# ---------------------------------------------------------------------------
+# more eager collectives
+# ---------------------------------------------------------------------------
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, mesh=None):
+    """Reduce to rank ``dst``: every rank gets its own shard back except
+    dst, which gets the reduction (SPMD lockstep form)."""
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(x):
+        red = all_reduce_in(x, op, axis)
+        return jnp.where(jax.lax.axis_index(axis) == dst, red, x)
+
+    return f(tensor)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, mesh=None):
+    """Rank r receives piece r of src's list (paddle signature:
+    scatter(out, tensor_list, src))."""
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    n = mesh.shape[axis]
+    x = (jnp.stack(tensor_list) if tensor_list is not None
+         else tensor.reshape(n, -1, *tensor.shape[1:]))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(full):
+        i = jax.lax.axis_index(axis)
+        return jax.lax.dynamic_index_in_dim(full, i, 0, keepdims=False)
+
+    return f(x)
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, mesh=None):
+    """All ranks contribute their shard; the stacked result is returned
+    (every rank materializes it — an SPMD program cannot hold rank-
+    dependent shapes; paddle's dst-only contract is a subset)."""
+    stacked = all_gather(tensor, group=group, mesh=mesh)
+    n = (mesh or _active_mesh()).shape[_group_axis(group)]
+    # the global result replicates the gathered block once per rank —
+    # slice ONE block, then split it into the per-rank pieces
+    gathered = stacked[: stacked.shape[0] // n]
+    per = gathered.shape[0] // n
+    chunks = [gathered[i * per:(i + 1) * per] for i in range(n)]
+    if gather_list is not None:
+        gather_list.extend(chunks)
+        return gather_list
+    return chunks
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, mesh=None):
+    """Equal-split all-to-all on dim 0 (paddle alltoall_single with
+    uniform splits; ragged splits need the MoE dispatch path)."""
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError(
+            "alltoall_single: ragged splits — use distributed.moe's "
+            "sort-based dispatch for variable-size exchange")
+    return alltoall(in_tensor, group=group, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# p2p (parity: send/recv/isend/irecv, P2POp + batch_isend_irecv).
+# Lockstep SPMD: a rank pair is one ppermute edge; every rank runs the
+# same program, non-addressed ranks keep their input.
+# ---------------------------------------------------------------------------
+class _Task:
+    def __init__(self, value=None):
+        self.value = value
+
+    def wait(self):
+        if self.value is not None:
+            jax.block_until_ready(self.value)
+        return self.value
+
+
+def _p2p(tensor, pairs, group=None, mesh=None):
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )
+    def f(x):
+        moved = jax.lax.ppermute(x, axis, pairs)
+        dsts = jnp.asarray([d for _, d in pairs])
+        i = jax.lax.axis_index(axis)
+        hit = jnp.any(dsts == i)
+        return jnp.where(hit, moved, x)
+
+    return f(tensor)
+
+
+def send(tensor, dst: int = 0, group=None, mesh=None):
+    """Paired send: rank src's shard replaces rank dst's (the matching
+    ``recv`` reads the returned array). Returns the post-exchange
+    array."""
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    src = (dst - 1) % mesh.shape[axis]
+    return _p2p(tensor, [(src, dst)], group, mesh)
+
+
+def recv(tensor, src: int = 0, group=None, mesh=None):
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    dst = (src + 1) % mesh.shape[axis]
+    return _p2p(tensor, [(src, dst)], group, mesh)
+
+
+def isend(tensor, dst: int = 0, group=None, mesh=None):
+    return _Task(send(tensor, dst, group, mesh))
+
+
+def irecv(tensor, src: int = 0, group=None, mesh=None):
+    return _Task(recv(tensor, src, group, mesh))
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor)
+    return tensor
+
+
+class P2POp:
+    """Parity: paddle.distributed.P2POp — a deferred send/recv edge for
+    batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        name = getattr(op, "__name__", str(op))
+        if name not in ("send", "isend", "recv", "irecv"):
+            raise ValueError(f"P2POp: unknown op {op}")
+        self.is_send = "send" in name
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute every edge and return one task per op. Call-site parity
+    for the reference's grouped-NCCL launcher: in lockstep SPMD each op
+    is a canonical ring edge (see ``send``/``recv``); real pipelined
+    transfer fusion lives in the compiled schedules
+    (``distributed/pipeline.py``'s in-jit ppermute), not here."""
+    tasks = []
+    for o in p2p_op_list:
+        val = (send(o.tensor, o.peer, o.group) if o.is_send
+               else recv(o.tensor, o.peer, o.group))
+        tasks.append(_Task(val))
+    return tasks

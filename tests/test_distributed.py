@@ -77,6 +77,76 @@ def test_collectives_eager():
     np.testing.assert_allclose(np.asarray(rs), np.full((8, 4), 8.0))
 
 
+def test_collectives_extended():
+    """scatter/gather/reduce/p2p/groups (parity:
+    paddle.distributed.communication surface) on an 8-way dp mesh."""
+    s = _strategy(dp_degree=8)
+    hcg = dist.fleet_init(s)
+    mesh = hcg.mesh
+
+    # reduce to dst: dst rank's shard is the sum, others keep their own
+    x = jnp.arange(8.0)
+    r = dist.reduce(x, dst=3, mesh=mesh, group="dp")
+    ref = np.arange(8.0)
+    ref[3] = 28.0
+    np.testing.assert_allclose(np.asarray(r), ref)
+
+    # scatter from a list of per-rank pieces
+    pieces = [jnp.full((2,), float(i)) for i in range(8)]
+    sc = dist.scatter(None, pieces, mesh=mesh, group="dp")
+    np.testing.assert_allclose(np.asarray(sc).reshape(8, 2),
+                               np.repeat(np.arange(8.0)[:, None], 2, 1))
+
+    # gather returns the per-rank chunks
+    chunks = dist.gather(jnp.arange(8.0), mesh=mesh, group="dp")
+    assert len(chunks) == 8
+    np.testing.assert_allclose(np.asarray(chunks[5]), [5.0])
+
+    # send/recv: the canonical ring edge (src -> src+1)
+    moved = dist.recv(jnp.arange(8.0), src=2, mesh=mesh, group="dp")
+    assert float(moved[3]) == 2.0          # rank 3 received rank 2's
+    assert float(moved[0]) == 0.0          # others untouched
+    t = dist.isend(jnp.arange(8.0), dst=5, mesh=mesh, group="dp")
+    got = t.wait()
+    assert float(got[5]) == 4.0
+
+    # batch form
+    ops = [dist.P2POp(dist.isend, jnp.arange(8.0), 1, "dp"),
+           dist.P2POp(dist.irecv, jnp.arange(8.0), 6, "dp")]
+    tasks = dist.batch_isend_irecv(ops)
+    assert float(tasks[0].wait()[1]) == 0.0
+    assert float(tasks[1].wait()[7]) == 6.0
+
+    # alltoall_single uniform split: rank r's local [8] scatters chunk j
+    # to rank j; global output position r*8+j holds 8j+r (transpose)
+    a2a = dist.alltoall_single(jnp.arange(64.0), mesh=mesh, group="dp")
+    ref_a2a = np.arange(64.0).reshape(8, 8).T.reshape(-1)
+    np.testing.assert_allclose(np.asarray(a2a), ref_a2a)
+    with pytest.raises(NotImplementedError):
+        dist.alltoall_single(jnp.arange(64.0), in_split_sizes=[1] * 8,
+                             mesh=mesh, group="dp")
+
+    # groups: axis binding and subgroup matching
+    g = dist.new_group(axis="dp")
+    assert g.nranks == 8 and dist.get_group(g.id) is g
+    g2 = dist.new_group(ranks=list(range(8)))
+    assert g2.axis == "dp"
+    with pytest.raises(ValueError):
+        dist.new_group(ranks=[0, 3])
+    assert dist.is_initialized()
+    dist.destroy_process_group()
+    assert dist.get_group(g.id) is None
+
+    # mesh state + shard_optimizer parity wrappers
+    pm = dist.ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    dist.set_mesh(pm)
+    assert dist.get_mesh() is pm
+    from paddle_tpu import optimizer as opt
+
+    o = opt.AdamW(1e-3)
+    assert dist.shard_optimizer(o) is o
+
+
 def test_shard_tensor_api(mesh8):
     pm = dist.ProcessMesh(
         np.arange(8).reshape(2, 2, 2), dim_names=["dp", "fsdp", "tp"]
