@@ -37,6 +37,9 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     use_flash_attention: bool = True
+    # chunked fused head+CE (see LlamaConfig.fused_head_loss_chunk);
+    # 0 = off — worth enabling for GPT's 50k vocab at long seq
+    fused_head_loss_chunk: int = 0
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -207,6 +210,13 @@ class GPTForCausalLM(Layer):
                                       kv_caches, cache_index)
             return self.lm_head(hidden), caches
         hidden = self.gpt(input_ids, position_ids)
+        if labels is not None and self.config.fused_head_loss_chunk:
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            return fused_linear_cross_entropy(
+                hidden[:, :-1, :], self.lm_head.weight.value,
+                labels[:, 1:], ignore_index=-100,
+                seq_chunk=self.config.fused_head_loss_chunk)
         logits = self.lm_head(hidden)
         if labels is None:
             return logits

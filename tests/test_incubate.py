@@ -126,6 +126,20 @@ class TestFusedLinearCrossEntropy:
             x, w.T, y, bias=bias, transpose_weight=True, seq_chunk=8)
         np.testing.assert_allclose(float(ref), float(out), rtol=1e-6)
 
+    def test_gpt_config_flag(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        losses = {}
+        for chunk in (0, 4):
+            pt.seed(0)
+            m = GPTForCausalLM(GPTConfig.tiny(
+                use_flash_attention=False, fused_head_loss_chunk=chunk,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+            ids = jnp.asarray(
+                np.random.default_rng(2).integers(0, 256, (2, 10)))
+            losses[chunk] = float(m(ids, labels=ids))
+        np.testing.assert_allclose(losses[0], losses[4], rtol=1e-6)
+
     def test_llama_config_flag(self):
         """fused_head_loss_chunk routes the CausalLM loss through the
         chunked head; loss must match the default full-logits path."""
