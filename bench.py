@@ -384,8 +384,8 @@ def _apply_baseline_ratio(result):
             r["vs_baseline"] = round(r["value"] / float(b["value"]), 3)
 
 
-SECONDARY_TIMEOUT = 420   # per config; each compiles its own programs
-SECONDARY_BUDGET = 1500   # total wall-clock for all secondaries
+SECONDARY_TIMEOUT = 560   # per config; each compiles its own programs
+SECONDARY_BUDGET = 1800   # total wall-clock for all secondaries
 HEADLINE_TIMEOUT = 1200
 
 

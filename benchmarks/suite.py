@@ -61,6 +61,10 @@ def _train_throughput(model, data, loss_fn=None, iters=None, unit_count=0):
         dt = time.perf_counter() - t0
         if dt >= min_window or n >= 2000:
             break
+        # grow the dispatch chunk so the remaining window costs ~2 more
+        # blocking roundtrips, not hundreds (tunnel dispatch latency)
+        iters = max(iters, min(1000, int((min_window - dt) / max(
+            dt / n, 1e-4) / 2) + 1))
     return unit_count * n / dt, 1000 * dt / n, float(loss)
 
 
@@ -229,11 +233,14 @@ def bench_infer(tpu_diags):
     # steady arrival load: a new request lands every `gap` seconds while
     # earlier ones are still decoding. The calibration chunk (request 0)
     # is INSIDE the measured window so token counts and wall time match.
+    # On TPU the gap is a FIXED design constant — a chunk-relative gap
+    # self-scales the offered load with engine speed, which made TTFT
+    # incomparable across rounds (a faster engine measured "worse").
     t_start = time.perf_counter()
     eng.add_request(prompts[0], new_tokens)
-    eng.step_chunk(max_chunk)  # measure gap-per-chunk cheaply
+    eng.step_chunk(max_chunk)  # calibration chunk (CPU gap only)
     chunk_s = time.perf_counter() - t_start
-    gap = max(chunk_s / 2, 1e-3)
+    gap = 0.150 if tpu else max(chunk_s / 2, 1e-3)
 
     submitted = 1
     next_arrival = time.perf_counter() + gap
