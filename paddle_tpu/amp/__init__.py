@@ -168,3 +168,15 @@ class GradScaler:
         self._scale = d["scale"]
         self._good_steps = d["good_steps"]
         self._bad_steps = d["bad_steps"]
+
+
+def is_bfloat16_supported(device=None):
+    """Parity: paddle.amp.is_bfloat16_supported — every TPU generation
+    (and XLA:CPU) runs bf16 natively."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """Parity: paddle.amp.is_float16_supported. XLA supports f16
+    storage/compute on TPU (MXU upconverts); bf16 is the fast path."""
+    return True

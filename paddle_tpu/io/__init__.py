@@ -179,6 +179,23 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Parity: paddle.io.SubsetRandomSampler — a random permutation of
+    the given index subset each epoch."""
+
+    def __init__(self, indices):
+        super().__init__(None)
+        self.indices = list(indices)
+        self._rng = np.random.default_rng()
+
+    def __iter__(self):
+        return iter(self.indices[i] for i in
+                    self._rng.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         super().__init__(None)

@@ -335,3 +335,34 @@ class ReduceOnPlateau(LRScheduler):
                 self._bad = 0
                 self._cool = self.cooldown
         self.last_lr = float(self._lr)
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """Parity: paddle.optimizer.lr.CosineAnnealingWarmRestarts (SGDR):
+    cosine anneal over a period of T_0 steps, then restart with the
+    period scaled by T_mult."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1):
+        if T_0 <= 0 or T_mult < 1:
+            raise ValueError("T_0 must be > 0 and T_mult >= 1")
+        self.T_0 = T_0
+        self.T_mult = int(T_mult)
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.T_mult == 1:
+            t_cur = jnp.mod(step, self.T_0)
+            t_i = jnp.asarray(self.T_0, jnp.float32)
+        else:
+            # cycle n starts at T_0*(T_mult^n - 1)/(T_mult - 1)
+            m = self.T_mult
+            n = jnp.floor(
+                jnp.log1p(step * (m - 1) / self.T_0) / jnp.log(float(m)))
+            start = self.T_0 * (jnp.power(float(m), n) - 1.0) / (m - 1)
+            t_i = self.T_0 * jnp.power(float(m), n)
+            t_cur = step - start
+        return self.eta_min + 0.5 * (self.base_lr - self.eta_min) * (
+            1.0 + jnp.cos(jnp.pi * t_cur / t_i))
