@@ -486,3 +486,257 @@ class TransformedDistribution(Distribution):
         x = self.transform.inverse(value)
         return (self.base.log_prob(x)
                 - self.transform.forward_log_det_jacobian(x))
+
+
+# ---------------------------------------------------------------------------
+# long-tail distributions (parity: python/paddle/distribution/)
+# ---------------------------------------------------------------------------
+class Geometric(Distribution):
+    """Parity: paddle.distribution.Geometric — pmf over the number of
+    failures before the first success, support {0, 1, 2, ...}:
+    P(X=k) = (1-p)^k p."""
+
+    def __init__(self, probs):
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs_) / self.probs_
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs_) / (self.probs_ ** 2)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("geometric")
+        shape = tuple(shape) + self.probs_.shape
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_))
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return value * jnp.log1p(-p) + jnp.log(p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        q = 1.0 - p
+        return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+
+class Cauchy(Distribution):
+    """Parity: paddle.distribution.Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("cauchy")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.cauchy(key, shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (jnp.asarray(value, jnp.float32) - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1.0 + z * z))
+
+    def entropy(self):
+        return jnp.log(4 * math.pi * self.scale)
+
+    def cdf(self, value):
+        z = (jnp.asarray(value, jnp.float32) - self.loc) / self.scale
+        return jnp.arctan(z) / math.pi + 0.5
+
+    def kl_divergence(self, other: "Cauchy"):
+        # closed form (Chyzak & Nielsen 2019)
+        num = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+        return jnp.log(num / (4.0 * self.scale * other.scale))
+
+
+class StudentT(Distribution):
+    """Parity: paddle.distribution.StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("student_t")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.t(key, self.df, shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        gl = jax.scipy.special.gammaln
+        v = self.df
+        z = (jnp.asarray(value, jnp.float32) - self.loc) / self.scale
+        return (gl((v + 1) / 2) - gl(v / 2)
+                - 0.5 * jnp.log(v * math.pi) - jnp.log(self.scale)
+                - (v + 1) / 2 * jnp.log1p(z * z / v))
+
+    def entropy(self):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        v = self.df
+        return ((v + 1) / 2 * (dg((v + 1) / 2) - dg(v / 2))
+                + 0.5 * jnp.log(v) + _betaln(v / 2, jnp.asarray(0.5))
+                + jnp.log(self.scale))
+
+
+class Binomial(Distribution):
+    """Parity: paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(total_count, jnp.float32)
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs_
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs_ * (1 - self.probs_)
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("binomial")
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.total_count.shape, self.probs_.shape)
+        n = int(jnp.max(self.total_count))
+        u = jax.random.uniform(key, (n,) + shape)
+        trial = jnp.arange(n).reshape((n,) + (1,) * len(shape))
+        live = trial < self.total_count
+        return jnp.sum((u < self.probs_) & live, axis=0).astype(
+            jnp.float32)
+
+    def log_prob(self, value):
+        gl = jax.scipy.special.gammaln
+        k = jnp.asarray(value, jnp.float32)
+        n = self.total_count
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return (gl(n + 1) - gl(k + 1) - gl(n - k + 1)
+                + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+
+class ContinuousBernoulli(Distribution):
+    """Parity: paddle.distribution.ContinuousBernoulli — density
+    C(l) l^x (1-l)^(1-x) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+        self._lims = lims
+
+    def _log_C(self):
+        l = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        near = (l > self._lims[0]) & (l < self._lims[1])
+        safe = jnp.where(near, 0.25, l)
+        log_c = jnp.log(
+            jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            / jnp.abs(1.0 - 2.0 * safe))
+        # Taylor at l = 1/2: log 2 + (4/3)(l-1/2)^2 + O(eps^4)
+        x = l - 0.5
+        taylor = math.log(2.0) + 4.0 / 3.0 * x * x
+        return jnp.where(near, taylor, log_c)
+
+    def log_prob(self, value):
+        l = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        x = jnp.asarray(value, jnp.float32)
+        return (self._log_C() + x * jnp.log(l)
+                + (1.0 - x) * jnp.log1p(-l))
+
+    def sample(self, shape=()):
+        key = random_mod.next_rng_key("cbernoulli")
+        shape = tuple(shape) + self.probs_.shape
+        u = jax.random.uniform(key, shape, minval=1e-6, maxval=1 - 1e-6)
+        l = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        near = (l > self._lims[0]) & (l < self._lims[1])
+        safe = jnp.where(near, 0.25, l)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(near, u, icdf)
+
+    rsample = sample
+
+
+class Independent(Distribution):
+    """Parity: paddle.distribution.Independent — reinterpret the last
+    ``reinterpreted_batch_ndims`` batch dims as event dims (log_prob
+    sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.ndims = int(reinterpreted_batch_ndims)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.ndims, 0)))
+
+    def entropy(self):
+        return jnp.sum(self.base.entropy(),
+                       axis=tuple(range(-self.ndims, 0)))
+
+
+class ExponentialFamily(Distribution):
+    """Parity: paddle.distribution.ExponentialFamily — subclasses give
+    natural parameters + log-normalizer A(theta); entropy comes from the
+    Bregman identity H = A - <theta, grad A> + E[-h(x)] via jax.grad
+    (the reference differentiates A with its autograd too)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        theta = [jnp.asarray(t, jnp.float32)
+                 for t in self._natural_parameters]
+        a_val = self._log_normalizer(*theta)
+        grads = jax.grad(
+            lambda *ts: jnp.sum(self._log_normalizer(*ts)),
+            argnums=tuple(range(len(theta))))(*theta)
+        ent = a_val + self._mean_carrier_measure
+        for t, g in zip(theta, grads):
+            ent = ent - t * g
+        return ent
+
+
+# user-extensible KL registry (parity: paddle.distribution.register_kl)
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+_builtin_kl = kl_divergence
+
+
+def kl_divergence(p: Distribution, q: Distribution):  # noqa: F811
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    if isinstance(p, Cauchy) and isinstance(q, Cauchy):
+        return p.kl_divergence(q)
+    if isinstance(p, Independent) and isinstance(q, Independent) \
+            and p.ndims == q.ndims:
+        kl = kl_divergence(p.base, q.base)
+        return jnp.sum(kl, axis=tuple(range(-p.ndims, 0)))
+    return _builtin_kl(p, q)

@@ -1,7 +1,10 @@
 from .callbacks import (  # noqa: F401
     Callback,
     EarlyStopping,
+    LRScheduler,
     LRSchedulerCallback,
+    ReduceLROnPlateau,
+    VisualDL,
     ModelCheckpoint,
     ProgBarLogger,
 )

@@ -151,3 +151,96 @@ class LRSchedulerCallback(Callback):
         sched = getattr(self.model._optimizer, "_lr_scheduler", None)
         if sched is not None and not self.by_step:
             sched.step()
+
+
+# paddle name: callbacks.LRScheduler
+LRScheduler = LRSchedulerCallback
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi callbacks.ReduceLROnPlateau — shrink the scheduler
+    LR when ``monitor`` stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 mode="min", min_delta=1e-4, cooldown=0, min_lr=0.0,
+                 verbose=1):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = self.model._optimizer
+            sched = getattr(opt, "_lr_scheduler", None)
+            target = sched if sched is not None else opt
+            old = float(getattr(target, "base_lr",
+                                getattr(target, "learning_rate", 0.0)))
+            new = max(old * self.factor, self.min_lr)
+            if hasattr(target, "base_lr"):
+                target.base_lr = new
+            else:
+                target.learning_rate = new
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self.wait = 0
+            self.cooldown_counter = self.cooldown
+
+
+class VisualDL(Callback):
+    """Parity: hapi callbacks.VisualDL. The visualdl package is not
+    available in this environment; scalars are appended to a JSONL
+    file a local VisualDL/TensorBoard shim can tail."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        rec = {"tag": tag, "step": self._step}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)

@@ -198,3 +198,17 @@ class ImageFolder(DatasetFolder):
         if self.transform is not None:
             img = self.transform(img)
         return (img,)
+
+
+class FashionMNIST(MNIST):
+    """Parity: paddle.vision.datasets.FashionMNIST — identical idx file
+    format, different corpus."""
+
+
+class Cifar100(Cifar10):
+    """Parity: paddle.vision.datasets.Cifar100 — the
+    ``cifar-100-python.tar.gz`` layout ('train'/'test' members,
+    fine_labels)."""
+
+    _batches_train = ["train"]
+    _batches_test = ["test"]

@@ -8,6 +8,10 @@ from .resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext101_32x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
 )
 
 from .classic import (  # noqa: F401,E402
@@ -15,10 +19,12 @@ from .classic import (  # noqa: F401,E402
     AlexNet,
     DenseNet,
     ShuffleNetV2,
+    LeNet,
     SqueezeNet,
     alexnet,
     densenet121,
     shufflenet_v2_x1_0,
+    squeezenet1_0,
     squeezenet1_1,
     vgg11,
     vgg13,

@@ -163,18 +163,27 @@ class SqueezeNet(Layer):
         super().__init__()
         from ...nn.layer.common import LayerList
 
-        if version != "1.1":
-            raise ValueError(
-                f"SqueezeNet: only version '1.1' is implemented "
-                f"(got {version!r})")
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"SqueezeNet: unknown version {version!r}")
         self.version = version
-        self.conv1 = Conv2D(3, 64, 3, stride=2)
-        self.fires = LayerList([
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
-        ])
+        if version == "1.1":
+            self.conv1 = Conv2D(3, 64, 3, stride=2)
+            self.fires = LayerList([
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            ])
+            self._pool_after = (1, 3)  # v1.1 placement
+        else:
+            self.conv1 = Conv2D(3, 96, 7, stride=2)
+            self.fires = LayerList([
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            ])
+            self._pool_after = (2, 6)  # v1.0 placement
         self.conv_final = Conv2D(512, num_classes, 1)
         self.dropout = Dropout(0.5)
 
@@ -182,10 +191,14 @@ class SqueezeNet(Layer):
         x = F.max_pool2d(F.relu(self.conv1(x)), 3, 2)
         for i, fire in enumerate(self.fires):
             x = fire(x)
-            if i in (1, 3):            # v1.1 pool placement
+            if i in self._pool_after:
                 x = F.max_pool2d(x, 3, 2)
         x = F.relu(self.conv_final(self.dropout(x)))
         return F.adaptive_avg_pool2d(x, (1, 1)).reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
 
 
 def squeezenet1_1(**kw):
@@ -332,3 +345,32 @@ class ShuffleNetV2(Layer):
 
 def shufflenet_v2_x1_0(**kw):
     return ShuffleNetV2(1.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# LeNet (parity: paddle.vision.models.LeNet — the MNIST 1x28x28 config)
+# ---------------------------------------------------------------------------
+class LeNet(Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        from ...nn.layer.common import Linear, Sequential
+
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1),
+        )
+        self.conv2 = Conv2D(6, 16, 5, stride=1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(400, 120)
+            self.fc1 = Linear(120, 84)
+            self.fc2 = Linear(84, num_classes)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.features(x)), 2, 2)
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2, 2)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = F.relu(self.fc(x))
+            x = F.relu(self.fc1(x))
+            x = self.fc2(x)
+        return x

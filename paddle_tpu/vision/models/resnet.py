@@ -43,14 +43,15 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=BatchNorm2D):
+                 norm_layer=BatchNorm2D, groups=1, base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = norm_layer(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = norm_layer(planes)
-        self.conv3 = Conv2D(planes, planes * self.expansion, 1,
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = norm_layer(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = norm_layer(width)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1,
                             bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.downsample = downsample
@@ -67,10 +68,13 @@ class BottleneckBlock(Layer):
 
 class ResNet(Layer):
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
-                 norm_layer=BatchNorm2D, in_channels=3):
+                 norm_layer=BatchNorm2D, in_channels=3, groups=1,
+                 width=64):
         super().__init__()
         self.inplanes = 64
         self.norm_layer = norm_layer
+        self.groups = groups
+        self.base_width = width
         self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
                             bias_attr=False)
         self.bn1 = norm_layer(64)
@@ -94,12 +98,15 @@ class ResNet(Layer):
                        stride=stride, bias_attr=False),
                 self.norm_layer(planes * block.expansion),
             )
+        extra = ({"groups": self.groups, "base_width": self.base_width}
+                 if block is BottleneckBlock else {})
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.norm_layer)]
+                        self.norm_layer, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(
-                block(self.inplanes, planes, norm_layer=self.norm_layer)
+                block(self.inplanes, planes, norm_layer=self.norm_layer,
+                      **extra)
             )
         return Sequential(*layers)
 
@@ -142,3 +149,23 @@ def resnet101(**kwargs):
 
 def resnet152(**kwargs):
     return _resnet(BottleneckBlock, (3, 8, 36, 3), **kwargs)
+
+
+def wide_resnet50_2(**kwargs):
+    """Parity: paddle wide_resnet50_2 — bottleneck width doubled."""
+    return _resnet(BottleneckBlock, (3, 4, 6, 3), width=128, **kwargs)
+
+
+def wide_resnet101_2(**kwargs):
+    return _resnet(BottleneckBlock, (3, 4, 23, 3), width=128, **kwargs)
+
+
+def resnext50_32x4d(**kwargs):
+    """Parity: paddle resnext50_32x4d — 32 groups x 4-wide."""
+    return _resnet(BottleneckBlock, (3, 4, 6, 3), groups=32, width=4,
+                   **kwargs)
+
+
+def resnext101_32x4d(**kwargs):
+    return _resnet(BottleneckBlock, (3, 4, 23, 3), groups=32, width=4,
+                   **kwargs)

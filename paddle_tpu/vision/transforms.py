@@ -429,3 +429,280 @@ class RandomAffine(BaseTransform):
             float(self._rng.uniform(*self.shear)), 0.0)
         return affine(img, ang, (tx, ty), sc, sh, self.interpolation,
                       self.fill)
+
+
+# ------------------------------------------------- color / photometric ops
+def _to_hwc_float(img):
+    """PIL/HWC array → (float32 HWC ndarray, was_pil, was_uint8)."""
+    was_pil = _is_pil(img)
+    arr = np.asarray(img)
+    was_u8 = arr.dtype == np.uint8
+    a = arr.astype(np.float32)
+    return a, was_pil, was_u8
+
+
+def _restore(a, was_pil, was_u8):
+    if was_u8:
+        a = np.clip(np.round(a), 0, 255).astype(np.uint8)
+    if was_pil:
+        return Image.fromarray(a)
+    return a
+
+
+def adjust_brightness(img, brightness_factor):
+    """Parity: paddle adjust_brightness — img * factor (blend with
+    black), torchvision math."""
+    a, p, u = _to_hwc_float(img)
+    return _restore(a * brightness_factor, p, u)
+
+
+def _grayscale(a):
+    if a.ndim == 2 or a.shape[-1] == 1:
+        return a if a.ndim == 2 else a[..., 0]
+    return (0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2])
+
+
+def adjust_contrast(img, contrast_factor):
+    a, p, u = _to_hwc_float(img)
+    mean = _grayscale(a).mean()
+    return _restore(mean + contrast_factor * (a - mean), p, u)
+
+
+def adjust_saturation(img, saturation_factor):
+    a, p, u = _to_hwc_float(img)
+    gray = _grayscale(a)[..., None]
+    return _restore(gray + saturation_factor * (a - gray), p, u)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: shift hue in HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a, p, u = _to_hwc_float(img)
+    scale = 255.0 if u else 1.0
+    rgb = a / scale
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = rgb.max(-1)
+    minc = rgb.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dd = np.maximum(d, 1e-12)
+    rc, gc, bc = (maxc - r) / dd, (maxc - g) / dd, (maxc - b) / dd
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    pp = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, pp, pp, t, v])
+    g2 = np.choose(i, [t, v, v, q, pp, pp])
+    b2 = np.choose(i, [pp, pp, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * scale
+    return _restore(out, p, u)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a, p, u = _to_hwc_float(img)
+    g = _grayscale(a)[..., None]
+    out = np.repeat(g, num_output_channels, axis=-1)
+    if p and num_output_channels == 1:
+        out = out[..., 0]
+    return _restore(out, p, u)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Parity: paddle transforms.pad — padding int | (lr, tb) |
+    (l, t, r, b); HWC arrays or PIL."""
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = (int(v) for v in padding)
+    was_pil = _is_pil(img)
+    arr = np.asarray(img)
+    width = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        out = np.pad(arr, width, constant_values=fill)
+    else:
+        out = np.pad(arr, width, mode=padding_mode)
+    return Image.fromarray(out) if was_pil else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Parity: paddle transforms.erase — fill [i:i+h, j:j+w] with v.
+    CHW tensors/arrays (or HWC with trailing channel)."""
+    import jax.numpy as jnp
+
+    if isinstance(img, np.ndarray):
+        out = img if inplace else img.copy()
+        if out.ndim == 3 and out.shape[0] in (1, 3):   # CHW
+            out[:, i:i + h, j:j + w] = v
+        else:
+            out[i:i + h, j:j + w] = v
+        return out
+    x = img
+    if x.ndim == 3 and x.shape[0] in (1, 3):
+        return x.at[:, i:i + h, j:j + w].set(v)
+    return x.at[i:i + h, j:j + w].set(v)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform(BaseTransform):
+    """value v: factor drawn U[max(0, 1-v), 1+v] (paddle semantics)."""
+
+    def __init__(self, value, seed=None):
+        self.value = value
+        self._rng = np.random.default_rng(seed)
+
+    def _factor(self):
+        v = self.value
+        return float(self._rng.uniform(max(0.0, 1 - v), 1 + v))
+
+    def __call__(self, img):
+        return adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        return adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        return adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    """value v <= 0.5: shift drawn U[-v, v]."""
+
+    def __init__(self, value, seed=None):
+        self.value = value
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, img):
+        return adjust_hue(img, float(self._rng.uniform(-self.value,
+                                                       self.value)))
+
+
+class ColorJitter(BaseTransform):
+    """Parity: paddle ColorJitter — brightness/contrast/saturation/hue
+    jitter applied in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 seed=None):
+        self._rng = np.random.default_rng(seed)
+        self.ops = []
+        if brightness:
+            self.ops.append(BrightnessTransform(brightness,
+                                                seed=self._rng.integers(2**31)))
+        if contrast:
+            self.ops.append(ContrastTransform(contrast,
+                                              seed=self._rng.integers(2**31)))
+        if saturation:
+            self.ops.append(SaturationTransform(saturation,
+                                                seed=self._rng.integers(2**31)))
+        if hue:
+            self.ops.append(HueTransform(hue,
+                                         seed=self._rng.integers(2**31)))
+
+    def __call__(self, img):
+        for k in self._rng.permutation(len(self.ops)):
+            img = self.ops[int(k)](img)
+        return img
+
+
+class RandomPerspective(BaseTransform):
+    """Parity: paddle RandomPerspective — random corner displacement
+    warp with probability ``prob``."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0.0, seed=None):
+        self.prob = prob
+        self.scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, img):
+        if self._rng.random() >= self.prob:
+            return img
+        h, w = np.asarray(img).shape[-2:] if not _is_pil(img) \
+            else (img.size[1], img.size[0])
+        if not _is_pil(img) and np.asarray(img).ndim == 3 \
+                and np.asarray(img).shape[0] not in (1, 3):
+            h, w = np.asarray(img).shape[:2]
+        dx = self.scale * w / 2
+        dy = self.scale * h / 2
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[float(self._rng.uniform(0, dx)),
+                float(self._rng.uniform(0, dy))],
+               [float(w - 1 - self._rng.uniform(0, dx)),
+                float(self._rng.uniform(0, dy))],
+               [float(w - 1 - self._rng.uniform(0, dx)),
+                float(h - 1 - self._rng.uniform(0, dy))],
+               [float(self._rng.uniform(0, dx)),
+                float(h - 1 - self._rng.uniform(0, dy))]]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Parity: paddle RandomErasing — erase a random rectangle with
+    probability ``prob``; value None => random noise."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, seed=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, img):
+        if self._rng.random() >= self.prob:
+            return img
+        arr = np.asarray(img) if not _is_pil(img) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h, w = (arr.shape[1:3] if chw else arr.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = float(self._rng.uniform(*self.scale)) * area
+            ar = float(np.exp(self._rng.uniform(np.log(self.ratio[0]),
+                                                np.log(self.ratio[1]))))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = int(self._rng.integers(0, h - eh + 1))
+                j = int(self._rng.integers(0, w - ew + 1))
+                if self.value is None:
+                    shape = ((arr.shape[0], eh, ew) if chw
+                             else (eh, ew) + arr.shape[2:])
+                    v = self._rng.standard_normal(shape).astype(
+                        np.float32)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img

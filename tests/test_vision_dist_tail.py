@@ -1,0 +1,197 @@
+"""Round-4 breadth tail: color/photometric transforms, model-zoo
+variants, long-tail distributions, hapi callbacks — numerics pinned to
+torch where a reference exists."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+from paddle_tpu.vision import models as M, transforms as T
+
+
+class TestColorTransforms:
+    def setup_method(self, _):
+        rng = np.random.default_rng(0)
+        self.img = rng.uniform(0, 255, (16, 20, 3)).astype(np.uint8)
+
+    def test_adjust_ops_match_torchvision_math(self):
+        a = self.img.astype(np.float32)
+        np.testing.assert_array_equal(
+            T.adjust_brightness(self.img, 0.5),
+            np.clip(np.round(a * 0.5), 0, 255).astype(np.uint8))
+        g = 0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2]
+        np.testing.assert_array_equal(
+            T.adjust_contrast(self.img, 1.3),
+            np.clip(np.round(g.mean() + 1.3 * (a - g.mean())),
+                    0, 255).astype(np.uint8))
+        np.testing.assert_array_equal(
+            T.adjust_saturation(self.img, 0.0)[..., 0],
+            T.to_grayscale(self.img)[..., 0])
+        # hue: zero shift is identity; any shift preserves value channel
+        np.testing.assert_allclose(T.adjust_hue(self.img, 0.0),
+                                   self.img, atol=1)
+        shifted = T.adjust_hue(self.img, 0.25)
+        np.testing.assert_allclose(shifted.max(-1), self.img.max(-1),
+                                   atol=1)
+        with pytest.raises(ValueError):
+            T.adjust_hue(self.img, 0.7)
+
+    def test_jitter_pad_gray_erase_perspective(self):
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2, seed=0)(self.img)
+        assert out.shape == self.img.shape and out.dtype == np.uint8
+        assert T.Pad(2)(self.img).shape == (20, 24, 3)
+        assert T.Pad((1, 2))(self.img).shape == (20, 22, 3)
+        assert T.Grayscale(3)(self.img).shape == self.img.shape
+        e = T.RandomErasing(prob=1.0, seed=1)(self.img.copy())
+        assert (e != self.img).any()
+        chw = np.transpose(self.img, (2, 0, 1))
+        e2 = T.RandomErasing(prob=1.0, value=None, seed=2)(chw.copy())
+        assert e2.shape == chw.shape
+        p = T.RandomPerspective(prob=1.0, seed=3)(self.img)
+        assert np.asarray(p).shape == self.img.shape
+        assert T.RandomPerspective(prob=0.0)(self.img) is self.img
+
+
+class TestModelZooVariants:
+    def test_wide_and_resnext_param_counts(self):
+        """Parameter counts must match the torchvision/paddle references
+        (1000-class config): wide_resnet50_2 68.88M, resnext50_32x4d
+        25.03M."""
+        pt.seed(0)
+        w = M.wide_resnet50_2()
+        n = sum(int(np.prod(p.shape)) for _, p in w.named_parameters())
+        assert abs(n - 68_883_240) < 10_000, n
+        r = M.resnext50_32x4d()
+        n = sum(int(np.prod(p.shape)) for _, p in r.named_parameters())
+        assert abs(n - 25_028_904) < 10_000, n
+
+    def test_forward_shapes(self):
+        pt.seed(0)
+        x = jnp.zeros((1, 3, 64, 64))
+        assert M.resnext50_32x4d(num_classes=7)(x).shape == (1, 7)
+        assert M.LeNet()(jnp.zeros((2, 1, 28, 28))).shape == (2, 10)
+        y = M.squeezenet1_0(num_classes=5)(jnp.zeros((1, 3, 96, 96)))
+        assert y.shape == (1, 5)
+
+    def test_datasets_exist(self):
+        from paddle_tpu.vision import datasets as DS
+
+        assert issubclass(DS.FashionMNIST, DS.MNIST)
+        assert DS.Cifar100._batches_train == ["train"]
+
+
+class TestDistributionTail:
+    def test_log_prob_vs_torch(self):
+        cases = (
+            (D.Geometric(0.3), torch.distributions.Geometric(0.3), 4.0),
+            (D.Cauchy(1.0, 2.0), torch.distributions.Cauchy(1.0, 2.0), 0.7),
+            (D.StudentT(5.0, 1.0, 2.0),
+             torch.distributions.StudentT(5.0, 1.0, 2.0), 0.3),
+            (D.Binomial(10, 0.4),
+             torch.distributions.Binomial(10, 0.4), 3.0),
+            (D.ContinuousBernoulli(0.3),
+             torch.distributions.ContinuousBernoulli(0.3), 0.7),
+            # the lambda ~ 0.5 Taylor branch
+            (D.ContinuousBernoulli(0.5),
+             torch.distributions.ContinuousBernoulli(0.5), 0.7),
+        )
+        for ours, theirs, v in cases:
+            np.testing.assert_allclose(
+                float(ours.log_prob(v)),
+                float(theirs.log_prob(torch.tensor(v))), atol=2e-4,
+                err_msg=type(ours).__name__)
+
+    def test_entropy_vs_torch(self):
+        for ours, theirs in (
+                (D.Cauchy(1.0, 2.0), torch.distributions.Cauchy(1.0, 2.0)),
+                (D.StudentT(5.0, 1.0, 2.0),
+                 torch.distributions.StudentT(5.0, 1.0, 2.0)),
+                (D.Geometric(0.3), torch.distributions.Geometric(0.3))):
+            np.testing.assert_allclose(float(ours.entropy()),
+                                       float(theirs.entropy()), atol=2e-4)
+
+    def test_independent_and_register_kl(self):
+        base = D.Normal(jnp.zeros((3, 4)), jnp.ones((3, 4)))
+        ind = D.Independent(base, 1)
+        tb = torch.distributions.Independent(
+            torch.distributions.Normal(torch.zeros(3, 4),
+                                       torch.ones(3, 4)), 1)
+        np.testing.assert_allclose(
+            np.asarray(ind.log_prob(jnp.zeros((3, 4)))),
+            tb.log_prob(torch.zeros(3, 4)).numpy(), rtol=1e-5)
+        # Independent KL reduces over event dims
+        q = D.Independent(D.Normal(jnp.ones((3, 4)),
+                                   jnp.ones((3, 4))), 1)
+        kl = D.kl_divergence(ind, q)
+        assert kl.shape == (3,)
+        # registered kernels take precedence
+        class _Marker(D.Geometric):
+            pass
+
+        @D.register_kl(_Marker, _Marker)
+        def _kl(p, q):  # noqa: ANN001
+            return jnp.asarray(42.0)
+
+        assert float(D.kl_divergence(_Marker(0.3), _Marker(0.5))) == 42.0
+        # Cauchy-Cauchy closed form is positive and zero at identity
+        assert float(D.kl_divergence(D.Cauchy(0.0, 1.0),
+                                     D.Cauchy(0.0, 1.0))) < 1e-6
+        assert float(D.kl_divergence(D.Cauchy(0.0, 1.0),
+                                     D.Cauchy(1.0, 2.0))) > 0
+
+    def test_exponential_family_autograd_entropy(self):
+        class _NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.float32(loc)
+                self.scale = jnp.float32(scale)
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, t1, t2):
+                return -t1 ** 2 / (4 * t2) - 0.5 * jnp.log(-2 * t2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return 0.5 * np.log(2 * np.pi)
+
+        np.testing.assert_allclose(
+            float(_NormalEF(1.0, 2.0).entropy()),
+            float(torch.distributions.Normal(1.0, 2.0).entropy()),
+            atol=1e-4)
+
+
+class TestCallbackTail:
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu import hapi, optimizer as opt
+
+        cb = hapi.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                    patience=2, verbose=0)
+
+        class _FakeModel:
+            _optimizer = opt.SGD(learning_rate=0.8)
+
+        cb.model = _FakeModel()
+        cb.on_eval_end({"loss": 1.0})
+        for _ in range(3):
+            cb.on_eval_end({"loss": 2.0})   # no improvement
+        assert abs(float(_FakeModel._optimizer.base_lr) - 0.4) < 1e-9
+
+    def test_visualdl_writes_jsonl(self, tmp_path):
+        import json
+
+        from paddle_tpu import hapi
+
+        cb = hapi.VisualDL(log_dir=str(tmp_path))
+        for i in range(10):
+            cb.on_train_batch_end(i, {"loss": 1.0 / (i + 1)})
+        cb.on_eval_end({"acc": 0.9})
+        lines = [json.loads(l) for l in
+                 (tmp_path / "scalars.jsonl").read_text().splitlines()]
+        assert any(r["tag"] == "train" for r in lines)
+        assert any(r["tag"] == "eval" and r["acc"] == 0.9 for r in lines)
