@@ -1,6 +1,17 @@
 """Vision model zoo (parity: python/paddle/vision/models/)."""
 
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .inception import (  # noqa: F401
+    GoogLeNet,
+    InceptionV3,
+    googlenet,
+    inception_v3,
+)
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
 from .resnet import (  # noqa: F401
     ResNet,
     resnet18,

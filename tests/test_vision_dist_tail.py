@@ -76,6 +76,39 @@ class TestModelZooVariants:
         y = M.squeezenet1_0(num_classes=5)(jnp.zeros((1, 3, 96, 96)))
         assert y.shape == (1, 5)
 
+    def test_mobilenet_v3_param_counts_exact(self):
+        """Exactly torchvision's counts: large 5,483,032 / small
+        2,542,856."""
+        pt.seed(0)
+        n = sum(int(np.prod(p.shape)) for _, p in
+                M.mobilenet_v3_large().named_parameters())
+        assert n == 5_483_032, n
+        n = sum(int(np.prod(p.shape)) for _, p in
+                M.mobilenet_v3_small().named_parameters())
+        assert n == 2_542_856, n
+        y = M.mobilenet_v3_small(num_classes=4)(jnp.zeros((1, 3, 64, 64)))
+        assert y.shape == (1, 4)
+
+    def test_googlenet_inception_aux_heads(self):
+        """Training mode returns (out, aux...) like the reference; eval
+        returns the logits; param counts track torchvision (head-size
+        delta accounted: 13.00M/27.16M at 1000 classes)."""
+        pt.seed(0)
+        g = M.googlenet(num_classes=10)
+        outs = g(jnp.zeros((1, 3, 96, 96)))
+        assert len(outs) == 3 and all(o.shape == (1, 10) for o in outs)
+        n = sum(int(np.prod(p.shape)) for _, p in g.named_parameters())
+        assert abs(n - 9_960_638) < 5_000, n
+        g.eval()
+        assert g(jnp.zeros((1, 3, 96, 96))).shape == (1, 10)
+        iv = M.inception_v3(num_classes=10)
+        outs = iv(jnp.zeros((1, 3, 299, 299)))
+        assert len(outs) == 2 and outs[0].shape == (1, 10)
+        n = sum(int(np.prod(p.shape)) for _, p in iv.named_parameters())
+        assert abs(n - 24_371_444) < 5_000, n
+        iv.eval()
+        assert iv(jnp.zeros((1, 3, 299, 299))).shape == (1, 10)
+
     def test_datasets_exist(self):
         from paddle_tpu.vision import datasets as DS
 
