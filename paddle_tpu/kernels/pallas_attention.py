@@ -601,6 +601,10 @@ def _fold(q, k, v, segment_ids, q_block, k_block):
         blk = min(blk, sl)
         while blk > 128 and sl % blk:
             blk //= 2
+        if sl % blk:
+            # requested block shares no power-of-two divisor with the
+            # seq (e.g. 768 vs 2048) — fall back to the universal 128
+            blk = 128
         return blk
 
     qb = _fit(q_block, sq)
