@@ -395,15 +395,114 @@ def gather(tensor, gather_list=None, dst: int = 0, group=None, mesh=None):
     return chunks
 
 
+def alltoall_single_in(x, send_sizes, axis: str = "ep",
+                       slot_rows: Optional[int] = None):
+    """Ragged all-to-all, in-jit form (call under ``shard_map``).
+
+    Parity: the variable-split ``alltoall_single`` / NCCL alltoallv
+    (upstream python/paddle/distributed/communication/all_to_all.py).
+    TPU-native: XLA collectives are static-shaped, so each destination's
+    ragged segment is packed into a fixed slot of ``slot_rows`` rows and
+    exchanged with ONE dense ``lax.all_to_all`` over the ICI ring
+    (``lax.ragged_all_to_all`` would send only filled prefixes, but
+    XLA:CPU has no kernel for it and CI runs on the CPU mesh).
+
+    x: [n, ...] local rows sorted so rows destined for rank d form the
+    d-th contiguous segment; ``send_sizes``: int32 [nranks] segment
+    lengths (sum <= n, traced values allowed). Returns
+    ``(recv, recv_sizes)`` where ``recv`` is [nranks, slot_rows, ...]
+    (source-major; row s holds rank s's segment for this rank, zero
+    padded) and ``recv_sizes`` is int32 [nranks].
+    """
+    n = x.shape[0]
+    slot_rows = slot_rows or n
+    send_sizes = send_sizes.astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_sizes)[:-1]])
+    slot = jnp.arange(slot_rows, dtype=jnp.int32)
+    src_idx = offsets[:, None] + slot[None, :]
+    valid = slot[None, :] < send_sizes[:, None]
+    valid = valid.reshape(valid.shape + (1,) * (x.ndim - 1))
+    send_buf = jnp.where(
+        valid, x[jnp.clip(src_idx, 0, max(n - 1, 0))],
+        jnp.zeros((), x.dtype))
+    recv = jax.lax.all_to_all(send_buf, axis, 0, 0)
+    recv_sizes = jax.lax.all_to_all(send_sizes, axis, 0, 0, tiled=True)
+    return recv, recv_sizes
+
+
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, mesh=None):
-    """Equal-split all-to-all on dim 0 (paddle alltoall_single with
-    uniform splits; ragged splits need the MoE dispatch path)."""
-    if in_split_sizes or out_split_sizes:
-        raise NotImplementedError(
-            "alltoall_single: ragged splits — use distributed.moe's "
-            "sort-based dispatch for variable-size exchange")
-    return alltoall(in_tensor, group=group, mesh=mesh)
+    """All-to-all on dim 0 (paddle ``alltoall_single``), uniform or
+    ragged splits.
+
+    Uniform (no split sizes): ``in_tensor`` is the global array; rank
+    r's chunk j goes to rank j; returns the transposed global array.
+
+    Ragged: ``in_split_sizes`` is either one row of ``nranks`` ints
+    (every rank sends the same split pattern) or an ``[nranks][nranks]``
+    matrix whose row r is rank r's split list (the single-controller
+    SPMD form of the reference's per-process argument). Each row must
+    sum to the per-rank local length. Per-rank outputs generally have
+    different lengths, so the ragged form returns a LIST of per-rank
+    arrays (rank r's = the reference's ``out_tensor`` on process r);
+    ``out_split_sizes`` is validated against the transpose if given.
+    """
+    if in_split_sizes is None and out_split_sizes is None:
+        return alltoall(in_tensor, group=group, mesh=mesh)
+    import numpy as np
+
+    mesh = mesh or _active_mesh()
+    axis = _group_axis(group)
+    n = mesh.shape[axis]
+    if in_split_sizes is None:
+        # only out_split_sizes given: infer sends from the transpose
+        outs = np.asarray(out_split_sizes, dtype=np.int32)
+        if outs.ndim == 1:
+            outs = np.tile(outs, (n, 1))
+        in_split_sizes, out_split_sizes = outs.T, None
+    splits = np.asarray(in_split_sizes, dtype=np.int32)
+    if splits.ndim == 1:
+        splits = np.tile(splits, (n, 1))
+    if splits.shape != (n, n):
+        raise ValueError(
+            f"alltoall_single: in_split_sizes must be [{n}] or "
+            f"[{n}][{n}], got shape {tuple(splits.shape)}")
+    n_loc = in_tensor.shape[0] // n
+    row_sums = splits.sum(axis=1)
+    if not (row_sums == n_loc).all():
+        raise ValueError(
+            f"alltoall_single: each rank's in_split_sizes must sum to "
+            f"its local length {n_loc}, got {row_sums.tolist()}")
+    if out_split_sizes is not None:
+        outs = np.asarray(out_split_sizes, dtype=np.int32)
+        if outs.ndim == 1:
+            outs = np.tile(outs, (n, 1))
+        if not (outs == splits.T).all():
+            raise ValueError(
+                "alltoall_single: out_split_sizes must be the transpose "
+                "of in_split_sizes")
+    slot_rows = max(int(splits.max()), 1)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False,
+    )
+    def f(x_loc, sizes_loc):
+        recv, recv_sizes = alltoall_single_in(
+            x_loc, sizes_loc[0], axis=axis, slot_rows=slot_rows)
+        return recv[None], recv_sizes[None]
+
+    recv, recv_sizes = f(in_tensor, jnp.asarray(splits))
+    recv = jax.device_get(recv)            # [n, n, slot_rows, ...]
+    out = [
+        jnp.concatenate(
+            [recv[r, s, : int(splits[s, r])] for s in range(n)], axis=0)
+        for r in range(n)
+    ]
+    if out_tensor is not None and isinstance(out_tensor, list):
+        out_tensor.extend(out)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -299,13 +299,12 @@ def dropless_moe_ep_apply(xf, gate_weight, w1, b1, w2, b2, act, top_k,
         input_offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_sizes)[:-1]])
 
-        # pack destination segments into static per-source slots
-        slot = jnp.arange(n)
-        src_idx = input_offsets[:, None] + slot[None, :]
-        valid = slot[None, :] < send_sizes[:, None]
-        send_buf = jnp.where(
-            valid[..., None], xs[jnp.clip(src_idx, 0, n - 1)], 0.0)
-        recv_buf = lax.all_to_all(send_buf, ep_axis, 0, 0)   # [ep, n, m]
+        # ragged exchange: destination segments pack into static
+        # per-source slots (the public collective owns this machinery)
+        from .collective import alltoall_single_in
+
+        recv_buf, _ = alltoall_single_in(
+            xs, send_sizes, axis=ep_axis, slot_rows=n)       # [ep, n, m]
         cmat = lax.all_to_all(                               # [ep, e_loc]
             counts.reshape(ep, e_loc), ep_axis, 0, 0)
 

@@ -122,9 +122,35 @@ def test_collectives_extended():
     a2a = dist.alltoall_single(jnp.arange(64.0), mesh=mesh, group="dp")
     ref_a2a = np.arange(64.0).reshape(8, 8).T.reshape(-1)
     np.testing.assert_allclose(np.asarray(a2a), ref_a2a)
-    with pytest.raises(NotImplementedError):
-        dist.alltoall_single(jnp.arange(64.0), in_split_sizes=[1] * 8,
+    # ragged alltoall_single: per-rank split matrix (row r = rank r's
+    # in_split_sizes); verify against a numpy alltoallv reference
+    rng = np.random.default_rng(3)
+    n, n_loc = 8, 8
+    splits = np.zeros((n, n), np.int32)
+    for r in range(n):
+        cuts = np.sort(rng.integers(0, n_loc + 1, n - 1))
+        row = np.diff(np.concatenate([[0], cuts, [n_loc]]))
+        splits[r] = row
+    data = np.arange(n * n_loc, dtype=np.float64)
+    out = dist.alltoall_single(
+        jnp.asarray(data), in_split_sizes=splits,
+        out_split_sizes=splits.T, mesh=mesh, group="dp")
+    offs = np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(splits, 1)], 1)
+    for r in range(n):
+        expect = np.concatenate(
+            [data[s * n_loc + offs[s, r]: s * n_loc + offs[s, r + 1]]
+             for s in range(n)])
+        np.testing.assert_allclose(np.asarray(out[r]), expect)
+
+    # ragged validation errors
+    with pytest.raises(ValueError):
+        dist.alltoall_single(jnp.arange(64.0), in_split_sizes=[9] * 8,
                              mesh=mesh, group="dp")
+    with pytest.raises(ValueError):
+        dist.alltoall_single(
+            jnp.arange(64.0), in_split_sizes=[1] * 8,
+            out_split_sizes=np.full((8, 8), 2), mesh=mesh, group="dp")
 
     # groups: axis binding and subgroup matching
     g = dist.new_group(axis="dp")
