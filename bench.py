@@ -65,26 +65,6 @@ def probe_tpu():
     return False, diags
 
 
-PEAK_BF16_FLOPS = {
-    # device_kind → peak bf16 FLOP/s per chip (public spec sheets)
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_BF16_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return {"tpu": 197e12, "cpu": 1e12}.get(device.platform, 197e12)
-
-
 def _llama_cfg(platform):
     import os
 
@@ -137,6 +117,13 @@ def bench_llama_train(tpu_diags):
     import numpy as np
 
     import paddle_tpu as pt
+    from benchmarks.devtime import (
+        check_plausible,
+        compiled_flops,
+        fetch_sync,
+        peak_flops,
+        traced_step_ms,
+    )
     from paddle_tpu import distributed as dist, optimizer as opt
     from paddle_tpu.distributed.strategy import (
         DistributedStrategy,
@@ -178,25 +165,41 @@ def bench_llama_train(tpu_diags):
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
     data = {"input_ids": ids, "labels": ids}
 
-    # warmup / compile
-    ts.run(data).block_until_ready()
-    ts.run(data).block_until_ready()
+    # warmup / compile, with a REAL completion fetch (block_until_ready
+    # can return early through the tunnel — round-4 postmortem)
+    fetch_sync(ts.run(data))
+    fetch_sync(ts.run(data))
 
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(iters):
-        loss = ts.run(data)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    # device-time-true step time: N steps inside a profiler trace; the
+    # reported throughput comes from the trace's device plane, never
+    # from wall clock through the tunnel
+    n_steps = min(iters, 5) if platform == "tpu" else 2
+    timing = traced_step_ms(lambda: ts.run(data), n_steps=n_steps)
+    loss = ts.run(data)
 
-    tokens_per_sec = batch * seq * iters / dt
-    tokens_per_sec_chip = tokens_per_sec / n
+    step_s = timing.step_ms / 1e3
+    tokens_per_sec_chip = batch * seq / step_s / n
 
-    # MFU: 6*N_params*tokens/sec vs the DETECTED chip's peak bf16 flops
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    model_flops = 6 * n_params * tokens_per_sec_chip
-    peak = _peak_flops(devices[0])
-    mfu = model_flops / peak
+    peak = peak_flops(devices[0])
+    # MFU denominator: XLA's own cost analysis of the compiled step
+    # (includes attention + remat); fall back to the 6*N*T estimate
+    # (per-chip: global-batch tokens divided over n chips, matching
+    # the per-device step time the guard compares against)
+    flops = compiled_flops(ts.lower(data))
+    flops_src = "xla_cost_analysis"
+    if flops is None:
+        flops = 6.0 * n_params * batch * seq / n
+        flops_src = "6NT_estimate"
+    plaus = check_plausible(flops, timing.step_ms, devices[0])
+    mfu = plaus.get("mfu_est")
+    if platform == "tpu" and timing.device_step_ms is None:
+        # wall clock through the tunnel is not a throughput basis
+        plaus = {"implausible": True, "mfu_est": None,
+                 "reason": "profiler trace carried no device plane; "
+                           "tunnel wall-clock refused as a throughput "
+                           "basis"}
+        mfu = None
 
     vs = 1.0
 
@@ -209,41 +212,46 @@ def bench_llama_train(tpu_diags):
         "batch": batch,
         "seq": seq,
         "remat": cfg.use_recompute,
-        "step_ms": round(1000 * dt / iters, 2),
-        "mfu_est": round(mfu, 4),
+        "step_ms": round(timing.step_ms, 2),
+        "device_step_ms": (round(timing.device_step_ms, 2)
+                           if timing.device_step_ms else None),
+        "wall_step_ms": round(timing.wall_step_ms, 2),
+        "timed_steps": timing.n_steps,
+        "flops_per_step": flops,
+        "flops_source": flops_src,
+        "mfu_est": mfu,
         "loss": float(loss),
     }
-    if platform == "tpu":
-        # one profiled step → per-op device-time attribution for the MFU
-        # number (matmul vs collective vs copy); best-effort
-        try:
-            import tempfile
-
-            from paddle_tpu.profiler import xplane
-
-            tracedir = tempfile.mkdtemp(prefix="bench_trace_")
-            jax.profiler.start_trace(tracedir)
-            ts.run(data).block_until_ready()
-            jax.profiler.stop_trace()
-            ops = xplane.device_op_summary(tracedir)
-            if ops is not None and ops.rows:
-                total = ops.total_ms
-                extra["op_summary"] = {
-                    "total_device_ms": round(total, 2),
-                    "categories": {
-                        k: round(100.0 * v / total, 1)
-                        for k, v in ops.by_category().items()
-                    },
-                    "top_ops": [
-                        {"name": r.name[:60], "ms": round(r.total_ms, 2),
-                         "count": r.count}
-                        for r in ops.rows[:8]
-                    ],
-                }
-        except Exception as e:
-            extra["op_summary"] = {"error": repr(e)}
+    if timing.op_summary is not None and timing.op_summary.rows:
+        ops = timing.op_summary
+        total = ops.total_ms
+        extra["op_summary"] = {
+            "total_device_ms": round(total, 2),
+            "timed_steps": timing.n_steps,
+            "categories": {
+                k: round(100.0 * v / total, 1)
+                for k, v in ops.by_category().items()
+            },
+            "top_ops": [
+                {"name": r.name[:60], "ms": round(r.total_ms, 2),
+                 "count": r.count}
+                for r in ops.rows[:8]
+            ],
+        }
     if tpu_diags:
         extra["tpu_probe"] = tpu_diags
+    if plaus.get("implausible"):
+        # computed FLOP/s above chip peak: refuse to report (round-4
+        # lesson — 4 of 5 secondary numbers were dispatch-time artifacts)
+        extra["refused_value"] = round(tokens_per_sec_chip, 1)
+        extra["error"] = plaus.get("reason")
+        return {
+            "metric": "llama_train_implausible",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
     name = (f"llama{n_params // 10**6}m_train_tokens_per_sec_per_chip"
             if platform == "tpu"
             else "llama_train_cpu_smoke_tokens_per_sec")
@@ -291,7 +299,8 @@ def _compact_line(result):
     extra = result.get("extra", {}) or {}
     keep = {k: extra[k] for k in
             ("platform", "n_chips", "device_kind", "params", "batch",
-             "seq", "remat", "step_ms", "mfu_est", "loss") if k in extra}
+             "seq", "remat", "step_ms", "device_step_ms", "mfu_est",
+             "loss") if k in extra}
     if result.get("unit") == "error":
         keep["error"] = _err_msg(extra)
     if details_error:

@@ -4,6 +4,17 @@ SD-UNet, Mamba, and decode/TTFT inference.
 Each ``run_config(name)`` returns the same one-line JSON dict shape as
 the headline llama bench. Sizes scale by platform: real configs on TPU,
 smoke configs on CPU (so the suite is runnable anywhere, rc=0 always).
+
+Timing discipline (round 5): every THROUGHPUT number is derived from
+profiler DEVICE time (``benchmarks/devtime.py``), never from wall clock
+through the remote tunnel — wall clock produced 4 physically-impossible
+numbers in round 4 (dispatch was measured, not execution). A hard
+plausibility guard refuses any result whose computed FLOP/s exceeds 95%
+of chip peak. Exception: ``bench_infer``'s TTFT is a client-observed
+LATENCY, which is wall-clock by definition — in this sandbox it
+includes the remote tunnel's per-dispatch RTT (~10-90ms), recorded in
+the result's ``latency_basis`` note so the numbers aren't mistaken for
+on-host serving latency.
 """
 
 from __future__ import annotations
@@ -13,6 +24,13 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from benchmarks.devtime import (
+    check_plausible,
+    compiled_flops,
+    fetch_sync,
+    traced_step_ms,
+)
 
 
 def _platform():
@@ -24,6 +42,17 @@ def _result(metric, value, unit, extra, tpu_diags):
         extra["tpu_probe"] = tpu_diags
     extra["platform"] = _platform()
     extra["n_chips"] = len(jax.devices())
+    if extra.pop("implausible", False):
+        # measurement artifact — refuse to report it as a result, but
+        # keep the refused value for diagnosis (mirrors the headline)
+        extra["refused_value"] = round(float(value), 2)
+        return {
+            "metric": metric + "_implausible",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
     return {
         "metric": metric,
         "value": round(float(value), 2),
@@ -33,8 +62,12 @@ def _result(metric, value, unit, extra, tpu_diags):
     }
 
 
-def _train_throughput(model, data, loss_fn=None, iters=None, unit_count=0):
-    """Shared train-step timing harness → (per-sec rate, step_ms, loss)."""
+def _train_throughput(model, data, loss_fn=None, unit_count=0):
+    """Shared train-step timing harness.
+
+    -> (per-sec rate from DEVICE step time, extra-dict with
+    device/wall step ms, XLA-cost-analysis FLOPs, mfu_est, and the
+    plausibility verdict)."""
     import paddle_tpu as pt
     from paddle_tpu import distributed as dist, optimizer as opt
     from paddle_tpu.trainer import TrainStep
@@ -43,29 +76,46 @@ def _train_throughput(model, data, loss_fn=None, iters=None, unit_count=0):
     ts = TrainStep(model, opt.AdamW(1e-4, multi_precision=False), mesh,
                    loss_fn=loss_fn)
     tpu = _platform() == "tpu"
-    iters = iters or (10 if tpu else 2)
-    ts.run(data).block_until_ready()
-    ts.run(data).block_until_ready()
-    # tiny configs (3-16ms steps) are dispatch-noise dominated through
-    # the remote tunnel at 10 iterations — keep timing in chunks until
-    # the window is long enough for wall/iters to mean device throughput
-    min_window = 1.5 if tpu else 0.0
-    t0 = time.perf_counter()
-    n = 0
-    loss = None
-    while True:
-        for _ in range(iters):
-            loss = ts.run(data)
-        loss.block_until_ready()
-        n += iters
-        dt = time.perf_counter() - t0
-        if dt >= min_window or n >= 2000:
-            break
-        # grow the dispatch chunk so the remaining window costs ~2 more
-        # blocking roundtrips, not hundreds (tunnel dispatch latency)
-        iters = max(iters, min(1000, int((min_window - dt) / max(
-            dt / n, 1e-4) / 2) + 1))
-    return unit_count * n / dt, 1000 * dt / n, float(loss)
+    # warmup / compile, with a real completion fetch
+    fetch_sync(ts.run(data))
+    loss = ts.run(data)
+    fetch_sync(loss)
+
+    # phase 1: short trace to learn the true device step time
+    timing = traced_step_ms(lambda: ts.run(data), n_steps=3)
+    # phase 2: if the traced window is too short for stable numbers,
+    # re-trace with enough steps for ~0.4s of device time
+    if tpu and timing.device_step_ms and timing.device_step_ms * 3 < 200:
+        n = min(100, max(5, int(400 / timing.device_step_ms)))
+        timing = traced_step_ms(lambda: ts.run(data), n_steps=n)
+
+    flops = compiled_flops(ts.lower(data))
+    plaus = check_plausible(flops, timing.step_ms)
+    if tpu and timing.device_step_ms is None:
+        # no device plane in the trace: wall clock through the tunnel
+        # is NOT an acceptable substitute — refuse rather than publish
+        plaus = {"implausible": True, "mfu_est": None,
+                 "reason": "profiler trace carried no device plane; "
+                           "tunnel wall-clock refused as a throughput "
+                           "basis"}
+
+    rate = unit_count / (timing.step_ms / 1e3)
+    extra = {
+        "step_ms": round(timing.step_ms, 3),
+        "device_step_ms": (round(timing.device_step_ms, 3)
+                           if timing.device_step_ms else None),
+        "wall_step_ms": round(timing.wall_step_ms, 3),
+        "timed_steps": timing.n_steps,
+        "flops_per_step": flops,
+        "loss": float(loss),
+        **plaus,
+    }
+    if timing.op_summary is not None and timing.op_summary.rows:
+        total = timing.op_summary.total_ms
+        extra["device_categories"] = {
+            k: round(100.0 * v / total, 1)
+            for k, v in timing.op_summary.by_category().items()}
+    return rate, extra
 
 
 def bench_moe(tpu_diags):
@@ -91,11 +141,11 @@ def bench_moe(tpu_diags):
     model = ErnieMoEForCausalLM(cfg)
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)))
-    rate, step_ms, loss = _train_throughput(
+    rate, extra = _train_throughput(
         model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
+    extra["experts"] = cfg.num_experts
     return _result("ernie_moe_train_tokens_per_sec", rate, "tokens/s",
-                   {"step_ms": round(step_ms, 2), "loss": loss,
-                    "experts": cfg.num_experts}, tpu_diags)
+                   extra, tpu_diags)
 
 
 def bench_vit(tpu_diags):
@@ -117,11 +167,11 @@ def bench_vit(tpu_diags):
     def loss_fn(logits, label):
         return F.cross_entropy(logits, label).mean()
 
-    rate, step_ms, loss = _train_throughput(
+    rate, extra = _train_throughput(
         model, {"input": imgs, "label": labels}, loss_fn=loss_fn,
         unit_count=batch)
     return _result("vit_l_train_images_per_sec", rate, "images/s",
-                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+                   extra, tpu_diags)
 
 
 def bench_unet(tpu_diags):
@@ -156,9 +206,9 @@ def bench_unet(tpu_diags):
 
     wrap = _Wrap()
     data = {"sample": x, "timestep": t, "context": ctx, "target": x}
-    rate, step_ms, loss = _train_throughput(wrap, data, unit_count=batch)
+    rate, extra = _train_throughput(wrap, data, unit_count=batch)
     return _result("sd_unet_train_samples_per_sec", rate, "samples/s",
-                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+                   extra, tpu_diags)
 
 
 def bench_mamba(tpu_diags):
@@ -175,10 +225,10 @@ def bench_mamba(tpu_diags):
     model = MambaForCausalLM(cfg)
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)))
-    rate, step_ms, loss = _train_throughput(
+    rate, extra = _train_throughput(
         model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
     return _result("mamba_train_tokens_per_sec", rate, "tokens/s",
-                   {"step_ms": round(step_ms, 2), "loss": loss}, tpu_diags)
+                   extra, tpu_diags)
 
 
 def bench_infer(tpu_diags):
@@ -269,7 +319,8 @@ def bench_infer(tpu_diags):
     unloaded = eng._finished[r0].ttft_ms
     return _result(
         "infer_p50_ttft_ms", float(np.percentile(ttfts, 50)), "ms",
-        {"p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+        {"latency_basis": "client wall-clock incl. tunnel dispatch RTT",
+         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
          "unloaded_ttft_ms": round(unloaded, 2) if unloaded else None,
          "served_tokens_per_sec": round(served_tps, 1),
          "n_requests": len(reqs), "prompt_len": prompt_len,
