@@ -93,6 +93,7 @@ class OpRow:
 class DeviceOpSummary:
     plane: str
     rows: List[OpRow] = field(default_factory=list)
+    n_planes: int = 1  # device planes aggregated (chips in the trace)
 
     @property
     def total_ms(self) -> float:
@@ -187,7 +188,8 @@ def device_op_summary(log_dir: str, top: int = 0
     if top:
         rows = rows[:top]
     plane = ", ".join(sorted(pids[p] for p in dev_pids))
-    return DeviceOpSummary(plane=plane, rows=rows)
+    return DeviceOpSummary(plane=plane, rows=rows,
+                           n_planes=len(dev_pids))
 
 
 def format_summary(s: DeviceOpSummary, top: int = 20) -> str:
