@@ -26,11 +26,108 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUTS = (240, 120)  # seconds per attempt; first covers cold init
+# Per-attempt timeouts (first covers cold PJRT init) and the total
+# window over which the tunnel is retried before the CPU fallback.
+# Round-4 lesson: one-shot probes lost two consecutive driver captures
+# to transient tunnel outages — the retry discipline must live in the
+# tool, not in session lore.
+PROBE_ATTEMPT_TIMEOUTS = (240, 120)
+PROBE_WINDOW_S = float(os.environ.get("BENCH_PROBE_WINDOW_S", "600"))
+# marker argv appended to probe children so an orphaned hung probe is
+# recognizable to the reaper (python -c ignores extra argv)
+PROBE_MARK = "--paddle-tpu-bench-probe"
+
+
+def _stale_chip_holders():
+    """Orphaned python processes from a previous crashed bench/entry run.
+    libtpu is single-process-exclusive: a leftover child that still holds
+    the TPU client makes every later probe fail until it dies."""
+    holders = []
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return holders
+    for pid in pids:
+        if int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        # argv[0] must BE a python interpreter — a shell/driver whose
+        # command *string* merely mentions bench.py must never match
+        exe = os.path.basename(argv[0].decode("utf-8", "replace"))
+        if not exe.startswith("python"):
+            continue
+        cmd = " ".join(a.decode("utf-8", "replace") for a in argv if a)
+        # conservative: only reap processes that were orphaned (their
+        # launching bench/driver is gone) AND are recognizably ours
+        if ppid == 1 and ("bench.py" in cmd or "__graft_entry__" in cmd
+                          or PROBE_MARK in cmd):
+            holders.append((int(pid), cmd.strip()[:120]))
+    return holders
+
+
+def _proc_cpu_jiffies(pid):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        return int(parts[11]) + int(parts[12])  # utime + stime
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _reap_stale_holders(diags):
+    """Kill matched orphans — but only ones that are IDLE (no CPU over a
+    sample window). A wedged holder is blocked on a dead tunnel socket
+    and burns no CPU; a healthy daemonized benchmark that happens to be
+    orphaned (nohup) keeps accumulating jiffies and is left alone."""
+    import signal
+
+    candidates = _stale_chip_holders()
+    if not candidates:
+        return
+    # a candidate with a live CHILD is a supervisor (e.g. a nohup'd
+    # bench.py blocked in subprocess.run — 0 CPU but healthy); the chip
+    # holder in that tree is the child, whose parent is alive, so it
+    # never matches the orphan rule. Only childless orphans are reapable.
+    with_children = set()
+    try:
+        for pid in os.listdir("/proc"):
+            if pid.isdigit():
+                try:
+                    with open(f"/proc/{pid}/stat") as f:
+                        with_children.add(
+                            int(f.read().rsplit(")", 1)[1].split()[1]))
+                except (OSError, IndexError, ValueError):
+                    pass
+    except OSError:
+        pass
+    before = {pid: _proc_cpu_jiffies(pid) for pid, _ in candidates}
+    time.sleep(1.5)
+    for pid, cmd in candidates:
+        if pid in with_children:
+            diags.append({"spared_supervisor_pid": pid, "cmd": cmd})
+            continue
+        b, a = before.get(pid), _proc_cpu_jiffies(pid)
+        if b is None or a is None:  # already gone
+            continue
+        if a > b:
+            diags.append({"spared_live_pid": pid, "cmd": cmd})
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            diags.append({"reaped_stale_pid": pid, "cmd": cmd})
+        except OSError:
+            pass
 
 
 def probe_tpu():
-    """Try to bring up the TPU backend in a killable child. Returns
+    """Bring up the TPU backend in a killable child, retrying over a
+    bounded window (stale-holder reaping between attempts). Returns
     (ok, diagnostics)."""
     code = (
         "import jax; ds = jax.devices(); "
@@ -40,11 +137,19 @@ def probe_tpu():
         "print('PROBE_OK', len(ds), ds[0].platform)"
     )
     diags = []
-    for attempt, tmo in enumerate(PROBE_TIMEOUTS):
+    deadline = time.time() + PROBE_WINDOW_S
+    # reap BEFORE the first attempt too: if a crashed run left a wedged
+    # holder, attempt 0 would otherwise burn its full cold-init timeout
+    _reap_stale_holders(diags)
+    attempt = 0
+    while True:
+        tmo = PROBE_ATTEMPT_TIMEOUTS[
+            min(attempt, len(PROBE_ATTEMPT_TIMEOUTS) - 1)]
+        tmo = min(tmo, max(30, deadline - time.time()))
         t0 = time.time()
         try:
             r = subprocess.run(
-                [sys.executable, "-c", code],
+                [sys.executable, "-c", code, PROBE_MARK],
                 capture_output=True, text=True, timeout=tmo,
             )
             if r.returncode == 0 and "PROBE_OK" in r.stdout:
@@ -60,8 +165,14 @@ def probe_tpu():
                 "elapsed_s": round(time.time() - t0, 1),
                 "stderr_tail": f"probe hung > {tmo}s (PJRT init stall)",
             })
-        if attempt < len(PROBE_TIMEOUTS) - 1:
-            time.sleep(5 * (attempt + 1))
+        attempt += 1
+        if time.time() + 35 >= deadline:
+            break
+        _reap_stale_holders(diags)
+        time.sleep(min(15.0, 5.0 * attempt))
+    # keep the diagnostics bounded for the JSON line / details file
+    if len(diags) > 8:
+        diags = diags[:2] + [{"elided_attempts": len(diags) - 4}] + diags[-2:]
     return False, diags
 
 
@@ -160,7 +271,15 @@ def bench_llama_train(tpu_diags):
     else:
         mesh = dist.build_mesh(devices=devices)
 
-    ts = TrainStep(model, optimizer, mesh, strategy)
+    # master_only drops the persistent bf16 param copies (the fp32
+    # master is the single resident form; compute views are cast in-step)
+    # — saves 2 B/param ≈ 1.75 GB on the 876M headline, bit-identical
+    # numerics. That headroom is what admits batch 6.
+    residency = os.environ.get(
+        "BENCH_RESIDENCY",
+        "master_only" if cfg.dtype == "bfloat16" else "paired")
+    ts = TrainStep(model, optimizer, mesh, strategy,
+                   master_residency=residency)
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
     data = {"input_ids": ids, "labels": ids}
@@ -212,6 +331,7 @@ def bench_llama_train(tpu_diags):
         "batch": batch,
         "seq": seq,
         "remat": cfg.use_recompute,
+        "residency": residency,
         "step_ms": round(timing.step_ms, 2),
         "device_step_ms": (round(timing.device_step_ms, 2)
                            if timing.device_step_ms else None),

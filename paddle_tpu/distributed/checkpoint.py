@@ -69,7 +69,16 @@ def _snapshot_to_host(state_dict: Dict[str, jax.Array]):
     """
     snap = {}
     for name, arr in state_dict.items():
-        arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
+        if not isinstance(arr, jax.Array):
+            # host-local leaf (e.g. the TrainStep step counter): every
+            # process holds an identical copy with no replica topology,
+            # so only rank 0 may write it — otherwise all ranks race on
+            # the same chunk file
+            arr = np.asarray(arr)
+            chunks = ([(tuple(0 for _ in arr.shape), arr)]
+                      if jax.process_index() == 0 else [])
+            snap[name] = (list(arr.shape), str(arr.dtype), chunks)
+            continue
         chunks = []
         seen_offsets = set()
         for shard in arr.addressable_shards:
@@ -230,10 +239,51 @@ def _recover(path: str) -> None:
         os.rename(old, path)
 
 
+_NEST_SEP = "//"
+_EMPTY_DICT_LEAF = "__empty_dict__"
+
+
+def _flatten_nested(d, prefix="", keep_empty=True):
+    """Flatten nested dicts to {"a//b//c": leaf}. Leaf = anything that is
+    not a dict; scalars (the TrainStep step counter) become 0-d arrays at
+    snapshot time. ``//`` cannot collide with parameter names (paddle
+    names use ``.``; module paths never contain ``//``). Empty subtrees
+    (SGD's slot dicts, an fp32 model's master dict) are kept via a
+    marker leaf so the restored pytree structure matches exactly —
+    ``keep_empty=False`` on lookup-only flattens (load target/shardings),
+    where a synthesized marker array would be mistaken for a Sharding."""
+    flat = {}
+    for k, v in d.items():
+        key = f"{prefix}{_NEST_SEP}{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            if v:
+                flat.update(_flatten_nested(v, key, keep_empty))
+            elif keep_empty:
+                flat[f"{key}{_NEST_SEP}{_EMPTY_DICT_LEAF}"] = np.zeros(
+                    (), np.int8)
+        else:
+            flat[key] = v
+    return flat
+
+
+def _unflatten_nested(flat):
+    out = {}
+    for key, v in flat.items():
+        parts = key.split(_NEST_SEP)
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        if parts[-1] != _EMPTY_DICT_LEAF:
+            cur[parts[-1]] = v
+    return out
+
+
 def save_state_dict(state_dict: Dict[str, jax.Array], path: str) -> None:
-    """Atomically save a flat {name: jax.Array} dict (values may be
-    sharded global arrays). Blocks until the checkpoint is committed."""
-    snap = _snapshot_to_host(state_dict)
+    """Atomically save a {name: jax.Array} dict (values may be sharded
+    global arrays; nested dicts — e.g. a whole TrainStep.state_dict() —
+    are flattened transparently). Blocks until the checkpoint is
+    committed."""
+    snap = _snapshot_to_host(_flatten_nested(state_dict))
     tmp_path = path + ".tmp"
     if jax.process_index() == 0:
         _recover(path)
@@ -270,7 +320,8 @@ class AsyncCheckpointer:
 
     def save(self, state_dict: Dict[str, jax.Array], path: str) -> None:
         self.wait_until_finished()
-        snap = _snapshot_to_host(state_dict)  # the only blocking part
+        # the snapshot is the only blocking part
+        snap = _snapshot_to_host(_flatten_nested(state_dict))
         tmp_path = path + ".tmp"
         if jax.process_index() == 0:
             _recover(path)
@@ -389,9 +440,16 @@ def load_state_dict(
 
     ``target``: {name: existing array} — layouts (shardings) are taken
     from it. Or pass ``shardings`` {name: Sharding} directly. With
-    neither, arrays load replicated on the default device.
+    neither, arrays load replicated on the default device. Nested dicts
+    (saved from e.g. TrainStep.state_dict()) round-trip: target/shardings
+    may be nested the same way, and the result is re-nested.
     """
     import jax.numpy as jnp
+
+    if target is not None:
+        target = _flatten_nested(target, keep_empty=False)
+    if shardings is not None:
+        shardings = _flatten_nested(shardings, keep_empty=False)
 
     # is_committed lets rank 0 heal any crashed-commit state; the
     # barrier keeps the other ranks from racing the rename on a shared
@@ -415,7 +473,8 @@ def load_state_dict(
         if shardings and name in shardings:
             sharding = shardings[name]
         elif target is not None and name in target:
-            sharding = target[name].sharding
+            # scalar leaves (the step counter) have no sharding
+            sharding = getattr(target[name], "sharding", None)
         if sharding is None:
             full = reader.read_slice(
                 tuple(slice(0, s) for s in shape)
@@ -427,6 +486,8 @@ def load_state_dict(
                 lambda idx, r=reader, dt=dtype: r.read_slice(idx).astype(dt),
             )
             out[name] = arr
+    if any(_NEST_SEP in name for name in out):
+        return _unflatten_nested(out)
     return out
 
 

@@ -16,6 +16,7 @@ from .optimizer import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    Lars,
     Momentum,
     NAdam,
     Optimizer,
@@ -26,7 +27,7 @@ from .optimizer import (  # noqa: F401
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Lamb",
-    "RMSProp", "Adamax", "Adadelta", "NAdam", "RAdam", "ASGD", "Rprop",
+    "Lars", "RMSProp", "Adamax", "Adadelta", "NAdam", "RAdam", "ASGD", "Rprop",
     "LBFGS",
     "lr", "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
 ]

@@ -88,13 +88,27 @@ class TrainStep:
         donate: bool = True,
         rng_seed: int = 0,
         abstract: bool = False,
+        master_residency: str = "paired",
     ):
         """``abstract=True`` builds the full sharded step WITHOUT
         materializing parameters or optimizer state — params may be
         ``jax.ShapeDtypeStruct`` (core.meta.meta_init). Use ``lower()``
         for AOT compilation / per-device memory planning of configs far
         larger than host memory (the 70B north-star path); ``run()`` is
-        unavailable."""
+        unavailable.
+
+        ``master_residency``: ``"paired"`` (default) keeps params at
+        model dtype alongside fp32 masters in optimizer state — the
+        classic layout. ``"master_only"`` drops the persistent
+        low-precision copies: the fp32 master is the ONLY resident form
+        of each bf16/fp16 parameter, and the compute-dtype view is cast
+        transiently inside the step. Numerics are bit-identical to
+        "paired" (the stored bf16 param is exactly cast(master) after
+        every update), but steady HBM residency shrinks by
+        itemsize(model_dtype) bytes/param — ~1.75 GB on the 876M
+        headline — which is what buys the larger batch (parity intent:
+        fleet GroupShardedOptimizerStage2 master-weight handling, which
+        likewise keeps one authoritative fp32 copy)."""
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -102,6 +116,9 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.batch_seq_axis = batch_seq_axis
         self.abstract = abstract
+
+        self.master_residency = master_residency
+        self._master_dtypes: Dict[str, jnp.dtype] = {}
 
         self._param_objs = extract_param_objs(model, trainable_only=True)
         self.param_shardings = _param_shardings(
@@ -138,6 +155,39 @@ class TrainStep:
             # otherwise leave Parameters referencing deleted arrays
             self.sync_to_model()
 
+        # master-only residency: the fp32 master in optimizer state is
+        # the single persistent copy; drop the model-dtype duplicates
+        # from the step's carried params
+        if master_residency not in ("paired", "master_only"):
+            raise ValueError(
+                f"master_residency must be 'paired' or 'master_only', "
+                f"got {master_residency!r}")
+        master_names = set(state_shape.get("master", {}))
+        if master_residency == "master_only" and not master_names:
+            raise ValueError(
+                "master_residency='master_only' needs fp32 masters: use "
+                "an optimizer with multi_precision=True and bf16/fp16 "
+                "parameters")
+        if master_residency == "master_only":
+            self._master_dtypes = {
+                n: self.params[n].dtype for n in master_names
+            }
+            for n in master_names:
+                # release the Layer tree's reference too, or the bf16
+                # device buffer stays alive and nothing is saved; the
+                # Parameter holds a meta struct until sync_to_model()
+                v = self.params[n]
+                if not isinstance(v, jax.ShapeDtypeStruct):
+                    self._param_objs[n].value = jax.ShapeDtypeStruct(
+                        tuple(v.shape), v.dtype)
+            self.params = {n: v for n, v in self.params.items()
+                           if n not in master_names}
+        carried_param_shardings = {
+            n: s for n, s in self.param_shardings.items()
+            if n in self.params
+        }
+        master_dtypes = self._master_dtypes
+
         self.step_count = 0
         self._rng_key = jax.random.PRNGKey(rng_seed)
 
@@ -156,6 +206,14 @@ class TrainStep:
             return loss_ref(out, batch["label"])
 
         def step_fn(params, opt_state, batch, rng):
+            if master_dtypes:
+                # rebuild the compute-dtype view from the resident fp32
+                # masters; XLA sees cast(master) feeding the matmuls and
+                # may rematerialize the casts under memory pressure
+                # instead of keeping 2 bytes/param alive across the step
+                params = dict(params)
+                for n, dt in master_dtypes.items():
+                    params[n] = opt_state["master"][n].astype(dt)
             if merge_k <= 1:
                 loss, grads = jax.value_and_grad(loss_of)(
                     params, batch, rng)
@@ -207,19 +265,24 @@ class TrainStep:
                     lambda a: a / merge_k, acc)
                 loss = loss_sum / merge_k
             new_params, new_state = optimizer.update(grads, opt_state, params)
+            if master_dtypes:
+                # the low-precision copies are not carried: drop them so
+                # XLA dead-code-eliminates the cast-back
+                new_params = {n: v for n, v in new_params.items()
+                              if n not in master_dtypes}
             return new_params, new_state, loss
 
         donate_argnums = (0, 1) if donate else ()
         self._step = jax.jit(
             step_fn,
             in_shardings=(
-                self.param_shardings,
+                carried_param_shardings,
                 self.state_shardings,
                 None,  # batch shardings resolve from committed inputs
                 NamedSharding(mesh, P()),
             ),
             out_shardings=(
-                self.param_shardings,
+                carried_param_shardings,
                 self.state_shardings,
                 NamedSharding(mesh, P()),
             ),
@@ -280,28 +343,64 @@ class TrainStep:
                 self.params, self.opt_state, batch, sub
             )
         self.step_count += 1
-        self.sync_to_model()
+        if not self._master_dtypes:
+            self.sync_to_model()
+        else:
+            # master_only: skip the write-back ONLY for master-backed
+            # params (re-materializing them defeats the mode; call
+            # sync_to_model() explicitly before eval/export). Carried
+            # params (fp32, no master) were donated and MUST be rebound
+            # or their Parameters point at deleted buffers.
+            for n in self.params:
+                self._param_objs[n].value = self.params[n]
         if self.optimizer._lr_scheduler is not None:
             self.optimizer._lr_scheduler.step()
         return loss
 
+    def _materialized_params(self):
+        """Full param dict at model dtype; in master_only mode the
+        dropped copies are cast back from the fp32 masters on demand."""
+        params = dict(self.params)
+        for n, dt in self._master_dtypes.items():
+            params[n] = self.opt_state["master"][n].astype(dt)
+        return params
+
     def sync_to_model(self):
         """Write the (sharded) param values back into the Layer tree."""
         for n, p in self._param_objs.items():
-            p.value = self.params[n]
+            if n in self._master_dtypes:
+                p.value = self.opt_state["master"][n].astype(
+                    self._master_dtypes[n])
+            elif n in self.params:
+                p.value = self.params[n]
 
     def state_dict(self):
         return {
-            "params": self.params,
+            "params": self._materialized_params(),
             "opt_state": self.opt_state,
             "step": self.step_count,
         }
 
     def set_state_dict(self, sd):
-        self.params = {
-            n: jax.device_put(v, self.param_shardings[n])
-            for n, v in sd["params"].items()
-        }
+        # merge, don't replace: a partial restore must not wipe params
+        # absent from sd (the carried-params pytree has to keep matching
+        # the compiled step's structure)
+        new_params = dict(self.params)
+        for n, v in sd["params"].items():
+            if n not in self._master_dtypes:
+                new_params[n] = jax.device_put(v, self.param_shardings[n])
+        self.params = new_params
+        if "opt_state" not in sd and self.opt_state.get("master"):
+            # params-only restore with live fp32 masters (either mode):
+            # the masters are what the next update reads — refresh them
+            # or the restore is silently overwritten on the first step
+            new_master = dict(self.opt_state["master"])
+            for n in new_master:
+                if n in sd["params"]:
+                    new_master[n] = jax.device_put(
+                        jnp.asarray(sd["params"][n]).astype(jnp.float32),
+                        self.state_shardings["master"][n])
+            self.opt_state = {**self.opt_state, "master": new_master}
         if "opt_state" in sd:
             self.opt_state = jax.device_put(
                 sd["opt_state"], self.state_shardings

@@ -153,3 +153,79 @@ def test_kv_cache_decode_matches_full_forward(tiny_model):
         np.asarray(logits[0, 0]), full_logits[0, -1], rtol=2e-3, atol=2e-3
     )
     tiny_model.train()
+
+
+def test_master_only_residency_matches_paired():
+    """master_residency='master_only' is bit-identical to 'paired':
+    the stored bf16 param is exactly cast(master) after every update, so
+    dropping the persistent bf16 copy changes residency, not numerics."""
+    ids = np.random.randint(0, 256, (4, 8))
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids)}
+    runs = {}
+    for mode in ("paired", "master_only"):
+        pt.seed(17)
+        cfg = LlamaConfig.tiny(use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        model.to(pt.bfloat16)
+        mesh = dist.build_mesh(fsdp=4)
+        strategy = _strategy(stage=3, sharding_degree=4)
+        o = opt.AdamW(learning_rate=1e-3, multi_precision=True)
+        ts = TrainStep(model, o, mesh, strategy, master_residency=mode)
+        losses = [float(ts.run(batch)) for _ in range(3)]
+        runs[mode] = (ts, losses)
+
+    ts_m, losses_m = runs["master_only"]
+    ts_p, losses_p = runs["paired"]
+    np.testing.assert_array_equal(losses_p, losses_m)
+
+    # the bf16 copies are not carried by the master_only step
+    name = "model.embed_tokens.weight"
+    assert name not in ts_m.params and name in ts_p.params
+    np.testing.assert_array_equal(
+        np.asarray(ts_p.opt_state["master"][name], np.float32),
+        np.asarray(ts_m.opt_state["master"][name], np.float32))
+
+    # state_dict still carries full params (cast back on demand), and
+    # sync_to_model rematerializes the Layer tree from the masters
+    sd = ts_m.state_dict()
+    assert sd["params"][name].dtype == jnp.bfloat16
+    ts_m.sync_to_model()
+    live = dict(ts_m.model.named_parameters())[name].value
+    np.testing.assert_array_equal(
+        np.asarray(live, np.float32),
+        np.asarray(sd["params"][name], np.float32))
+
+
+def test_master_only_requires_masters():
+    pt.seed(3)
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)  # fp32 params: no masters
+    mesh = dist.build_mesh(fsdp=4)
+    o = opt.AdamW(learning_rate=1e-3, multi_precision=True)
+    with pytest.raises(ValueError, match="master_only"):
+        TrainStep(model, o, mesh, _strategy(stage=1, sharding_degree=4),
+                  master_residency="master_only")
+
+
+def test_master_only_params_only_restore():
+    """set_state_dict with params but no opt_state must refresh the
+    masters (the resident form) — not silently drop the restore."""
+    pt.seed(21)
+    cfg = LlamaConfig.tiny(use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    model.to(pt.bfloat16)
+    mesh = dist.build_mesh(fsdp=4)
+    o = opt.AdamW(learning_rate=1e-3, multi_precision=True)
+    ts = TrainStep(model, o, mesh, _strategy(stage=3, sharding_degree=4),
+                   master_residency="master_only")
+    name = "model.embed_tokens.weight"
+    new_w = jnp.full(ts.opt_state["master"][name].shape, 0.125, jnp.bfloat16)
+    ts.set_state_dict({"params": {name: new_w}})
+    np.testing.assert_array_equal(
+        np.asarray(ts.opt_state["master"][name]),
+        np.full(new_w.shape, 0.125, np.float32))
+    # and the forward now uses the restored value
+    sd = ts.state_dict()
+    np.testing.assert_array_equal(
+        np.asarray(sd["params"][name], np.float32),
+        np.full(new_w.shape, 0.125, np.float32))

@@ -209,3 +209,44 @@ def test_scheduler_drives_optimizer_in_jit():
 
     p1, s1 = step(params, state)
     assert bool(jnp.all(jnp.isfinite(p1["w"])))
+
+
+def test_lars_trust_ratio_matches_numpy():
+    """Lars vs a numpy reference of the lars_momentum kernel recurrence."""
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal((6, 4)).astype(np.float32)
+    g = rng.standard_normal((6, 4)).astype(np.float32)
+    lr, mu, coeff, decay = 0.1, 0.9, 0.001, 0.0005
+
+    o = opt.Lars(learning_rate=lr, momentum=mu, lars_coeff=coeff,
+                 lars_weight_decay=decay, multi_precision=False)
+    params = {"w": jnp.asarray(w0)}
+    state = o.init(params)
+    grads = {"w": jnp.asarray(g)}
+
+    w_np, v_np = w0.copy(), np.zeros_like(w0)
+    for _ in range(4):
+        params, state = o.update(grads, state, params)
+        w_norm = np.linalg.norm(w_np)
+        g_norm = np.linalg.norm(g)
+        local_lr = lr * coeff * w_norm / (g_norm + decay * w_norm)
+        v_np = mu * v_np + local_lr * (g + decay * w_np)
+        w_np = w_np - v_np
+    np.testing.assert_allclose(np.asarray(params["w"]), w_np,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lars_exclude_from_weight_decay():
+    o = opt.Lars(learning_rate=0.1, lars_weight_decay=0.5,
+                 exclude_from_weight_decay=["bias"],
+                 multi_precision=False)
+    params = {"fc.bias": jnp.ones((3,))}
+    state = o.init(params)
+    grads = {"fc.bias": jnp.full((3,), 0.1)}
+    p1, _ = o.update(grads, state, params)
+    # reference without any decay
+    o2 = opt.Lars(learning_rate=0.1, lars_weight_decay=0.0,
+                  multi_precision=False)
+    p2, _ = o2.update(grads, o2.init(params), params)
+    np.testing.assert_allclose(np.asarray(p1["fc.bias"]),
+                               np.asarray(p2["fc.bias"]), rtol=1e-6)
