@@ -71,6 +71,36 @@ def _stale_chip_holders():
     return holders
 
 
+_HB_PREFIX = "/tmp/paddle_tpu_bench.hb."
+
+
+def _heartbeat():
+    """Refresh this process's liveness file. Any bench that might be
+    orphaned (nohup) stays immune to the reaper while it keeps beating —
+    the probe loop beats every attempt, so ≤ ~4 min between beats; a
+    crashed run's orphans never beat again."""
+    try:
+        with open(f"{_HB_PREFIX}{os.getpid()}", "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass
+
+
+def _heartbeat_fresh(pid, max_age_s=400.0):
+    try:
+        return (time.time()
+                - os.stat(f"{_HB_PREFIX}{pid}").st_mtime) < max_age_s
+    except OSError:
+        return False
+
+
+def _clear_heartbeat():
+    try:
+        os.unlink(f"{_HB_PREFIX}{os.getpid()}")
+    except OSError:
+        pass
+
+
 def _proc_cpu_jiffies(pid):
     try:
         with open(f"/proc/{pid}/stat") as f:
@@ -112,6 +142,11 @@ def _reap_stale_holders(diags):
         if pid in with_children:
             diags.append({"spared_supervisor_pid": pid, "cmd": cmd})
             continue
+        if _heartbeat_fresh(pid):
+            # healthy orphan (e.g. nohup'd run sleeping between its own
+            # probe attempts): its heartbeat file is still beating
+            diags.append({"spared_heartbeat_pid": pid, "cmd": cmd})
+            continue
         b, a = before.get(pid), _proc_cpu_jiffies(pid)
         if b is None or a is None:  # already gone
             continue
@@ -138,11 +173,13 @@ def probe_tpu():
     )
     diags = []
     deadline = time.time() + PROBE_WINDOW_S
+    _heartbeat()
     # reap BEFORE the first attempt too: if a crashed run left a wedged
     # holder, attempt 0 would otherwise burn its full cold-init timeout
     _reap_stale_holders(diags)
     attempt = 0
     while True:
+        _heartbeat()
         tmo = PROBE_ATTEMPT_TIMEOUTS[
             min(attempt, len(PROBE_ATTEMPT_TIMEOUTS) - 1)]
         tmo = min(tmo, max(30, deadline - time.time()))
@@ -514,7 +551,8 @@ def _apply_baseline_ratio(result):
 
 
 SECONDARY_TIMEOUT = 560   # per config; each compiles its own programs
-SECONDARY_BUDGET = 1800   # total wall-clock for all secondaries
+SERVE7B_TIMEOUT = 700     # 32-layer decode program compiles are slower
+SECONDARY_BUDGET = 2400   # total wall-clock for all secondaries
 HEADLINE_TIMEOUT = 1200
 
 
@@ -551,19 +589,22 @@ def _run_secondary_configs(env):
     gets its JSON line."""
     out = {}
     t_start = time.time()
-    for name in ("infer", "moe", "vit", "mamba", "unet"):
+    for name in ("infer", "moe", "vit", "mamba", "unet", "serve7b"):
         if time.time() - t_start > SECONDARY_BUDGET:
             out[name] = {"metric": f"bench_{name}_skipped", "value": 0.0,
                          "unit": "skipped",
                          "extra": {"reason": "secondary budget exhausted"}}
             continue
-        out[name] = _run_one_config(name, env, SECONDARY_TIMEOUT)
+        tmo = SERVE7B_TIMEOUT if name == "serve7b" else SECONDARY_TIMEOUT
+        _heartbeat()
+        out[name] = _run_one_config(name, env, tmo)
     return out
 
 
 def _child_main(config):
     """Child mode (--config X): the parent guarantees the device is free
     for this process; run the requested benchmark in-process."""
+    _heartbeat()
     tpu_diags = None
     if os.environ.get("_BENCH_DIAGS"):
         tpu_diags = json.loads(os.environ["_BENCH_DIAGS"])
@@ -623,6 +664,7 @@ def main():
     _maybe_write_baseline(result)
     _apply_baseline_ratio(result)
     print(_compact_line(result))
+    _clear_heartbeat()
 
 
 if __name__ == "__main__":

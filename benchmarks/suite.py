@@ -231,12 +231,51 @@ def bench_mamba(tpu_diags):
                    extra, tpu_diags)
 
 
+def _run_load(eng, prompts, new_tokens, gap, max_chunk, chunked=True):
+    """One steady-arrival load sweep. A new request lands every ``gap``
+    seconds while earlier ones decode; returns TTFT percentiles and the
+    served-token throughput over the window. ``chunked=False`` is the
+    head-of-line CONTROL: decode granularity stays identical (same
+    K-step chunks), but admission prefills BLOCK the loop instead of
+    overlapping the in-flight chunk — isolating exactly what the
+    overlapped-admission scheduler buys."""
+    eng._finished.clear()
+    t_start = time.perf_counter()
+    submitted = 0
+    next_arrival = t_start
+    n_requests = len(prompts)
+    while True:
+        now = time.perf_counter()
+        while submitted < n_requests and now >= next_arrival:
+            eng.add_request(prompts[submitted], new_tokens)
+            submitted += 1
+            next_arrival += gap
+            now = time.perf_counter()
+        if not chunked and eng._queue:
+            eng._admit()  # blocking whole-prefill admission
+        busy = eng.step_chunk(max_chunk)
+        if submitted >= n_requests and not busy and not eng.active.any():
+            break
+    t_total = time.perf_counter() - t_start
+
+    reqs = [eng._finished[r] for r in sorted(eng._finished)]
+    ttfts = np.array([r.ttft_ms for r in reqs if r.ttft_ms is not None])
+    total_toks = sum(len(r.output) for r in reqs)
+    return {
+        "gap_ms": round(gap * 1e3, 1),
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
+        "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+        "served_tokens_per_sec": round(total_toks / t_total, 1),
+        "n_requests": len(reqs),
+    }
+
+
 def bench_infer(tpu_diags):
-    """TTFT under steady arrival load (p50/p99) + decode tokens/sec on
-    the flagship Llama — BASELINE's inference metric, measured the way a
-    server sees it: requests arrive WHILE other sequences are decoding,
-    and admission must not stall in-flight decode (serving.step_chunk's
-    overlapped prefill)."""
+    """Serving LOAD CURVE: TTFT p50/p99 at several steady arrival rates
+    spanning sub-saturation -> saturation, plus a chunked-prefill on/off
+    comparison at the middle rate — BASELINE's inference metric measured
+    the way a server sees it (one overload point says nothing about
+    scheduling quality; VERDICT r4 weak #3)."""
     import paddle_tpu as pt
     from paddle_tpu.inference.serving import (
         ContinuousBatchingEngine,
@@ -275,57 +314,235 @@ def bench_infer(tpu_diags):
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
                for _ in range(n_requests)]
 
-    # warmup: compile prefill + chunk-decode programs; drop its record
-    # (its TTFT is compile time, not serving time)
+    # warmup: compile prefill + chunk-decode (and whole-prefill) programs;
+    # drop its record (its TTFT is compile time, not serving time)
+    eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
+    eng.add_request(prompts[0], 2)
+    while eng.step() or eng.active.any():
+        pass
+    eng._finished.clear()
+
+    # unloaded TTFT: one request into an empty engine (prefill +
+    # admission latency with zero queueing)
+    unloaded = _run_load(eng, prompts[:1], new_tokens, 1e-3, max_chunk)
+
+    # arrival-rate sweep: FIXED design gaps (a chunk-relative gap would
+    # self-scale the offered load with engine speed and make TTFT
+    # incomparable across rounds). 300ms ~ sub-saturation for 8 slots,
+    # 75ms ~ 2x overload.
+    gaps = (0.300, 0.150, 0.075) if tpu else (0.050,)
+    curve = [_run_load(eng, prompts, new_tokens, g, max_chunk)
+             for g in gaps]
+
+    # overlapped-admission OFF at the middle rate: same decode chunks,
+    # but admission prefills block the loop (head-of-line control)
+    mid = gaps[len(gaps) // 2]
+    unchunked = _run_load(eng, prompts, new_tokens, mid, max_chunk,
+                          chunked=False)
+
+    headline = curve[len(gaps) // 2]
+    return _result(
+        "infer_p50_ttft_ms", headline["p50_ttft_ms"], "ms",
+        {"latency_basis": "client wall-clock incl. tunnel dispatch RTT",
+         "p99_ttft_ms": headline["p99_ttft_ms"],
+         "unloaded_ttft_ms": unloaded["p50_ttft_ms"],
+         "served_tokens_per_sec": headline["served_tokens_per_sec"],
+         "load_curve": curve,
+         "chunked_prefill_off": unchunked,
+         "n_requests": headline["n_requests"], "prompt_len": prompt_len,
+         "new_tokens": new_tokens,
+         "arrival_gap_ms": headline["gap_ms"],
+         "max_chunk": max_chunk,
+         "slots": ecfg.max_slots}, tpu_diags)
+
+
+def _build_7b_int8(cfg, group_size=128, seed=0):
+    """Construct a weight-only-int8 Llama of ``cfg``'s size WITHOUT ever
+    materializing the fp32/bf16 dense tree (28 GB for 7B — beyond the
+    16 GB HBM): the model is meta-initialized (ShapeDtypeStructs), every
+    linear is swapped for a WeightOnlyLinear allocated directly at int8,
+    the qweights are filled with random values on-device (decode
+    throughput is value-independent), and only the small non-linear
+    params (embeddings, norms) are materialized densely."""
+    import jax.random as jrandom
+
+    from paddle_tpu.core import meta
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.quantization import WeightOnlyLinear
+    from paddle_tpu.quantization.qat import replace_layers
+    from paddle_tpu.distributed.parallel_layers.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+    from paddle_tpu.nn.layer.common import Linear
+
+    with meta.meta_init():
+        model = LlamaForCausalLM(cfg)
+
+    kinds = (Linear, ColumnParallelLinear, RowParallelLinear)
+    model = replace_layers(
+        model, lambda s: type(s) in kinds,
+        lambda s: WeightOnlyLinear(s.in_features, s.out_features,
+                                   weight_dtype="int8",
+                                   group_size=group_size))
+
+    key = jrandom.PRNGKey(seed)
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, WeightOnlyLinear):
+            key, k1, k2 = jrandom.split(key, 3)
+            q = jrandom.randint(
+                k1, (sub.in_features, sub.out_features), -127, 128,
+                jnp.int8)
+            # scales sized like a real quantization of N(0, 0.02) weights
+            s = 0.02 * (1.0 + 0.1 * jrandom.uniform(
+                k2, sub._buffers["scale"].shape)) / 127.0
+            sub._buffers["qweight"] = q
+            sub._buffers["scale"] = s.astype(jnp.float32)
+            sub.bias = None  # llama linears are bias-free
+    meta.materialize(model, seed=seed)  # embeddings + norms only now
+    if cfg.dtype == "bfloat16":
+        import paddle_tpu as pt
+
+        model.to(pt.bfloat16)
+    model.eval()
+    return model
+
+
+def bench_serve7b(tpu_diags):
+    """7B-class int8 weight-only decode through the paged continuous-
+    batching engine — the first production-scale silicon path (VERDICT
+    r4 next-#3; parity: phi weight_only_linear + masked_multihead
+    serving). Reports decode tok/s (DEVICE-time basis), TTFT, and HBM
+    residency."""
+    import os
+
+    from paddle_tpu.inference.serving import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+    from paddle_tpu.models import LlamaConfig
+
+    tpu = _platform() == "tpu"
+    if tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=int(os.environ.get("BENCH_7B_HID", "4096")),
+            intermediate_size=int(os.environ.get("BENCH_7B_INTER", "11008")),
+            num_hidden_layers=int(os.environ.get("BENCH_7B_LAYERS", "32")),
+            num_attention_heads=32, num_key_value_heads=32,
+            max_position_embeddings=2048, use_flash_attention=False,
+            dtype="bfloat16")
+        slots, max_len, prompt_len = 8, 1024, 120
+        measure_tokens, max_chunk = 128, 16
+        cache_dtype = jnp.bfloat16
+    else:
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=256,
+            use_flash_attention=False)
+        slots, max_len, prompt_len = 2, 128, 12
+        measure_tokens, max_chunk = 8, 4
+        cache_dtype = jnp.float32
+
+    model = _build_7b_int8(cfg, group_size=128)
+    n_linear = sum(int(np.prod(b.shape))
+                   for nm, b in model.named_buffers() if "qweight" in nm)
+    n_dense = sum(int(np.prod(p.value.shape))
+                  for nm, p in model.named_parameters())
+    n_params = n_linear + n_dense
+
+    ecfg = EngineConfig(
+        max_slots=slots, max_len=max_len, seq_buckets=(128,),
+        cache_dtype=cache_dtype, paged=True,
+        page_size=64 if tpu else 32)
+    eng = ContinuousBatchingEngine(model, ecfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(slots)]
+
+    # warmup / compile all programs
     eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
     eng._finished.clear()
 
-    # steady arrival load: a new request lands every `gap` seconds while
-    # earlier ones are still decoding. The calibration chunk (request 0)
-    # is INSIDE the measured window so token counts and wall time match.
-    # On TPU the gap is a FIXED design constant — a chunk-relative gap
-    # self-scales the offered load with engine speed, which made TTFT
-    # incomparable across rounds (a faster engine measured "worse").
-    t_start = time.perf_counter()
-    eng.add_request(prompts[0], new_tokens)
-    eng.step_chunk(max_chunk)  # calibration chunk (CPU gap only)
-    chunk_s = time.perf_counter() - t_start
-    gap = 0.150 if tpu else max(chunk_s / 2, 1e-3)
+    # unloaded TTFT
+    ttft = _run_load(eng, prompts[:1], 4, 1e-3, max_chunk)
 
-    submitted = 1
-    next_arrival = time.perf_counter() + gap
-    while True:
-        now = time.perf_counter()
-        while submitted < n_requests and now >= next_arrival:
-            eng.add_request(prompts[submitted], new_tokens)
-            submitted += 1
-            next_arrival += gap
-            now = time.perf_counter()
-        busy = eng.step_chunk(max_chunk)
-        if submitted >= n_requests and not busy and not eng.active.any():
-            break
-    t_total = time.perf_counter() - t_start
+    # steady-state decode: all slots resident, chunked decode measured
+    # inside a profiler trace — tok/s comes from the DEVICE plane
+    from benchmarks.devtime import traced_step_ms
 
-    reqs = [eng._finished[r] for r in sorted(eng._finished)]
-    ttfts = np.array([r.ttft_ms for r in reqs if r.ttft_ms is not None])
-    total_toks = sum(len(r.output) for r in reqs)
-    # service throughput over the whole load window (includes prefill
-    # and arrival idle gaps — what the server delivers, not raw decode
-    # speed; named accordingly)
-    served_tps = total_toks / t_total
-    # request 0 entered an empty engine: its TTFT is the unloaded
-    # (prefill + admission) latency, vs the percentiles' under-load view
-    r0 = min(eng._finished)
-    unloaded = eng._finished[r0].ttft_ms
-    return _result(
-        "infer_p50_ttft_ms", float(np.percentile(ttfts, 50)), "ms",
-        {"latency_basis": "client wall-clock incl. tunnel dispatch RTT",
-         "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
-         "unloaded_ttft_ms": round(unloaded, 2) if unloaded else None,
-         "served_tokens_per_sec": round(served_tps, 1),
-         "n_requests": len(reqs), "prompt_len": prompt_len,
-         "new_tokens": new_tokens, "arrival_gap_ms": round(gap * 1e3, 2),
-         "slots": ecfg.max_slots}, tpu_diags)
+    for p in prompts:
+        eng.add_request(p, measure_tokens + 64)
+    # admit everything + settle into pure decode
+    eng.step_chunk(max_chunk)
+    eng.step_chunk(max_chunk)
+
+    n_chunks = max(2, measure_tokens // max_chunk)
+
+    def one_chunk():
+        # step_chunk syncs the chunk's tokens to the host itself; return
+        # a live cache leaf so traced_step_ms's completion fetch also
+        # rides the real output stream
+        eng.step_chunk(max_chunk)
+        leaf = (eng.layer_caches[0].k_pages if ecfg.paged
+                else eng.caches[0][0])
+        return leaf[0, 0]
+
+    timing = traced_step_ms(one_chunk, n_steps=n_chunks)
+    toks_per_chunk = slots * max_chunk
+    decode_tps = toks_per_chunk / (timing.step_ms / 1e3)
+
+    stats = {}
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    hbm_gb = round(stats.get("bytes_in_use", 0) / 2**30, 2)
+    peak_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 2)
+
+    extra = {
+        "params": n_params,
+        "int8_linear_params": n_linear,
+        "dense_params": n_dense,
+        "weight_dtype": "int8",
+        "slots": slots, "max_len": max_len,
+        "prompt_len": prompt_len, "max_chunk": max_chunk,
+        "paged": True, "page_size": ecfg.page_size,
+        "device_chunk_ms": (round(timing.device_step_ms, 3)
+                            if timing.device_step_ms else None),
+        "wall_chunk_ms": round(timing.wall_step_ms, 3),
+        "unloaded_ttft_ms": ttft["p50_ttft_ms"],
+        "hbm_gb_in_use": hbm_gb, "hbm_gb_peak": peak_gb,
+        "latency_basis": "decode tok/s from profiler device plane; "
+                         "TTFT is client wall-clock incl. tunnel RTT",
+        "platform": _platform(),
+        "n_chips": len(jax.devices()),
+    }
+    if tpu_diags:
+        extra["tpu_probe"] = tpu_diags
+    if tpu and timing.device_step_ms is None:
+        extra["error"] = ("profiler trace carried no device plane; "
+                          "tunnel wall-clock refused as throughput basis")
+        return {"metric": "serve7b_int8_implausible", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0, "extra": extra}
+    # bandwidth plausibility: every decode ITERATION re-reads the int8
+    # weights, and one chunk scans max_chunk iterations — the implied
+    # streaming rate must stay under HBM bandwidth
+    if tpu and timing.device_step_ms:
+        bw = (n_linear * float(max_chunk)) \
+            / (timing.device_step_ms / 1e3)  # B/s
+        extra["weight_stream_gbps"] = round(bw / 1e9, 1)
+        if bw > 1.25 * 819e9:  # v5e spec 819 GB/s + margin
+            extra["error"] = (
+                f"implied weight streaming {bw / 1e9:.0f} GB/s exceeds "
+                "HBM bandwidth — measurement artifact, refused")
+            return {"metric": "serve7b_int8_implausible", "value": 0.0,
+                    "unit": "error", "vs_baseline": 0.0, "extra": extra}
+    name = ("serve7b_int8_decode_tokens_per_sec" if tpu
+            else "serve7b_smoke_decode_tokens_per_sec")
+    return {"metric": name, "value": round(decode_tps, 1),
+            "unit": "tokens/s", "vs_baseline": 1.0, "extra": extra}
 
 
 _CONFIGS = {
@@ -334,6 +551,7 @@ _CONFIGS = {
     "unet": bench_unet,
     "mamba": bench_mamba,
     "infer": bench_infer,
+    "serve7b": bench_serve7b,
 }
 
 

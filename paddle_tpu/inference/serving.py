@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.functional import extract_params, functional_call
+from ..core.functional import (
+    extract_buffers,
+    extract_params,
+    functional_call,
+)
 from ..core.module import Layer
 from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
 
@@ -69,6 +73,12 @@ class ContinuousBatchingEngine:
         self.cfg = config or EngineConfig()
         model.eval()
         self.params = extract_params(model)
+        # buffers (rope tables, int8/int4 qweights+scales after
+        # quantize_model_weight_only) ride as ARGUMENTS, never as jit
+        # constants — a 7B int8 model would otherwise bake ~7 GB of
+        # weights into every compiled program
+        self.buffers = extract_buffers(model)
+        self._pb = {"p": self.params, "b": self.buffers}
         cfg = self.cfg
 
         self.seq_lens = np.zeros((cfg.max_slots,), np.int64)
@@ -141,12 +151,12 @@ class ContinuousBatchingEngine:
         # Samples the first token IN-JIT so only a scalar crosses to the
         # host — never the [1, bucket, vocab] logits tensor.
         if self._prefill_c is None:
-            def fn(params, ids, caches, last_idx, key):
+            def fn(pb, ids, caches, last_idx, key):
                 pos = jnp.broadcast_to(
                     jnp.arange(ids.shape[1])[None, :], ids.shape)
                 logits, filled = functional_call(
-                    self.model, params, ids, position_ids=pos,
-                    kv_caches=caches, cache_index=0)
+                    self.model, pb["p"], ids, position_ids=pos,
+                    kv_caches=caches, cache_index=0, buffers=pb["b"])
                 last = logits[0, last_idx]
                 if self.cfg.greedy:
                     first = jnp.argmax(last)
@@ -204,7 +214,7 @@ class ContinuousBatchingEngine:
         if self._decode_c is None:
             paged = self.cfg.paged
 
-            def fn(params, toks, caches, state_or_lens, key):
+            def fn(pb, toks, caches, state_or_lens, key):
                 # only `caches` (arg 2) is donated; the per-slot lengths /
                 # block tables must NOT alias it (f(donate(a), a) trap)
                 if paged:
@@ -216,8 +226,8 @@ class ContinuousBatchingEngine:
                     kv = caches
                 pos = seq_lens[:, None]
                 logits, new_kv = functional_call(
-                    self.model, params, toks, position_ids=pos,
-                    kv_caches=kv, cache_index=seq_lens)
+                    self.model, pb["p"], toks, position_ids=pos,
+                    kv_caches=kv, cache_index=seq_lens, buffers=pb["b"])
                 logits = logits[:, -1, :]
                 if self.cfg.greedy:
                     nxt = jnp.argmax(logits, axis=-1)
@@ -245,7 +255,7 @@ class ContinuousBatchingEngine:
         if self._decode_nc is None:
             paged = self.cfg.paged
 
-            def fn(params, toks, caches, lens, active, budget, bt, key, K):
+            def fn(pb, toks, caches, lens, active, budget, bt, key, K):
                 def one(carry, k):
                     toks, caches, lens = carry
                     if paged:
@@ -254,8 +264,9 @@ class ContinuousBatchingEngine:
                     else:
                         kv = caches
                     logits, new_kv = functional_call(
-                        self.model, params, toks, position_ids=lens[:, None],
-                        kv_caches=kv, cache_index=lens)
+                        self.model, pb["p"], toks,
+                        position_ids=lens[:, None],
+                        kv_caches=kv, cache_index=lens, buffers=pb["b"])
                     logits = logits[:, -1, :]
                     if self.cfg.greedy:
                         nxt = jnp.argmax(logits, axis=-1)
@@ -317,7 +328,7 @@ class ContinuousBatchingEngine:
                 1, bucket, dtype=self.cfg.cache_dtype)
             self._key, sub = jax.random.split(self._key)
             first_dev, filled = self._prefill()(
-                self.params, jnp.asarray(padded, jnp.int32), one_caches,
+                self._pb, jnp.asarray(padded, jnp.int32), one_caches,
                 n - 1, sub)
             if self.cfg.paged:
                 self.layer_caches = self._scatter_paged()(
@@ -378,10 +389,10 @@ class ContinuousBatchingEngine:
                 block_tables=jnp.asarray(self.pool.block_tables),
                 seq_lens=lens)
             nxt, self.layer_caches = self._decode()(
-                self.params, toks, self.layer_caches, state, sub)
+                self._pb, toks, self.layer_caches, state, sub)
         else:
             nxt, self.caches = self._decode()(
-                self.params, toks, self.caches, lens, sub)
+                self._pb, toks, self.caches, lens, sub)
         nxt = np.asarray(nxt)
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
@@ -437,7 +448,7 @@ class ContinuousBatchingEngine:
               else jnp.zeros((1,), jnp.int32))
         caches = self.layer_caches if self.cfg.paged else self.caches
         toks_all, caches, _ = self._decode_n()(
-            self.params, toks, caches, lens, act, jnp.asarray(budget),
+            self._pb, toks, caches, lens, act, jnp.asarray(budget),
             bt, sub, K)
         if self.cfg.paged:
             self.layer_caches = caches

@@ -88,7 +88,11 @@ class WeightOnlyLinear(Layer):
         self.use_pallas = use_pallas
         if weight_dtype == "int4" and group_size is None:
             group_size = 128
-        if isinstance(linear_or_in, Linear):
+        if not isinstance(linear_or_in, int):
+            # any linear-shaped layer: nn.Linear or the TP variants
+            # (Column/RowParallelLinear — quantized serving is a
+            # single-chip path today, where their collectives are
+            # identity)
             src = linear_or_in
             self.in_features = src.in_features
             self.out_features = src.out_features
@@ -175,15 +179,29 @@ class FakeQuant(Layer):
 
 
 def quantize_model_weight_only(model: Layer, weight_dtype: str = "int8",
-                               group_size: Optional[int] = None) -> Layer:
-    """Replace every nn.Linear in the tree with WeightOnlyLinear."""
+                               group_size: Optional[int] = None,
+                               use_pallas: bool = True) -> Layer:
+    """Replace every linear in the tree with WeightOnlyLinear.
+
+    Matches nn.Linear AND the tensor-parallel variants
+    (Column/RowParallelLinear) so transformer blocks built for the
+    hybrid engine (e.g. models/llama.py) quantize too. Weight-only
+    serving is a single-chip path today: at mesh size 1 the TP layers'
+    collectives are identity, so swapping them for a dense quantized
+    matmul is exact. (Parity: phi weight_only_linear serving kernels.)"""
+    from ..distributed.parallel_layers.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
     from ..nn.layer.common import Linear
     from .qat import replace_layers
 
+    kinds = (Linear, ColumnParallelLinear, RowParallelLinear)
     return replace_layers(
-        model, lambda s: type(s) is Linear,
+        model, lambda s: type(s) in kinds,
         lambda s: WeightOnlyLinear(s, weight_dtype=weight_dtype,
-                                   group_size=group_size))
+                                   group_size=group_size,
+                                   use_pallas=use_pallas))
 
 
 from .observer import (  # noqa: E402,F401

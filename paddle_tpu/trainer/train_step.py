@@ -405,7 +405,9 @@ class TrainStep:
             self.opt_state = jax.device_put(
                 sd["opt_state"], self.state_shardings
             )
-        self.step_count = sd.get("step", 0)
+        # a checkpoint round-trip returns 'step' as a 0-d array; keep the
+        # counter a python int (log lines, ckpt filenames format it)
+        self.step_count = int(sd.get("step", 0))
 
 
 def build_train_step(model, optimizer, mesh, strategy=None, loss_fn=None,

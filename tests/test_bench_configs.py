@@ -17,7 +17,7 @@ import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-CONFIGS = ["moe", "vit", "unet", "mamba", "infer"]
+CONFIGS = ["moe", "vit", "unet", "mamba", "infer", "serve7b"]
 
 
 @pytest.mark.parametrize("name", CONFIGS)
