@@ -110,6 +110,19 @@ def _proc_cpu_jiffies(pid):
         return None
 
 
+def _gc_heartbeats(max_age_s=3600.0):
+    """Drop heartbeat files nobody will clear (killed parents): stale
+    files whose pid could be recycled must not shield a wedged holder."""
+    import glob
+
+    for f in glob.glob(_HB_PREFIX + "*"):
+        try:
+            if time.time() - os.stat(f).st_mtime > max_age_s:
+                os.unlink(f)
+        except OSError:
+            pass
+
+
 def _reap_stale_holders(diags):
     """Kill matched orphans — but only ones that are IDLE (no CPU over a
     sample window). A wedged holder is blocked on a dead tunnel socket
@@ -117,6 +130,7 @@ def _reap_stale_holders(diags):
     orphaned (nohup) keeps accumulating jiffies and is left alone."""
     import signal
 
+    _gc_heartbeats()
     candidates = _stale_chip_holders()
     if not candidates:
         return
@@ -603,8 +617,10 @@ def _run_secondary_configs(env):
 
 def _child_main(config):
     """Child mode (--config X): the parent guarantees the device is free
-    for this process; run the requested benchmark in-process."""
-    _heartbeat()
+    for this process; run the requested benchmark in-process. Children
+    do NOT heartbeat: while the parent lives they are not orphan-
+    matchable, and after a parent crash a wedged child must be
+    immediately reapable."""
     tpu_diags = None
     if os.environ.get("_BENCH_DIAGS"):
         tpu_diags = json.loads(os.environ["_BENCH_DIAGS"])
@@ -657,14 +673,16 @@ def main():
         # whole timeout inside plugin registration).
         env.pop("PALLAS_AXON_POOL_IPS", None)
 
-    result = _run_one_config("llama", env, HEADLINE_TIMEOUT)
-    if "--no-secondary" not in argv:
-        result.setdefault("extra", {})["secondary"] = \
-            _run_secondary_configs(env)
-    _maybe_write_baseline(result)
-    _apply_baseline_ratio(result)
-    print(_compact_line(result))
-    _clear_heartbeat()
+    try:
+        result = _run_one_config("llama", env, HEADLINE_TIMEOUT)
+        if "--no-secondary" not in argv:
+            result.setdefault("extra", {})["secondary"] = \
+                _run_secondary_configs(env)
+        _maybe_write_baseline(result)
+        _apply_baseline_ratio(result)
+        print(_compact_line(result))
+    finally:
+        _clear_heartbeat()
 
 
 if __name__ == "__main__":

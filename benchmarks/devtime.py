@@ -48,6 +48,17 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+PEAK_HBM_BYTES = {
+    # device_kind -> HBM bandwidth B/s per chip (public spec sheets)
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,        # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+    "TPU v6e": 1640e9,
+}
+
 # computed-FLOP/s above this fraction of spec-sheet peak is treated as a
 # measurement artifact, not a result
 MFU_PLAUSIBILITY_CEILING = 0.95
@@ -60,6 +71,15 @@ def peak_flops(device=None) -> float:
         if kind.startswith(k):
             return v
     return {"tpu": 197e12, "cpu": 1e12}.get(device.platform, 197e12)
+
+
+def peak_hbm_bandwidth(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for k, v in PEAK_HBM_BYTES.items():
+        if kind.startswith(k):
+            return v
+    return 819e9
 
 
 def fetch_sync(x) -> None:

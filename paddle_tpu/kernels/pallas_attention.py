@@ -411,6 +411,103 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
+# ---------------------------------------------------------------------------
+# backward: FUSED single pass (flash-v2 backward proper).
+#
+# The two-pass layout above runs 7 tile-matmuls (s and dp are computed
+# twice) and the full exp/mask/ds VPU chain twice — and the round-4
+# profile showed the backward VPU-bound at ~31% of roofline. This kernel
+# computes s/p/dp/ds ONCE per (j, i) tile and emits all three gradients:
+# dk/dv accumulate in VMEM scratch exactly as before (j is the outer
+# grid dim), while dq — whose natural accumulation order is transposed —
+# is written as per-j f32 PARTIALS [g, n_kb, rep, sq, d] that one XLA
+# reduction folds afterwards. 5 tile-matmuls, one VPU chain; extra HBM
+# is n_kb x sizeof(dq) for the partials, so the fused path is gated to
+# small n_kb (large k_block keeps n_kb = seq/1024) and falls back to the
+# two-pass kernels beyond it. Races: every partial block is written by
+# exactly one grid step; fully-masked steps zero-fill theirs.
+# ---------------------------------------------------------------------------
+_FUSED_BWD_MAX_KB = 4
+
+
+def _bwd_fused_kernel(*refs, sm_scale, causal, q_block, k_block, n_qb, rep,
+                      with_segments, window):
+    if with_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dqp_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dqp_ref,
+         dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+        qseg_ref = kseg_ref = None
+
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(jnp.logical_and(r == 0, i == 0))
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        q_seg = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        k_seg = kseg_ref[...][:1, :] if kseg_ref is not None else None
+
+        # input-dtype matmul operands, f32 accumulation (flash-v2)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal or window or q_seg is not None:
+            s = _block_mask(s, i, j, q_block, k_block, causal, q_seg,
+                            k_seg, window)
+        p = jnp.exp(s - lse)  # computed ONCE for all three grads
+        dv_scratch[:] += jax.lax.dot_general(
+            p.astype(q.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dqp_ref[0, 0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dqp_ref.dtype)
+
+    def _skip():
+        # fully-masked tile: its dq partial block must still be defined
+        dqp_ref[0, 0, 0] = jnp.zeros_like(dqp_ref[0, 0, 0])
+
+    if causal and window:
+        live = jnp.logical_and(
+            i >= _causal_i_min(j, q_block, k_block),
+            i <= _window_i_max(j, q_block, k_block, window))
+        pl.when(live)(_step)
+        pl.when(jnp.logical_not(live))(_skip)
+    elif causal:
+        live = i >= _causal_i_min(j, q_block, k_block)
+        pl.when(live)(_step)
+        pl.when(jnp.logical_not(live))(_skip)
+    else:
+        _step()
+
+    @pl.when(jnp.logical_and(r == rep - 1, i == n_qb - 1))
+    def _fin():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
 def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
                   q_block, k_block, dlse=None, window=0):
     g, rep, sq, d = q.shape
@@ -446,28 +543,30 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
             (1, k_block), lambda b, r, i, j: (b, kv_index(b, r, i, j)[1])))
         inputs += [qseg, kseg]
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            q_block=q_block, k_block=k_block, n_kb=n_kb,
-            with_segments=qseg is not None, window=window,
-        ),
-        grid=(g, rep, n_qb, n_kb),
-        in_specs=in_specs,
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((g, rep, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((q_block, d), jnp.float32)],
-        cost_estimate=pl.CostEstimate(
-            flops=6 * g * rep * sq * sk * d // (2 if causal else 1),
-            bytes_accessed=4 * g * rep * sq * d * 2 + 2 * g * sk * d * 2,
-            transcendentals=g * rep * sq * sk // (2 if causal else 1),
-        ),
-        compiler_params=_params("parallel", "parallel", "parallel",
-                                "arbitrary"),
-        interpret=_interpret(),
-    )(*inputs)
+    fused = n_kb <= _FUSED_BWD_MAX_KB
+    if not fused:
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                q_block=q_block, k_block=k_block, n_kb=n_kb,
+                with_segments=qseg is not None, window=window,
+            ),
+            grid=(g, rep, n_qb, n_kb),
+            in_specs=in_specs,
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct((g, rep, sq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((q_block, d), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=6 * g * rep * sq * sk * d // (2 if causal else 1),
+                bytes_accessed=4 * g * rep * sq * d * 2 + 2 * g * sk * d * 2,
+                transcendentals=g * rep * sq * sk // (2 if causal else 1),
+            ),
+            compiler_params=_params("parallel", "parallel", "parallel",
+                                    "arbitrary"),
+            interpret=_interpret(),
+        )(*inputs)
 
-    # dk/dv pass: grid reordered (g, kb, rep, qb)
+    # dk/dv pass (fused: + dq partials): grid reordered (g, kb, rep, qb)
     def q_index2(b, j, r, i):
         if causal:
             i = jnp.maximum(i, _causal_i_min(j, q_block, k_block))
@@ -487,6 +586,42 @@ def _mha_bwd_impl(q, k, v, o, do, lse, qseg, kseg, sm_scale, causal,
             lambda b, j, r, i: (b, q_index2(b, j, r, i)[2], 0)))
         in_specs2.append(pl.BlockSpec((1, k_block),
                                       lambda b, j, r, i: (b, j)))
+
+    if fused:
+        dqp_spec = pl.BlockSpec(
+            (1, 1, 1, q_block, d), lambda b, j, r, i: (b, j, r, i, 0))
+        dq_part, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+                q_block=q_block, k_block=k_block, n_qb=n_qb, rep=rep,
+                with_segments=qseg is not None, window=window,
+            ),
+            grid=(g, n_kb, rep, n_qb),
+            in_specs=in_specs2,
+            out_specs=(dqp_spec, kv_spec2, kv_spec2),
+            out_shape=(
+                jax.ShapeDtypeStruct((g, n_kb, rep, sq, d), jnp.float32),
+                jax.ShapeDtypeStruct((g, sk, d), q.dtype),
+                jax.ShapeDtypeStruct((g, sk, d), q.dtype),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((k_block, d), jnp.float32),
+                pltpu.VMEM((k_block, d), jnp.float32),
+            ],
+            cost_estimate=pl.CostEstimate(
+                flops=10 * g * rep * sq * sk * d // (2 if causal else 1),
+                bytes_accessed=(4 * g * rep * sq * d * 2
+                                + 2 * g * sk * d * 2
+                                + 4 * g * n_kb * rep * sq * d),
+                transcendentals=g * rep * sq * sk
+                // (2 if causal else 1),
+            ),
+            compiler_params=_params("parallel", "parallel", "arbitrary",
+                                    "arbitrary"),
+            interpret=_interpret(),
+        )(*inputs)
+        dq = dq_part.sum(axis=1).astype(q.dtype)
+        return dq, dk, dv
 
     dk, dv = pl.pallas_call(
         functools.partial(

@@ -283,3 +283,28 @@ def test_flash_attention_window_fallback_paths():
     with pytest.raises(ValueError):
         flash_attention(q, q, q, causal=False, window_size=16,
                         dropout_p=0.5)
+
+
+def test_mha_grad_two_pass_path_matches_fused():
+    """n_kb > _FUSED_BWD_MAX_KB falls back to the two-pass backward;
+    both paths must produce identical gradients."""
+    from paddle_tpu.kernels import pallas_attention as pa
+
+    rng = np.random.default_rng(11)
+    # seq 768 / k_block 128 -> n_kb = 6 > 4 (two-pass); k_block 256 ->
+    # n_kb = 3 (fused). Same math either way.
+    q = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)
+
+    def loss(blk):
+        def f(q, k, v):
+            return jnp.sum(
+                mha(q, k, v, causal=True, q_block=128, k_block=blk) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_two = loss(128)   # n_kb=6: two-pass
+    g_fused = loss(256)  # n_kb=3: fused
+    for a, b in zip(g_two, g_fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
