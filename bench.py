@@ -111,8 +111,9 @@ def _proc_cpu_jiffies(pid):
 
 
 def _gc_heartbeats(max_age_s=3600.0):
-    """Drop heartbeat files nobody will clear (killed parents): stale
-    files whose pid could be recycled must not shield a wedged holder."""
+    """/tmp hygiene only: drop heartbeat files nobody will clear (killed
+    parents). The reaper's shield window is _heartbeat_fresh's 400s
+    check — by the time this GC fires, the file shields nothing."""
     import glob
 
     for f in glob.glob(_HB_PREFIX + "*"):

@@ -319,7 +319,6 @@ def bench_infer(tpu_diags):
     # chunked=False control reuses these same programs (it only changes
     # admission blocking), so nothing else needs compiling.
     eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
-    eng._finished.clear()
 
     # unloaded TTFT: one request into an empty engine (prefill +
     # admission latency with zero queueing)
@@ -462,7 +461,6 @@ def bench_serve7b(tpu_diags):
 
     # warmup / compile all programs
     eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
-    eng._finished.clear()
 
     # unloaded TTFT
     ttft = _run_load(eng, prompts[:1], 4, 1e-3, max_chunk)

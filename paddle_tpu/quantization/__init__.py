@@ -53,12 +53,24 @@ def weight_only_linear(x, qweight, scale, bias=None, weight_dtype="int8",
         x2 = x.reshape(-1, x.shape[-1])
         m, k = x2.shape
         n = qweight.shape[1]
-        tiles = (use_pallas and m % 256 == 0 and n % 256 == 0
+        # decode batches are tiny (m = active slots); pad m up to a
+        # Mosaic-legal tile instead of falling back to the XLA path —
+        # XLA dequantizes the WHOLE weight per call, which forfeits the
+        # int8 bandwidth saving that decode lives on
+        # 128-granular above 256 keeps full MXU rows with <1 dead block
+        m_pad = (-(-m // 16) * 16 if m <= 256 else -(-m // 128) * 128)
+        m_block = min(256, m_pad) if m_pad % 256 == 0 or m_pad <= 256 \
+            else 128
+        tiles = (use_pallas and n % 256 == 0
                  and k % 256 == 0 and 256 % group_size == 0)
         if tiles:
+            if m_pad != m:
+                x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
             y = qmm.weight_only_matmul_pallas(
                 x2, qweight, scale, group_size=group_size,
-                weight_dtype=weight_dtype)
+                weight_dtype=weight_dtype, m_block=m_block)
+            if m_pad != m:
+                y = y[:m]
         else:
             y = qmm.weight_only_matmul_xla(
                 x2, qweight, scale, group_size=group_size,

@@ -291,8 +291,9 @@ def test_mha_grad_two_pass_path_matches_fused():
     from paddle_tpu.kernels import pallas_attention as pa
 
     rng = np.random.default_rng(11)
-    # seq 768 / k_block 128 -> n_kb = 6 > 4 (two-pass); k_block 256 ->
-    # n_kb = 3 (fused). Same math either way.
+    # seq 768 / k_block 128 -> n_kb = 6 > pa._FUSED_BWD_MAX_KB
+    # (two-pass); k_block 256 -> n_kb = 3 (fused). Same math either way.
+    assert 768 // 128 > pa._FUSED_BWD_MAX_KB >= 768 // 256
     q = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((1, 2, 768, 64)), jnp.float32)

@@ -214,3 +214,24 @@ def test_percentile_observer_bounded_memory():
         obs.observe(jnp.asarray(_rand((4096,), seed=i)))
     assert obs._reservoir.size == 1000  # bounded despite 200k samples
     assert obs.scale() > 0
+
+
+@pytest.mark.parametrize("m", [1, 8, 120, 300])
+def test_weight_only_pallas_small_m_padding(m):
+    """Decode-sized activations (m = a few slots) must route through the
+    Pallas blockwise-dequant kernel via m-padding — the XLA fallback
+    dequantizes the whole weight per call."""
+    rng = np.random.default_rng(0)
+    k, n = 256, 512
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    from paddle_tpu.kernels import quant_matmul as qmm
+
+    q8, s8 = qmm.quantize_weight_int8_grouped(w, 128)
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    y_pallas = Q.weight_only_linear(x, q8, s8, weight_dtype="int8",
+                                    group_size=128, use_pallas=True)
+    y_xla = Q.weight_only_linear(x, q8, s8, weight_dtype="int8",
+                                 group_size=128, use_pallas=False)
+    assert y_pallas.shape == (m, n)
+    np.testing.assert_allclose(np.asarray(y_pallas), np.asarray(y_xla),
+                               rtol=2e-5, atol=2e-5)
