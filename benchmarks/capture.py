@@ -1,0 +1,92 @@
+"""One-window TPU capture orchestrator.
+
+The tunnel to the chip can be unavailable for hours (see
+.claude/skills/verify/SKILL.md), so when a window opens, EVERYTHING
+should be captured in one pass: headline at batch 4 and batch 6
+(master-only residency decides which fits/wins), every secondary
+config, the 7B int8 decode, and the serving load curve — each in its
+own subprocess (libtpu is single-process-exclusive; one crash cannot
+take the rest down).
+
+Writes BENCH_TPU_CAPTURE.json with full per-config details (the same
+dicts the bench children emit, including op summaries and plausibility
+verdicts) and seeds BENCH_BASELINE.json via bench's own logic.
+
+Usage:  python benchmarks/capture.py [--skip-secondary]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+CAPTURE_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_TPU_CAPTURE.json")
+
+
+def main():
+    argv = sys.argv[1:]
+    env = dict(os.environ)
+    ok, diags = bench.probe_tpu()
+    if not ok:
+        print(json.dumps({"error": "tpu unavailable", "attempts": diags},
+                         default=str)[:2000])
+        sys.exit(1)
+
+    capture = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+               "configs": {}}
+
+    t0 = time.time()
+    for tag, batch in (("llama_b4", "4"), ("llama_b6", "6")):
+        env_b = {**env, "BENCH_BATCH": batch}
+        r = bench._run_one_config("llama", env_b, bench.HEADLINE_TIMEOUT)
+        capture["configs"][tag] = r
+        v = r.get("value")
+        print(f"{tag}: {r.get('metric')} = {v} "
+              f"(mfu={r.get('extra', {}).get('mfu_est')}) "
+              f"[{time.time() - t0:.0f}s]", flush=True)
+
+    # headline = the better of b4/b6 by MFU (both device-time-true or
+    # refused; a refused/failed config reports mfu None -> loses)
+    def mfu(tag):
+        r = capture["configs"][tag]
+        if r.get("unit") == "error":
+            return -1.0
+        return r.get("extra", {}).get("mfu_est") or -1.0
+
+    best = max(("llama_b4", "llama_b6"), key=mfu)
+    capture["headline"] = best
+
+    if "--skip-secondary" not in argv:
+        for name in ("infer", "serve7b", "moe", "vit", "mamba", "unet"):
+            tmo = (bench.SERVE7B_TIMEOUT if name == "serve7b"
+                   else bench.SECONDARY_TIMEOUT)
+            r = bench._run_one_config(name, env, tmo)
+            capture["configs"][name] = r
+            print(f"{name}: {r.get('metric')} = {r.get('value')} "
+                  f"[{time.time() - t0:.0f}s]", flush=True)
+
+    with open(CAPTURE_PATH, "w") as f:
+        json.dump(capture, f, indent=1, default=str)
+
+    # seed/refresh per-config baselines through bench's own discipline
+    head = capture["configs"][best]
+    head.setdefault("extra", {})["secondary"] = {
+        k: v for k, v in capture["configs"].items()
+        if k not in ("llama_b4", "llama_b6")}
+    bench._maybe_write_baseline(head)
+    print(f"capture written to {CAPTURE_PATH} "
+          f"({time.time() - t0:.0f}s total)")
+
+
+if __name__ == "__main__":
+    main()

@@ -354,14 +354,14 @@ def bench_infer(tpu_diags):
          "slots": ecfg.max_slots}, tpu_diags)
 
 
-def _build_7b_int8(cfg, group_size=128, seed=0):
-    """Construct a weight-only-int8 Llama of ``cfg``'s size WITHOUT ever
-    materializing the fp32/bf16 dense tree (28 GB for 7B — beyond the
-    16 GB HBM): the model is meta-initialized (ShapeDtypeStructs), every
-    linear is swapped for a WeightOnlyLinear allocated directly at int8,
-    the qweights are filled with random values on-device (decode
-    throughput is value-independent), and only the small non-linear
-    params (embeddings, norms) are materialized densely."""
+def _build_7b_int8(cfg, group_size=128, seed=0, weight_dtype="int8"):
+    """Construct a weight-only-quantized Llama of ``cfg``'s size WITHOUT
+    ever materializing the fp32/bf16 dense tree (28 GB for 7B — beyond
+    the 16 GB HBM): the model is meta-initialized (ShapeDtypeStructs),
+    every linear is swapped for a WeightOnlyLinear allocated directly at
+    int8/int4, the qweights are filled with random values on-device
+    (decode throughput is value-independent), and only the small
+    non-linear params (embeddings, norms) are materialized densely."""
     import jax.random as jrandom
 
     from paddle_tpu.core import meta
@@ -381,7 +381,7 @@ def _build_7b_int8(cfg, group_size=128, seed=0):
     model = replace_layers(
         model, lambda s: type(s) in kinds,
         lambda s: WeightOnlyLinear(s.in_features, s.out_features,
-                                   weight_dtype="int8",
+                                   weight_dtype=weight_dtype,
                                    group_size=group_size))
 
     key = jrandom.PRNGKey(seed)
@@ -389,8 +389,7 @@ def _build_7b_int8(cfg, group_size=128, seed=0):
         if isinstance(sub, WeightOnlyLinear):
             key, k1, k2 = jrandom.split(key, 3)
             q = jrandom.randint(
-                k1, (sub.in_features, sub.out_features), -127, 128,
-                jnp.int8)
+                k1, sub._buffers["qweight"].shape, -127, 128, jnp.int8)
             # scales sized like a real quantization of N(0, 0.02) weights
             s = 0.02 * (1.0 + 0.1 * jrandom.uniform(
                 k2, sub._buffers["scale"].shape)) / 127.0
@@ -443,12 +442,14 @@ def bench_serve7b(tpu_diags):
         measure_tokens, max_chunk = 8, 4
         cache_dtype = jnp.float32
 
-    model = _build_7b_int8(cfg, group_size=128)
+    wdtype = os.environ.get("BENCH_7B_WDTYPE", "int8")
+    model = _build_7b_int8(cfg, group_size=128, weight_dtype=wdtype)
+    # qweight BYTES on HBM (int4 packs two params/byte: shape is k//2)
     n_linear = sum(int(np.prod(b.shape))
                    for nm, b in model.named_buffers() if "qweight" in nm)
     n_dense = sum(int(np.prod(p.value.shape))
                   for nm, p in model.named_parameters())
-    n_params = n_linear + n_dense
+    n_params = n_linear * (2 if wdtype == "int4" else 1) + n_dense
 
     ecfg = EngineConfig(
         max_slots=slots, max_len=max_len, seq_buckets=(128,),
@@ -500,9 +501,9 @@ def bench_serve7b(tpu_diags):
 
     extra = {
         "params": n_params,
-        "int8_linear_params": n_linear,
+        "qweight_hbm_bytes": n_linear,
         "dense_params": n_dense,
-        "weight_dtype": "int8",
+        "weight_dtype": wdtype,
         "slots": slots, "max_len": max_len,
         "prompt_len": prompt_len, "max_chunk": max_chunk,
         "paged": True, "page_size": ecfg.page_size,
@@ -521,7 +522,7 @@ def bench_serve7b(tpu_diags):
     if tpu and timing.device_step_ms is None:
         extra["error"] = ("profiler trace carried no device plane; "
                           "tunnel wall-clock refused as throughput basis")
-        return {"metric": "serve7b_int8_implausible", "value": 0.0,
+        return {"metric": f"serve7b_{wdtype}_implausible", "value": 0.0,
                 "unit": "error", "vs_baseline": 0.0, "extra": extra}
     # bandwidth plausibility: every decode ITERATION re-reads the int8
     # weights, and one chunk scans max_chunk iterations — the implied
@@ -538,9 +539,10 @@ def bench_serve7b(tpu_diags):
                 f"implied weight streaming {bw / 1e9:.0f} GB/s exceeds "
                 f"HBM bandwidth ({hbm_peak / 1e9:.0f} GB/s) — "
                 "measurement artifact, refused")
-            return {"metric": "serve7b_int8_implausible", "value": 0.0,
-                    "unit": "error", "vs_baseline": 0.0, "extra": extra}
-    name = ("serve7b_int8_decode_tokens_per_sec" if tpu
+            return {"metric": f"serve7b_{wdtype}_implausible",
+                    "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+                    "extra": extra}
+    name = (f"serve7b_{wdtype}_decode_tokens_per_sec" if tpu
             else "serve7b_smoke_decode_tokens_per_sec")
     return {"metric": name, "value": round(decode_tps, 1),
             "unit": "tokens/s", "vs_baseline": 1.0, "extra": extra}

@@ -72,6 +72,7 @@ from .loss import (  # noqa: F401
     cosine_embedding_loss,
     cross_entropy,
     ctc_loss,
+    rnnt_loss,
     dice_loss,
     gaussian_nll_loss,
     hinge_embedding_loss,

@@ -190,6 +190,99 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return loss
 
 
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-T (transducer) loss.
+
+    Parity: paddle.nn.functional.rnnt_loss (reference: the warprnnt op
+    over the vendored third_party warp_transducer — SURVEY §2.3).
+    ``input``: [B, T, U+1, V] unnormalized joint-network logits
+    (log_softmax applied internally, matching warprnnt); ``label``:
+    [B, U] int; per-sample ``input_lengths`` / ``label_lengths``.
+
+    TPU design: the (t, u) lattice DP is ONE ``lax.scan`` over t. The
+    in-row recurrence alpha[t,u] = logaddexp(alpha[t-1,u] + blank,
+    alpha[t,u-1] + emit) is solved in CLOSED FORM per row: with
+    G_u = prefix-sum of emit scores, x_u = G_u + cumlogsumexp(c - G)_u
+    — no per-u python/scan loop, fully batch-vectorized, static shapes.
+    FastEmit regularization uses warprnnt's exact semantics (emit-arc
+    gradients scaled by 1+lambda) via a value-preserving
+    ``stop_gradient`` reparameterization of the emit scores; the loss
+    VALUE is identical to lambda=0, only gradients change. Backward is
+    autodiff through the scan (the beta recursion, synthesized).
+    """
+    lp = jax.nn.log_softmax(_f32up(_v(input)), axis=-1)
+    label = _v(label).astype(jnp.int32)
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+    B, T, U1, V = lp.shape
+    U = U1 - 1
+    if label.shape[1] != U:
+        raise ValueError(
+            f"label width {label.shape[1]} must equal input's U axis - 1 "
+            f"= {U} (input is [B, T, U+1, V])")
+    neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+    blank_lp = lp[..., blank]  # [B, T, U+1]
+    if U > 0:
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :],
+            jnp.broadcast_to(label[:, None, :, None], (B, T, U, 1)),
+            axis=3,
+        )[..., 0]  # [B, T, U]
+        # tokens past each sample's label length cannot be emitted
+        emit_lp = jnp.where(
+            (jnp.arange(U)[None, :] < label_lengths[:, None])[:, None, :],
+            emit_lp, neg_inf)
+        if fastemit_lambda:
+            # d(loss)/d(emit) scales by (1+lambda); forward value exact
+            lam = float(fastemit_lambda)
+            emit_lp = (emit_lp * (1.0 + lam)
+                       - lax.stop_gradient(emit_lp * lam))
+    else:
+        emit_lp = jnp.zeros((B, T, 0), lp.dtype)
+
+    def row_prefix(e_t):
+        # G[u] = sum of emit scores before u: [B, U+1], G[0] = 0
+        return jnp.concatenate(
+            [jnp.zeros((B, 1), lp.dtype), jnp.cumsum(e_t, axis=1)], axis=1)
+
+    # t = 0: alpha[0, u] = emit-only prefix
+    alpha0 = row_prefix(emit_lp[:, 0])
+
+    def step(alpha_prev, xs):
+        b_prev, e_t = xs  # blank row t-1, emit row t
+        c = alpha_prev + b_prev
+        G = row_prefix(e_t)
+        alpha_t = G + lax.cumlogsumexp(c - G, axis=1)
+        # keep lattice garbage (masked regions) finite, never NaN
+        alpha_t = jnp.maximum(alpha_t, neg_inf)
+        return alpha_t, alpha_t
+
+    if T > 1:
+        xs = (jnp.moveaxis(blank_lp[:, :-1], 1, 0),
+              jnp.moveaxis(emit_lp[:, 1:], 1, 0))
+        _, rows = lax.scan(step, alpha0, xs)
+        alpha = jnp.concatenate([alpha0[None], rows], axis=0)  # [T,B,U+1]
+    else:
+        alpha = alpha0[None]
+
+    # log Z = alpha[T_b-1, U_b] + blank[T_b-1, U_b]
+    t_last = jnp.maximum(input_lengths - 1, 0)
+    a_tb = jnp.take_along_axis(
+        jnp.moveaxis(alpha, 0, 1), t_last[:, None, None],
+        axis=1)[:, 0]  # [B, U+1]
+    a_final = jnp.take_along_axis(
+        a_tb, jnp.minimum(label_lengths, U)[:, None], axis=1)[:, 0]
+    b_final = jnp.take_along_axis(
+        jnp.take_along_axis(
+            blank_lp, t_last[:, None, None], axis=1)[:, 0],
+        jnp.minimum(label_lengths, U)[:, None], axis=1)[:, 0]
+    loss = -(a_final + b_final)
+    # paddle/warprnnt: plain mean over the batch
+    return _reduce_loss(loss, reduction)
+
+
 def _reduce_loss(loss, reduction):
     if reduction == "mean":
         return jnp.mean(loss)

@@ -134,6 +134,26 @@ def _reduce(loss, reduction):
     return loss
 
 
+class RNNTLoss(Layer):
+    """Parity: paddle.nn.RNNTLoss (warprnnt-backed upstream; here a
+    lax.scan + cumlogsumexp lattice DP — see functional.rnnt_loss)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from .. import functional as F
+
+        return F.rnnt_loss(
+            input, label, input_lengths, label_lengths,
+            blank=self.blank, fastemit_lambda=self.fastemit_lambda,
+            reduction=self.reduction,
+        )
+
+
 class CTCLoss(Layer):
     """Parity: paddle.nn.CTCLoss (warpctc-backed upstream; here a
     lax.scan log-semiring recursion — see functional.ctc_loss)."""

@@ -228,3 +228,114 @@ class TestActivationFunctionalForms:
         np.testing.assert_allclose(
             np.asarray(layer(x)),
             np.asarray(F.prelu(x, layer.weight)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# RNN-T loss (parity: warprnnt — SURVEY §2.3)
+# ---------------------------------------------------------------------------
+
+def _rnnt_brute(lp, label, T, U):
+    """Enumerate every monotone lattice path (T-1 blanks interleaved with
+    U emits, final blank) and logsumexp the path scores."""
+    import itertools
+
+    V = lp.shape[-1]
+    paths = []
+    # a path is a sequence of moves: 'b' (t+1) or 'e' (u+1), with
+    # exactly T-1 b-moves and U e-moves in any order, ending with the
+    # final blank at (T-1, U)
+    for moves in set(itertools.permutations("b" * (T - 1) + "e" * U)):
+        t = u = 0
+        s = 0.0
+        for m in moves:
+            if m == "b":
+                s += float(lp[t, u, 0])
+                t += 1
+            else:
+                s += float(lp[t, u, label[u]])
+                u += 1
+        s += float(lp[T - 1, U, 0])  # final blank
+        paths.append(s)
+    m = max(paths)
+    return -(m + np.log(sum(np.exp(p - m) for p in paths)))
+
+
+def test_rnnt_loss_matches_brute_force():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    B, T, U, V = 2, 3, 2, 5
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    label = np.array([[1, 3], [2, 2]], np.int32)
+    got = F.rnnt_loss(jnp.asarray(logits), jnp.asarray(label),
+                      np.array([T, T]), np.array([U, U]),
+                      blank=0, fastemit_lambda=0.0, reduction="none")
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    want = [_rnnt_brute(lp[b], label[b], T, U) for b in range(B)]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_rnnt_loss_variable_lengths():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(1)
+    B, T, U, V = 2, 4, 3, 6
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    label = rng.integers(1, V, (B, U)).astype(np.int32)
+    # sample 1 uses a shorter lattice (T=2, U=1): must equal the brute
+    # force on the TRUNCATED lattice, regardless of padding content
+    got = F.rnnt_loss(jnp.asarray(logits), jnp.asarray(label),
+                      np.array([T, 2]), np.array([U, 1]),
+                      blank=0, fastemit_lambda=0.0, reduction="none")
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    want0 = _rnnt_brute(lp[0], label[0], T, U)
+    want1 = _rnnt_brute(lp[1], label[1], 2, 1)
+    np.testing.assert_allclose(np.asarray(got), [want0, want1], rtol=1e-5)
+
+
+def test_rnnt_loss_gradients_and_fastemit():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    B, T, U, V = 1, 3, 2, 4
+    logits = jnp.asarray(rng.standard_normal((B, T, U + 1, V)), jnp.float32)
+    label = jnp.asarray([[1, 2]], jnp.int32)
+    il, ll = np.array([T]), np.array([U])
+
+    def loss(x, lam):
+        return F.rnnt_loss(x, label, il, ll, fastemit_lambda=lam,
+                           reduction="sum")
+
+    # finite-difference check at lambda=0
+    g = jax.grad(loss)(logits, 0.0)
+    eps = 1e-3
+    for idx in [(0, 0, 0, 1), (0, 1, 1, 0), (0, 2, 2, 3)]:
+        lp_ = logits.at[idx].add(eps)
+        lm_ = logits.at[idx].add(-eps)
+        fd = (float(loss(lp_, 0.0)) - float(loss(lm_, 0.0))) / (2 * eps)
+        np.testing.assert_allclose(float(g[idx]), fd, rtol=2e-3, atol=2e-4)
+
+    # FastEmit: identical VALUE, different gradients (emit arcs scaled)
+    v0, v1 = float(loss(logits, 0.0)), float(loss(logits, 0.5))
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)
+    g1 = jax.grad(loss)(logits, 0.5)
+    assert not np.allclose(np.asarray(g), np.asarray(g1))
+
+
+def test_rnnt_loss_layer_and_empty_label():
+    import paddle_tpu as pt
+
+    rng = np.random.default_rng(3)
+    layer = pt.nn.RNNTLoss(blank=0, fastemit_lambda=0.0, reduction="mean")
+    B, T, U, V = 2, 3, 2, 4
+    logits = jnp.asarray(rng.standard_normal((B, T, U + 1, V)), jnp.float32)
+    label = jnp.asarray([[1, 2], [3, 1]], jnp.int32)
+    out = layer(logits, label, np.array([T, T]), np.array([U, 0]))
+    assert np.isfinite(float(out))
+    # empty-label sample = pure blank path
+    lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    import paddle_tpu.nn.functional as F
+    per = F.rnnt_loss(logits, label, np.array([T, T]), np.array([U, 0]),
+                      reduction="none")
+    want1 = -sum(lp[1, t, 0, 0] for t in range(T))
+    np.testing.assert_allclose(float(per[1]), want1, rtol=1e-5)
