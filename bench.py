@@ -535,11 +535,14 @@ def _maybe_write_baseline(result):
     secondary = result.get("extra", {}).get("secondary", {})
     base_sec = base.setdefault("secondary", {})
     for name, r in secondary.items():
-        if (name not in base_sec and r.get("unit") not in
+        # keyed by METRIC, not config name: a config with variants
+        # (serve7b int8 vs int4) must never cross-compare dtypes
+        key = r.get("metric", name)
+        if (key not in base_sec and r.get("unit") not in
                 ("error", "skipped") and
                 r.get("extra", {}).get("platform") == "tpu"):
-            base_sec[name] = {"metric": r["metric"], "value": r["value"],
-                              "unit": r["unit"]}
+            base_sec[key] = {"metric": r["metric"], "value": r["value"],
+                             "unit": r["unit"]}
             changed = True
     if changed:
         with open(BASELINE_PATH, "w") as f:
@@ -559,8 +562,10 @@ def _apply_baseline_ratio(result):
         except Exception:
             pass
     for name, r in result.get("extra", {}).get("secondary", {}).items():
-        b = base.get("secondary", {}).get(name)
-        if (b and r.get("extra", {}).get("platform") == "tpu"
+        sec = base.get("secondary", {})
+        b = sec.get(r.get("metric")) or sec.get(name)
+        if (b and b.get("metric") == r.get("metric")
+                and r.get("extra", {}).get("platform") == "tpu"
                 and r.get("value")):
             r["vs_baseline"] = round(r["value"] / float(b["value"]), 3)
 

@@ -68,9 +68,18 @@ class ContinuousBatchingEngine:
     ``models/llama.py`` implements.
     """
 
-    def __init__(self, model: Layer, config: Optional[EngineConfig] = None):
+    def __init__(self, model: Layer, config: Optional[EngineConfig] = None,
+                 mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``tp`` axis —
+        tensor-parallel serving (parity: the reference's multi-GPU
+        FastDeploy/fleet predictor). Params shard by their logical
+        ``Parameter.spec`` (Column/RowParallelLinear carry tp specs);
+        KV caches shard the kv-head axis; every compiled program runs
+        under the mesh and GSPMD inserts the TP collectives. Requires
+        num_key_value_heads divisible by the tp degree."""
         self.model = model
         self.cfg = config or EngineConfig()
+        self.mesh = mesh
         model.eval()
         self.params = extract_params(model)
         # buffers (rope tables, int8/int4 qweights+scales after
@@ -78,6 +87,42 @@ class ContinuousBatchingEngine:
         # constants — a 7B int8 model would otherwise bake ~7 GB of
         # weights into every compiled program
         self.buffers = extract_buffers(model)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..core.functional import extract_param_objs
+            from ..distributed.sharding import (
+                _filter_spec_for_mesh,
+                param_partition_spec,
+            )
+            from ..distributed.strategy import DistributedStrategy
+
+            strat = DistributedStrategy()  # logical specs only, no fsdp
+            objs = extract_param_objs(model)
+            self.params = {
+                n: jax.device_put(v, NamedSharding(mesh, P(
+                    *_filter_spec_for_mesh(
+                        tuple(param_partition_spec(
+                            n, v.shape, objs[n].spec, strat)), mesh))))
+                for n, v in self.params.items()
+            }
+            # buffers replicate (rope tables; TP-sharded quantized
+            # serving would thread specs here)
+            repl = NamedSharding(mesh, P())
+            self.buffers = {n: jax.device_put(v, repl)
+                            for n, v in self.buffers.items()}
+            # rebind the Layer tree to the placed arrays: keeping the
+            # original single-device copies alive would hold the WHOLE
+            # model on device 0 next to its 1/tp shard — an OOM exactly
+            # when the model needs TP to fit
+            for n, obj in objs.items():
+                obj.value = self.params[n]
+            owners = dict(model.named_sublayers(include_self=True))
+            for n, v in self.buffers.items():
+                mod_name, _, bname = n.rpartition(".")
+                sub = owners.get(mod_name)
+                if sub is not None and bname in sub._buffers:
+                    sub._buffers[bname] = v
         self._pb = {"p": self.params, "b": self.buffers}
         cfg = self.cfg
 
@@ -111,16 +156,43 @@ class ContinuousBatchingEngine:
             self.layer_caches = init_paged_pool(
                 self._n_layers, n_pages, cfg.page_size, kvh, hd,
                 dtype=cfg.cache_dtype)
+            if mesh is not None:
+                self.layer_caches = [
+                    PagedLayerCache(self._shard_kv(c.k_pages),
+                                    self._shard_kv(c.v_pages))
+                    for c in self.layer_caches]
         else:
             self.pool = None
             self.caches = model.init_kv_caches(
                 cfg.max_slots, cfg.max_len, dtype=cfg.cache_dtype)
+            if mesh is not None:
+                self.caches = [
+                    (self._shard_kv(k), self._shard_kv(v))
+                    for k, v in self.caches]
 
         self._decode_c = None
         self._decode_nc = None
         self._prefill_c = None
         self._insert_c = None
         self._scatter_c = None
+
+    def _shard_kv(self, arr):
+        """[..., kv_heads, head_dim] cache: shard the kv-head axis
+        over tp (requires kv_heads % tp == 0)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = [None] * arr.ndim
+        spec[-2] = "tp"
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+    def _ctx(self):
+        import contextlib
+
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from ..distributed.sharding import mesh_context
+
+        return mesh_context(self.mesh)
 
     # ---------------- request lifecycle ----------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -327,16 +399,17 @@ class ContinuousBatchingEngine:
             one_caches = self.model.init_kv_caches(
                 1, bucket, dtype=self.cfg.cache_dtype)
             self._key, sub = jax.random.split(self._key)
-            first_dev, filled = self._prefill()(
-                self._pb, jnp.asarray(padded, jnp.int32), one_caches,
-                n - 1, sub)
-            if self.cfg.paged:
-                self.layer_caches = self._scatter_paged()(
-                    self.layer_caches, filled,
-                    jnp.asarray(self.pool.block_tables[slot]))
-            else:
-                self.caches = self._insert_contig()(
-                    self.caches, filled, slot)
+            with self._ctx():
+                first_dev, filled = self._prefill()(
+                    self._pb, jnp.asarray(padded, jnp.int32), one_caches,
+                    n - 1, sub)
+                if self.cfg.paged:
+                    self.layer_caches = self._scatter_paged()(
+                        self.layer_caches, filled,
+                        jnp.asarray(self.pool.block_tables[slot]))
+                else:
+                    self.caches = self._insert_contig()(
+                        self.caches, filled, slot)
             # mark the slot taken now so the next iteration can't hand
             # it out again; lengths/last_tok land at integrate
             self.active[slot] = True
@@ -384,15 +457,16 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
-        if self.cfg.paged:
-            state = PagedState(
-                block_tables=jnp.asarray(self.pool.block_tables),
-                seq_lens=lens)
-            nxt, self.layer_caches = self._decode()(
-                self._pb, toks, self.layer_caches, state, sub)
-        else:
-            nxt, self.caches = self._decode()(
-                self._pb, toks, self.caches, lens, sub)
+        with self._ctx():
+            if self.cfg.paged:
+                state = PagedState(
+                    block_tables=jnp.asarray(self.pool.block_tables),
+                    seq_lens=lens)
+                nxt, self.layer_caches = self._decode()(
+                    self._pb, toks, self.layer_caches, state, sub)
+            else:
+                nxt, self.caches = self._decode()(
+                    self._pb, toks, self.caches, lens, sub)
         nxt = np.asarray(nxt)
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
@@ -447,9 +521,10 @@ class ContinuousBatchingEngine:
         bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
               else jnp.zeros((1,), jnp.int32))
         caches = self.layer_caches if self.cfg.paged else self.caches
-        toks_all, caches, _ = self._decode_n()(
-            self._pb, toks, caches, lens, act, jnp.asarray(budget),
-            bt, sub, K)
+        with self._ctx():
+            toks_all, caches, _ = self._decode_n()(
+                self._pb, toks, caches, lens, act, jnp.asarray(budget),
+                bt, sub, K)
         if self.cfg.paged:
             self.layer_caches = caches
         else:
