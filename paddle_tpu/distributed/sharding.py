@@ -164,11 +164,18 @@ def model_shardings(
     model,
     mesh: Mesh,
     strategy: DistributedStrategy,
+    filter_to_mesh: bool = False,
 ) -> Dict[str, NamedSharding]:
-    """NamedSharding per parameter (keys = qualified names)."""
+    """NamedSharding per parameter (keys = qualified names).
+
+    ``filter_to_mesh``: drop logical axes the mesh doesn't carry (the
+    serving engine's placement path — the same model runs under any
+    topology)."""
     out = {}
     for name, p in model.named_parameters():
         spec = param_partition_spec(name, p.shape, p.spec, strategy)
+        if filter_to_mesh:
+            spec = P(*_filter_spec_for_mesh(tuple(spec), mesh))
         out[name] = NamedSharding(mesh, spec)
     return out
 

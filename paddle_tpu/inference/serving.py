@@ -91,19 +91,25 @@ class ContinuousBatchingEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ..core.functional import extract_param_objs
-            from ..distributed.sharding import (
-                _filter_spec_for_mesh,
-                param_partition_spec,
-            )
+            from ..distributed.sharding import model_shardings
             from ..distributed.strategy import DistributedStrategy
 
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    f"tensor-parallel serving needs a mesh with a 'tp' "
+                    f"axis; got axes {mesh.axis_names}")
+            tp = mesh.shape["tp"]
+            kvh = model.config.num_key_value_heads
+            if kvh % tp:
+                raise ValueError(
+                    f"num_key_value_heads={kvh} not divisible by tp "
+                    f"degree {tp} — KV caches shard the kv-head axis")
             strat = DistributedStrategy()  # logical specs only, no fsdp
             objs = extract_param_objs(model)
+            shardings = model_shardings(model, mesh, strat,
+                                        filter_to_mesh=True)
             self.params = {
-                n: jax.device_put(v, NamedSharding(mesh, P(
-                    *_filter_spec_for_mesh(
-                        tuple(param_partition_spec(
-                            n, v.shape, objs[n].spec, strat)), mesh))))
+                n: jax.device_put(v, shardings[n])
                 for n, v in self.params.items()
             }
             # buffers replicate (rope tables; TP-sharded quantized

@@ -21,6 +21,7 @@ threads), so there the summary degrades gracefully with a note.
 from __future__ import annotations
 
 import glob
+import re
 import gzip
 import json
 import os
@@ -72,8 +73,32 @@ def categorize(op_name: str, hlo_category: str = "") -> str:
         return "matmul/conv"
     if any(m in n for m in _INFEED_MARKERS):
         return "infeed/outfeed"
+    # name the long tail (round-4 capture left 16.2% as one opaque
+    # "other" bucket): XLA fusion names concatenate their root ops, so
+    # substring heuristics attribute most of it. scatter/gather outranks
+    # the copy markers ("dynamic-update-slice" is a cache write, not a
+    # layout copy).
+    if any(m in n for m in ("scatter", "gather", "dynamic-update",
+                            "dynamic_update", "dynamic-slice",
+                            "dynamic_slice")):
+        return "scatter/gather/slice"
     if any(m in n for m in _COPY_MARKERS):
         return "copy/layout"
+    if "rng" in n or "random" in n:
+        return "rng"
+    if "reduce" in n:
+        return "reduce"
+    if "transpose" in n or "reshape" in n:
+        return "transpose/reshape"
+    # short markers match whole NAME TOKENS only — substring matching
+    # would book sort/xor/floor under elementwise via "or"
+    tokens = set(re.split(r"[._\-0-9]+", n))
+    if tokens & {"add", "mul", "multiply", "sub", "subtract", "div",
+                 "divide", "exp", "tanh", "select", "convert", "compare",
+                 "max", "maximum", "min", "minimum", "broadcast", "iota",
+                 "clamp", "rsqrt", "log", "power", "and", "or", "not",
+                 "sign", "loop"}:
+        return "elementwise"
     return "other"
 
 

@@ -274,3 +274,21 @@ def test_profiler_summary_with_real_trace(tmp_path):
     text = prof.summary()
     assert "step time summary" in text
     assert ("no device op events" in text) or ("device op summary" in text)
+
+
+def test_xplane_long_tail_categories():
+    """The round-4 capture left 16.2% of device time as one opaque
+    'other' bucket; fusion-name heuristics must attribute the tail."""
+    from paddle_tpu.profiler.xplane import categorize
+
+    assert categorize("loop_add_fusion.3") == "elementwise"
+    assert categorize("wrapped_convert") == "elementwise"
+    assert categorize("fused_reduce.1") == "reduce"
+    assert categorize("scatter.42") == "scatter/gather/slice"
+    assert categorize("dynamic-update-slice.7") == "scatter/gather/slice"
+    assert categorize("rng_bit_generator") == "rng"
+    # hlo_category still wins over name heuristics
+    assert categorize("loop_add_fusion", "convolution fusion") \
+        == "matmul/conv"
+    # truly unknown stays honest
+    assert categorize("fusion.99") == "other"
