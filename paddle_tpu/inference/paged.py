@@ -3,10 +3,15 @@ phi ``masked_multihead_attention`` / ``fused_multi_transformer``'s
 contiguous per-sequence caches — upgraded to a vLLM-style page pool).
 
 TPU-native design: XLA needs static shapes, so the pool is a fixed
-tensor ``[n_pages, page_size, kv_heads, head_dim]`` per layer and the
+tensor ``[kv_heads, n_pages, page_size, head_dim]`` per layer and the
 indirection is data: a ``block_table`` [slots, max_pages] of page ids
 and per-slot ``seq_lens``. Gathers over the page axis compile to
 efficient dynamic-gathers; no recompilation as sequences come and go.
+The pool is HEAD-MAJOR: one (head, page) block is contiguous with minor
+dims (page_size, head_dim), which is what the Pallas decode kernel's
+per-step DMA needs (TPU tiles the last two dims — a head-minor pool
+would make the per-head slice strided and un-lowerable), and it puts
+the tensor-parallel sharding axis (kv heads) first.
 The win over per-slot contiguous caches is oversubscription: the pool
 holds ``n_pages × page_size`` tokens total, which can be far less than
 ``slots × max_len`` when sequence lengths vary — the same HBM savings
@@ -29,8 +34,8 @@ import numpy as np
 class PagedLayerCache(NamedTuple):
     """Per-layer page pool + indirection (all device arrays)."""
 
-    k_pages: jax.Array  # [n_pages, page_size, kv_heads, head_dim]
-    v_pages: jax.Array  # [n_pages, page_size, kv_heads, head_dim]
+    k_pages: jax.Array  # [kv_heads, n_pages, page_size, head_dim]
+    v_pages: jax.Array  # [kv_heads, n_pages, page_size, head_dim]
 
 
 class PagedState(NamedTuple):
@@ -44,9 +49,9 @@ def init_paged_pool(n_layers: int, n_pages: int, page_size: int,
                     kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
     return [
         PagedLayerCache(
-            k_pages=jnp.zeros((n_pages, page_size, kv_heads, head_dim),
+            k_pages=jnp.zeros((kv_heads, n_pages, page_size, head_dim),
                               dtype),
-            v_pages=jnp.zeros((n_pages, page_size, kv_heads, head_dim),
+            v_pages=jnp.zeros((kv_heads, n_pages, page_size, head_dim),
                               dtype),
         )
         for _ in range(n_layers)
@@ -61,16 +66,18 @@ def append_kv(cache: PagedLayerCache, state: PagedState, k, v
     page ``block_tables[i, len_i // page_size]`` offset ``len_i %
     page_size`` — a scatter with computed indices, fully inside jit.
     """
-    page_size = cache.k_pages.shape[1]
+    page_size = cache.k_pages.shape[2]
     slots = k.shape[0]
     lens = state.seq_lens
     page_idx = lens // page_size
     offs = lens % page_size
     pages = state.block_tables[jnp.arange(slots), page_idx]  # [slots]
-    k_pages = cache.k_pages.at[pages, offs].set(
-        k[:, 0].astype(cache.k_pages.dtype))
-    v_pages = cache.v_pages.at[pages, offs].set(
-        v[:, 0].astype(cache.v_pages.dtype))
+    # destination [kvh, pages[i], offs[i]] <- k[i, 0, h]: value laid out
+    # head-major to match the pool
+    k_pages = cache.k_pages.at[:, pages, offs].set(
+        k[:, 0].astype(cache.k_pages.dtype).transpose(1, 0, 2))
+    v_pages = cache.v_pages.at[:, pages, offs].set(
+        v[:, 0].astype(cache.v_pages.dtype).transpose(1, 0, 2))
     return PagedLayerCache(k_pages, v_pages)
 
 
@@ -80,11 +87,12 @@ def gather_kv(cache: PagedLayerCache, state: PagedState
     where max_ctx = max_pages * page_size (mask handles the tail)."""
     bt = state.block_tables  # [slots, max_pages]
     slots, max_pages = bt.shape
-    _, page_size, kvh, d = cache.k_pages.shape
-    k = cache.k_pages[bt]  # [slots, max_pages, page_size, kvh, d]
-    v = cache.v_pages[bt]
-    return (k.reshape(slots, max_pages * page_size, kvh, d),
-            v.reshape(slots, max_pages * page_size, kvh, d))
+    kvh, _, page_size, d = cache.k_pages.shape
+    k = cache.k_pages[:, bt]  # [kvh, slots, max_pages, page_size, d]
+    v = cache.v_pages[:, bt]
+    k = k.reshape(kvh, slots, max_pages * page_size, d)
+    v = v.reshape(kvh, slots, max_pages * page_size, d)
+    return (k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3))
 
 
 def _use_pallas_decode(cache: PagedLayerCache) -> bool:
@@ -92,7 +100,7 @@ def _use_pallas_decode(cache: PagedLayerCache) -> bool:
 
     import jax as _jax
 
-    page_size, d = cache.k_pages.shape[1], cache.k_pages.shape[3]
+    page_size, d = cache.k_pages.shape[2], cache.k_pages.shape[3]
     aligned = d % 128 == 0 and page_size % 16 == 0
     if os.environ.get("PADDLE_TPU_FORCE_PALLAS"):
         return aligned
@@ -114,7 +122,7 @@ def paged_attention(q, cache: PagedLayerCache, state: PagedState,
     slots × max_ctx of the dense gather fallback below.
     """
     slots, one, h, d = q.shape
-    kvh_ = cache.k_pages.shape[2]
+    kvh_ = cache.k_pages.shape[0]
     if _use_pallas_decode(cache) and h % kvh_ == 0:
         from ..kernels.paged_attention import paged_decode_attention
 

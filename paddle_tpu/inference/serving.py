@@ -164,8 +164,8 @@ class ContinuousBatchingEngine:
                 dtype=cfg.cache_dtype)
             if mesh is not None:
                 self.layer_caches = [
-                    PagedLayerCache(self._shard_kv(c.k_pages),
-                                    self._shard_kv(c.v_pages))
+                    PagedLayerCache(self._shard_kv(c.k_pages, axis=0),
+                                    self._shard_kv(c.v_pages, axis=0))
                     for c in self.layer_caches]
         else:
             self.pool = None
@@ -182,13 +182,14 @@ class ContinuousBatchingEngine:
         self._insert_c = None
         self._scatter_c = None
 
-    def _shard_kv(self, arr):
-        """[..., kv_heads, head_dim] cache: shard the kv-head axis
-        over tp (requires kv_heads % tp == 0)."""
+    def _shard_kv(self, arr, axis=-2):
+        """Shard the kv-head axis over tp (requires kv_heads % tp == 0):
+        axis -2 for contiguous [..., kv_heads, head_dim] caches, axis 0
+        for the head-major paged pool."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         spec = [None] * arr.ndim
-        spec[-2] = "tp"
+        spec[axis] = "tp"
         return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
     def _ctx(self):
@@ -276,12 +277,15 @@ class ContinuousBatchingEngine:
                 for cache, (ok, ov) in zip(layer_caches, one_caches):
                     n_used = ok.shape[1] // ps
                     pages = bt_row[:n_used]
-                    okp = ok[0].reshape(n_used, ps, *ok.shape[2:])
-                    ovp = ov[0].reshape(n_used, ps, *ov.shape[2:])
+                    # [1, bucket, kvh, d] -> head-major [kvh, n_used, ps, d]
+                    okp = ok[0].reshape(n_used, ps, *ok.shape[2:]) \
+                        .transpose(2, 0, 1, 3)
+                    ovp = ov[0].reshape(n_used, ps, *ov.shape[2:]) \
+                        .transpose(2, 0, 1, 3)
                     out.append(PagedLayerCache(
-                        cache.k_pages.at[pages].set(
+                        cache.k_pages.at[:, pages].set(
                             okp.astype(cache.k_pages.dtype)),
-                        cache.v_pages.at[pages].set(
+                        cache.v_pages.at[:, pages].set(
                             ovp.astype(cache.v_pages.dtype)),
                     ))
                 return out

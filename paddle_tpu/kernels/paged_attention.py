@@ -56,8 +56,8 @@ def _decode_kernel(bt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j <= last_page)
     def _step():
         q = q_ref[0, 0].astype(jnp.float32)  # [group_pad, d]
-        k = k_ref[:, 0]  # [page_size, d]
-        v = v_ref[:, 0]
+        k = k_ref[...]  # [page_size, d]
+        v = v_ref[...]
         sc = jax.lax.dot_general(
             q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -93,13 +93,19 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            scale=None):
     """q: [slots, kv_heads, group, d] (one decode token per slot).
 
-    k_pages/v_pages: [n_pages, page_size, kv_heads, d].
+    k_pages/v_pages: [kv_heads, n_pages, page_size, d] — head-major, the
+    TPU-tileable layout: the per-grid-step block is one head's one page,
+    so the block's LAST TWO dims are (page_size, d) = full tiled minor
+    dims. (A head-minor pool [pages, page_size, kvh, d] cannot lower:
+    selecting 1 of kvh in the sublane dim is a strided DMA the Mosaic
+    lowering rejects — found the first time a 32-kv-head 7B model hit
+    real silicon; small models with kvh==1 never trip it.)
     block_tables: [slots, max_pages] int32; seq_lens: [slots] int32 —
     slot i attends to positions [0, seq_lens[i]] inclusive.
     Returns [slots, kv_heads, group, d].
     """
     slots, kvh, group, d = q.shape
-    n_pages, page_size, _, _ = k_pages.shape
+    _, n_pages, page_size, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = d ** -0.5
@@ -116,15 +122,15 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         # clamp to the slot's last active page: pruned steps revisit the
         # previous block, so no DMA is issued for them
         last = lens_ref[s] // page_size
-        return (bt_ref[s, jnp.minimum(j, last)], 0, h, 0)
+        return (h, bt_ref[s, jnp.minimum(j, last)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, kvh, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, group_pad, d), q_index),
-            pl.BlockSpec((None, page_size, 1, d), kv_index),
-            pl.BlockSpec((None, page_size, 1, d), kv_index),
+            pl.BlockSpec((None, None, page_size, d), kv_index),
+            pl.BlockSpec((None, None, page_size, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, group_pad, d), q_index),
         scratch_shapes=[

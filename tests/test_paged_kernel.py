@@ -41,9 +41,9 @@ def test_paged_decode_matches_dense(group):
     page_size, n_pages, max_pages = 16, 32, 4
 
     k_pages = jnp.asarray(
-        rng.standard_normal((n_pages, page_size, kvh, d)), jnp.float32)
+        rng.standard_normal((kvh, n_pages, page_size, d)), jnp.float32)
     v_pages = jnp.asarray(
-        rng.standard_normal((n_pages, page_size, kvh, d)), jnp.float32)
+        rng.standard_normal((kvh, n_pages, page_size, d)), jnp.float32)
     # distinct page ids per slot (vLLM-style arbitrary mapping)
     bt = jnp.asarray(
         rng.permutation(n_pages)[: slots * max_pages].reshape(
@@ -71,9 +71,9 @@ def test_paged_attention_api_uses_kernel(monkeypatch):
     slots, kvh, h, d = 2, 2, 4, 128
     page_size, n_pages, max_pages = 16, 8, 2
     k_pages = jnp.asarray(
-        rng.standard_normal((n_pages, page_size, kvh, d)), jnp.float32)
+        rng.standard_normal((kvh, n_pages, page_size, d)), jnp.float32)
     v_pages = jnp.asarray(
-        rng.standard_normal((n_pages, page_size, kvh, d)), jnp.float32)
+        rng.standard_normal((kvh, n_pages, page_size, d)), jnp.float32)
     bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
     lens = jnp.asarray([20, 5], jnp.int32)
     cache = pg.PagedLayerCache(k_pages, v_pages)
