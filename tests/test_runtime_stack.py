@@ -292,3 +292,34 @@ def test_xplane_long_tail_categories():
         == "matmul/conv"
     # truly unknown stays honest
     assert categorize("fusion.99") == "other"
+
+
+def test_xplane_long_name_attribution():
+    """Anonymous fusion.N events carry the HLO text in long_name; the
+    round-5 headline's 12.9% 'other' decoded into AdamW master updates
+    and the embedding-grad scatter this way."""
+    from paddle_tpu.profiler.xplane import categorize
+
+    adamw = ("%fusion.23 = (f32[32000,3072]{1,0}, f32[32000,3072]{1,0}) "
+             "fusion(f32[32000,3072]{1,0} "
+             "%opt_state__master____model_embed_tokens_weight__.1, "
+             "f32[] %sub.427), kind=kLoop, calls=%fused_computation.9")
+    assert categorize("fusion.23", "loop fusion", adamw) \
+        == "optimizer update"
+    scatter = ("%fusion.2 = bf16[32000,3072]{1,0} fusion(s32[8192]{0} "
+               "%gte, bf16[8192,3072]{1,0} %b), kind=kCustom, "
+               "calls=%scatter_computation")
+    assert categorize("fusion.2", "custom fusion", scatter) \
+        == "scatter/gather/slice"
+    # an elementwise fusion CONSUMING an all-gather output (TP trace)
+    # must not be booked as scatter/gather
+    tp = ("%fusion.7 = bf16[4,2048,3072]{2,1,0} fusion(bf16[...] "
+          "%all-gather.5, bf16[...] %model_embed_tokens_weight), "
+          "kind=kLoop, calls=%fused_computation.3")
+    assert categorize("fusion.7", "loop fusion", tp) == "other"
+    # a NAMED op never defers to long_name (its own tokens win)
+    assert categorize("loop_add_fusion.3", "", adamw) == "elementwise"
+    # anonymous fusion with uninformative long_name stays honest
+    assert categorize("fusion.99", "loop fusion",
+                      "%fusion.99 = f32[8,8] fusion(f32[8,8] %x)") \
+        == "other"
