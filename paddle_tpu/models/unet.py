@@ -223,7 +223,11 @@ class UNet2DConditionModel(Layer):
 
     def forward(self, sample, timestep, encoder_hidden_states):
         """sample [b, c, h, w]; timestep [b]; context [b, s, ctx_dim]."""
+        # the sinusoidal table is fp32 for accuracy; cast to the compute
+        # dtype before it meets activations, or one add would silently
+        # promote every downstream conv to fp32 under bf16 training
         temb = timestep_embedding(timestep, self.time_proj_dim)
+        temb = temb.astype(self.time_embedding1.weight.value.dtype)
         temb = self.time_embedding2(F.silu(self.time_embedding1(temb)))
 
         h = self.conv_in(sample)

@@ -29,8 +29,6 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
     ).astype(x.dtype)
     if bias is not None:
         shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
@@ -40,7 +38,13 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
-    """Weight layout [out_c, in_c/groups, kh, kw] (paddle convention)."""
+    """Weight layout [out_c, in_c/groups, kh, kw] (paddle convention).
+
+    bf16 convs run without ``preferred_element_type``: the TPU MXU
+    accumulates bf16 partial products in fp32 natively, and requesting
+    an f32 result breaks reverse-mode AD (the transpose rule feeds the
+    f32 cotangent and bf16 weight into a gradient conv, and
+    ``conv_general_dilated`` rejects mixed operand dtypes)."""
     x, weight = _v(x), _v(weight)
     if isinstance(stride, int):
         stride = (stride, stride)
@@ -61,7 +65,6 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     y = y.astype(x.dtype)
     if bias is not None:
@@ -92,8 +95,6 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
     ).astype(x.dtype)
     if bias is not None:
         shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" \
@@ -143,8 +144,6 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None,
     ).astype(x.dtype)
     if bias is not None:
         shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)

@@ -78,11 +78,12 @@ def categorize(op_name: str, hlo_category: str = "",
         ln = long_name.lower()
         if "opt_state" in ln or "__master__" in ln:
             return "optimizer update"
-        # scatter/gather as HLO op/computation names only — a bare
-        # substring would claim any fusion whose OPERANDS come from an
-        # %all-gather, or that reads the embedding weight (TP traces)
-        if re.search(r"%(scatter|gather)[_.\d]", ln) \
-                or "scatter_computation" in ln or "gather_computation" in ln:
+        # scatter/gather only when the fusion's OWN computation says so
+        # (calls=%scatter_computation / a root-level scatter(...) call).
+        # Operand references (%gather.12 feeding a loop fusion, or an
+        # %all-gather input in TP traces) must not claim the event.
+        if re.search(r"(scatter|gather)_computation", ln) \
+                or re.search(r"=\s*\S+\s+(scatter|gather)\(", ln):
             return "scatter/gather/slice"
     if any(m in n for m in _COLLECTIVE_MARKERS):
         return "collective"

@@ -317,6 +317,11 @@ def test_xplane_long_name_attribution():
           "%all-gather.5, bf16[...] %model_embed_tokens_weight), "
           "kind=kLoop, calls=%fused_computation.3")
     assert categorize("fusion.7", "loop fusion", tp) == "other"
+    # ...nor a fusion merely fed by a standalone %gather.12 output
+    fed = ("%fusion.8 = bf16[4,2048]{1,0} fusion(bf16[8,2048]{1,0} "
+           "%gather.12, bf16[4,2048]{1,0} %y), kind=kLoop, "
+           "calls=%fused_computation.4")
+    assert categorize("fusion.8", "loop fusion", fed) == "other"
     # a NAMED op never defers to long_name (its own tokens win)
     assert categorize("loop_add_fusion.3", "", adamw) == "elementwise"
     # anonymous fusion with uninformative long_name stays honest
