@@ -228,15 +228,28 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.0,
-                 grad_clip=None, multi_precision=True, lazy_mode=False, **kw):
+                 grad_clip=None, multi_precision=True, lazy_mode=False,
+                 moment_dtype=None, **kw):
+        """``moment_dtype``: storage dtype for the m/v slots (default
+        fp32, the reference's fused_adamw layout). ``bfloat16`` is the
+        TPU bandwidth option: the update step is pure HBM traffic (the
+        876M headline measured it at roofline, 10% of step time), and
+        halving moment bytes cuts that traffic ~29% and residency by
+        4 bytes/param. Math still runs in fp32 — only storage rounds;
+        bf16 keeps fp32's exponent range so v never under/overflows,
+        and the ~0.4% mantissa rounding on the EMAs is noise relative
+        to grad stochasticity (see test_optimizer bf16-moment
+        convergence parity)."""
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, **kw)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.moment_dtype = (jnp.dtype(moment_dtype) if moment_dtype
+                             else jnp.float32)
 
     def _init_slot(self, p):
         return {
-            "moment1": jnp.zeros(p.shape, jnp.float32),
-            "moment2": jnp.zeros(p.shape, jnp.float32),
+            "moment1": jnp.zeros(p.shape, self.moment_dtype),
+            "moment2": jnp.zeros(p.shape, self.moment_dtype),
         }
 
     def _decoupled(self):
@@ -245,15 +258,19 @@ class Adam(Optimizer):
     def _apply(self, lr, step, name, pf, gf, slots, decay):
         if decay and not self._decoupled():
             gf = gf + decay * pf  # L2-style (Adam)
-        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * gf
-        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(gf)
+        m = self.beta1 * slots["moment1"].astype(jnp.float32) \
+            + (1 - self.beta1) * gf
+        v = self.beta2 * slots["moment2"].astype(jnp.float32) \
+            + (1 - self.beta2) * jnp.square(gf)
         stepf = step.astype(jnp.float32)
         mhat = m / (1 - jnp.power(self.beta1, stepf))
         vhat = v / (1 - jnp.power(self.beta2, stepf))
         upd = mhat / (jnp.sqrt(vhat) + self.epsilon)
         if decay and self._decoupled():
             upd = upd + decay * pf  # decoupled (AdamW)
-        return pf - lr * upd, {"moment1": m, "moment2": v}
+        dt = self.moment_dtype
+        return pf - lr * upd, {"moment1": m.astype(dt),
+                               "moment2": v.astype(dt)}
 
 
 class AdamW(Adam):
@@ -264,10 +281,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  grad_clip=None, multi_precision=True,
-                 apply_decay_param_fun=None, **kw):
+                 apply_decay_param_fun=None, moment_dtype=None, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, multi_precision,
-                         apply_decay_param_fun=apply_decay_param_fun, **kw)
+                         apply_decay_param_fun=apply_decay_param_fun,
+                         moment_dtype=moment_dtype, **kw)
 
     def _decoupled(self):
         return True
