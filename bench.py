@@ -677,6 +677,16 @@ def _child_main(config):
     do NOT heartbeat: while the parent lives they are not orphan-
     matchable, and after a parent crash a wedged child must be
     immediately reapable."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # direct `--config X` invocations don't pass through the
+        # parent's env scrub, and sitecustomize registers the axon
+        # plugin at interpreter BOOT — before any code here can unset
+        # env. The config route works post-registration (same as
+        # tests/conftest.py): pin the platform before first backend use
+        # or jax.devices() blocks for minutes on the wedged tunnel.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     _enable_compile_cache()
     tpu_diags = None
     if os.environ.get("_BENCH_DIAGS"):

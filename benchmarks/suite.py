@@ -249,14 +249,21 @@ def bench_mamba(tpu_diags):
                    extra, tpu_diags)
 
 
-def _run_load(eng, prompts, new_tokens, gap, max_chunk, chunked=True):
+PROBE_CHUNK = 2  # step_adaptive's short-chunk size; warmup compiles it
+
+
+def _run_load(eng, prompts, new_tokens, gap, max_chunk, mode="chunked"):
     """One steady-arrival load sweep. A new request lands every ``gap``
     seconds while earlier ones decode; returns TTFT percentiles and the
-    served-token throughput over the window. ``chunked=False`` is the
-    head-of-line CONTROL: decode granularity stays identical (same
-    K-step chunks), but admission prefills BLOCK the loop instead of
-    overlapping the in-flight chunk — isolating exactly what the
-    overlapped-admission scheduler buys."""
+    served-token throughput over the window. Modes:
+    ``chunked`` — fixed-K chunks, admission overlapped behind them;
+    ``blocking`` — head-of-line CONTROL: same K-step chunks, but
+    admission prefills BLOCK the loop instead of overlapping (isolates
+    what the overlapped scheduler buys);
+    ``adaptive`` — ``step_adaptive``: short chunks while admission work
+    is queued, full chunks in steady decode."""
+    if mode not in ("chunked", "blocking", "adaptive"):
+        raise ValueError(f"unknown load mode {mode!r}")
     eng._finished.clear()
     t_start = time.perf_counter()
     submitted = 0
@@ -269,9 +276,12 @@ def _run_load(eng, prompts, new_tokens, gap, max_chunk, chunked=True):
             submitted += 1
             next_arrival += gap
             now = time.perf_counter()
-        if not chunked and eng._queue:
+        if mode == "blocking" and eng._queue:
             eng._admit()  # blocking whole-prefill admission
-        busy = eng.step_chunk(max_chunk)
+        if mode == "adaptive":
+            busy = eng.step_adaptive(max_chunk, probe_chunk=PROBE_CHUNK)
+        else:
+            busy = eng.step_chunk(max_chunk)
         if submitted >= n_requests and not busy and not eng.active.any():
             break
     t_total = time.perf_counter() - t_start
@@ -333,10 +343,13 @@ def bench_infer(tpu_diags):
                for _ in range(n_requests)]
 
     # warmup: compile the prefill + chunk-decode programs; drop its
-    # record (its TTFT is compile time, not serving time). The
-    # chunked=False control reuses these same programs (it only changes
-    # admission blocking), so nothing else needs compiling.
+    # record (its TTFT is compile time, not serving time). The blocking
+    # control reuses these same programs (it only changes admission
+    # blocking); the adaptive sweep also uses the probe-sized chunk, so
+    # compile that K too — a mid-measurement compile would bill seconds
+    # of compile time as TTFT.
     eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
+    eng.run([prompts[0]], max_new_tokens=2, max_chunk=PROBE_CHUNK)
 
     # unloaded TTFT: one request into an empty engine (prefill +
     # admission latency with zero queueing)
@@ -354,7 +367,12 @@ def bench_infer(tpu_diags):
     # but admission prefills block the loop (head-of-line control)
     mid = gaps[len(gaps) // 2]
     unchunked = _run_load(eng, prompts, new_tokens, mid, max_chunk,
-                          chunked=False)
+                          mode="blocking")
+    # adaptive chunk sizing at the same rate (short chunks while the
+    # admission queue is non-empty — should match blocking's TTFT while
+    # keeping chunked throughput)
+    adaptive = _run_load(eng, prompts, new_tokens, mid, max_chunk,
+                         mode="adaptive")
 
     headline = curve[len(gaps) // 2]
     return _result(
@@ -365,6 +383,7 @@ def bench_infer(tpu_diags):
          "served_tokens_per_sec": headline["served_tokens_per_sec"],
          "load_curve": curve,
          "chunked_prefill_off": unchunked,
+         "adaptive_chunking": adaptive,
          "n_requests": headline["n_requests"], "prompt_len": prompt_len,
          "new_tokens": new_tokens,
          "arrival_gap_ms": headline["gap_ms"],

@@ -246,6 +246,34 @@ def test_chunked_decode_matches_per_token():
     assert [r.output for r in out] == ref
 
 
+def test_adaptive_chunking_matches_fixed():
+    """step_adaptive (short chunks while admission work is queued, full
+    chunks in steady decode) must produce the same greedy outputs as the
+    per-token reference — scheduling granularity is invisible to
+    results."""
+    model, cfg = _model(11)
+    prompts = [np.arange(1, 6), np.arange(3, 10), np.arange(2, 4),
+               np.arange(4, 9)]
+
+    eng1 = ContinuousBatchingEngine(
+        model, EngineConfig(max_slots=2, max_len=64, seq_buckets=(16,)))
+    rids = [eng1.add_request(p, max_new_tokens=9) for p in prompts]
+    while eng1.step() or eng1._queue or eng1.active.any():
+        pass
+    ref = [eng1._finished[r].output for r in rids]
+
+    # 4 requests into 2 slots: the queue stays non-empty across the
+    # first chunks, exercising the probe-chunk path, then drains into
+    # full-chunk steady state
+    eng2 = ContinuousBatchingEngine(
+        model, EngineConfig(max_slots=2, max_len=64, seq_buckets=(16,)))
+    rids2 = [eng2.add_request(p, max_new_tokens=9) for p in prompts]
+    while eng2.step_adaptive(max_chunk=4) or eng2.active.any():
+        pass
+    got = [eng2._finished[r].output for r in rids2]
+    assert got == ref
+
+
 def test_chunked_decode_eos_mid_chunk():
     """A sequence hitting EOS inside a chunk stops exactly at EOS —
     overshoot tokens generated device-side are discarded."""
