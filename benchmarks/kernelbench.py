@@ -44,7 +44,7 @@ def main():
     if dev.platform != "tpu":
         print(json.dumps({"error": "kernel roofline needs the TPU"}))
         return
-    peak = peak_flops(getattr(dev, "device_kind", "?"))
+    peak = peak_flops(dev)
 
     # headline bench shape + a long-seq point
     shapes = [

@@ -96,3 +96,118 @@ def finfo(dtype):
 
         return ml_dtypes.finfo(ml_dtypes.bfloat16)
     return _np.finfo(d)
+
+
+# ---- round-5 migration-surface sweep (top-level paddle names) ----
+
+from . import distributed  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import models  # noqa: F401,E402
+from .core.parameter import ParamAttr  # noqa: F401,E402
+from .device import get_device, set_device  # noqa: F401,E402
+
+import builtins  # noqa: E402
+import jax as _jax  # noqa: E402
+
+#: the tensor type IS jax.Array (see tensor.py's module docstring)
+Tensor = _jax.Array
+bool = bool_  # noqa: A001  (paddle.bool is a public dtype name)
+
+
+class CPUPlace:
+    """Parity: paddle.CPUPlace. Device placement on TPU is owned by
+    PJRT/shardings; Places exist so migrating call sites keep working
+    (to_tensor(place=...), Config.set_device)."""
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+    def __eq__(self, other):
+        return type(other) is type(self)
+
+
+class CUDAPlace:
+    """Parity: paddle.CUDAPlace(id) — maps to the id-th accelerator."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(accelerator:{self.device_id})"
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other.device_id == self.device_id)
+
+
+XPUPlace = CUDAPlace
+
+
+def grad(outputs, inputs=None, grad_outputs=None, **kw):
+    """Parity adapter for paddle.grad. There is no dygraph tape here —
+    differentiation is a functional transform — so ``outputs`` must be
+    the CALLABLE producing the outputs, and ``inputs`` its example
+    arguments: ``paddle_tpu.grad(f, (x, y))`` returns (df/dx, df/dy) at
+    (x, y), one gradient per input like paddle.grad. Passing arrays
+    raises with the migration hint."""
+    if callable(outputs) and inputs is not None:
+        args = tuple(inputs) if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        return _jax.grad(outputs,
+                         argnums=tuple(range(len(args))))(*args)
+    raise TypeError(
+        "paddle_tpu.grad has no dygraph tape: pass the function AND its "
+        "inputs, e.g. grad(lambda x: loss(x), (x,)) — see "
+        "autograd.functional for vjp/jvp/jacobian/hessian")
+
+
+_grad_enabled = True
+
+
+class set_grad_enabled:
+    """Parity: paddle.set_grad_enabled — context manager tracking the
+    flag; gradient computation itself is explicit (jax transforms), so
+    the flag only drives is_grad_enabled()."""
+
+    def __init__(self, mode: builtins.bool):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = builtins.bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+def is_grad_enabled():
+    return _grad_enabled
+
+
+class DataParallel(Layer):
+    """Parity: paddle.DataParallel(model). On TPU, data parallelism is a
+    sharding of the batch axis over the mesh's dp axis inside the one
+    compiled program — gradient all-reduce is inserted by GSPMD, so the
+    wrapper has no reducer to run. It exists so migrating training
+    scripts keep their structure; pass the wrapped model to TrainStep
+    with a dp mesh axis for the actual parallelism."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._layers, name)

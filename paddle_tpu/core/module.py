@@ -106,10 +106,25 @@ class Layer:
         """
         dt = dtype_mod.convert_dtype(dtype or self._dtype)
         default = init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal()
+        trainable = True
+        optimize_attr = None
+        from .parameter import ParamAttr
+
+        if isinstance(default_initializer, ParamAttr):
+            attr = default_initializer
+            default_initializer = attr.initializer
+            trainable = attr.trainable
+            name = name or attr.name
+            if attr.learning_rate != 1.0:
+                optimize_attr = {"learning_rate": attr.learning_rate}
         init = init_mod.resolve(default_initializer, default)
         key = random_mod.next_rng_key("params")
         value = init(key, tuple(shape), dt)
-        return Parameter(value, name=name, spec=spec, init_fn=init)
+        p = Parameter(value, name=name, trainable=trainable, spec=spec,
+                      init_fn=init)
+        if optimize_attr:
+            p.optimize_attr.update(optimize_attr)
+        return p
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
         if tensor is not None:

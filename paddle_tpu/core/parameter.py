@@ -179,3 +179,26 @@ for _name, _op in [
     ("ge", operator.ge),
 ]:
     setattr(Parameter, f"__{_name}__", _binop(_op))
+
+
+class ParamAttr:
+    """Parameter attribute bundle (parity: paddle.ParamAttr,
+    python/paddle/base/param_attr.py): carried through every layer's
+    ``weight_attr``/``bias_attr``. ``initializer`` and ``trainable``
+    take effect at ``Layer.create_parameter``; ``learning_rate`` lands
+    in ``Parameter.optimize_attr`` (read by optimizers the way phi's
+    fused kernels read per-param lr scaling); ``regularizer`` /
+    ``need_clip`` / ``do_model_average`` are stored for API parity —
+    global weight-decay + clip already cover their common use on the
+    TPU path."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip

@@ -48,6 +48,7 @@ from .common import (  # noqa: F401
     alpha_dropout,
     cosine_similarity,
     dropout,
+    dropout3d,
     fold,
     interpolate,
     linear,
@@ -58,9 +59,11 @@ from .common import (  # noqa: F401
 )
 from .conv import (  # noqa: F401
     conv1d,
+    conv1d_transpose,
     conv2d,
     conv2d_transpose,
     conv3d,
+    conv3d_transpose,
 )
 from .flash_attention import (  # noqa: F401
     flash_attention,
@@ -98,8 +101,18 @@ from .norm import (  # noqa: F401
     rms_norm,
 )
 from .pooling import (  # noqa: F401
+    adaptive_avg_pool1d,
     adaptive_avg_pool2d,
+    adaptive_avg_pool3d,
+    adaptive_max_pool2d,
     avg_pool2d,
+    avg_pool3d,
     max_pool2d,
+    max_pool3d,
 )
-from .vision import _bilerp, grid_sample, pixel_shuffle  # noqa: F401
+from .vision import (  # noqa: F401
+    _bilerp,
+    grid_sample,
+    pixel_shuffle,
+    pixel_unshuffle,
+)

@@ -283,3 +283,18 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     else:
         width = [(0, 0), (top, bottom), (left, right), (0, 0)]
     return jnp.pad(x, width)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Whole-channel dropout for 5-D input (parity: F.dropout3d)."""
+    x = _v(x)
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_rng_key("dropout3d")
+    shape = list(x.shape)
+    if data_format == "NCDHW":
+        shape[2] = shape[3] = shape[4] = 1
+    else:
+        shape[1] = shape[2] = shape[3] = 1
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)

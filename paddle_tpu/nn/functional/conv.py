@@ -149,3 +149,65 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         y = y + _v(bias).reshape(shape)
     return y
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, channels_first, spec):
+    """Shared N-D transpose conv (fractionally-strided): the 2-D form
+    above, generalized. Weight [in_c, out_c/groups, *k]."""
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    if isinstance(output_padding, int):
+        output_padding = (output_padding,) * nd
+    ks = weight.shape[-nd:]
+    pads = []
+    for (k, p, op, d) in zip(ks, padding, output_padding, dilation):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + op))
+    w = jnp.flip(weight, axis=tuple(range(-nd, 0)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        i, og, khw = weight.shape[0], weight.shape[1], weight.shape[2:]
+        w = w.reshape(groups, i // groups, og, *khw)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups, *khw)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+    ).astype(x.dtype)
+    if bias is not None:
+        shape = [1] * y.ndim
+        shape[1 if channels_first else -1] = -1
+        y = y + _v(bias).reshape(shape)
+    return y
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL"):
+    """Parity: F.conv1d_transpose; weight [in_c, out_c/groups, k]."""
+    x, weight = _v(x), _v(weight)
+    cf = data_format == "NCL"
+    spec = ("NCH", "OIH", "NCH") if cf else ("NHC", "OIH", "NHC")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1, cf,
+                              spec)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW"):
+    """Parity: F.conv3d_transpose; weight [in_c, out_c/groups, kd, kh, kw]."""
+    x, weight = _v(x), _v(weight)
+    cf = data_format == "NCDHW"
+    spec = (("NCDHW", "OIDHW", "NCDHW") if cf
+            else ("NDHWC", "OIDHW", "NDHWC"))
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3, cf,
+                              spec)

@@ -82,3 +82,121 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     my = _adaptive_avg_matrix(output_size[0], h)
     mx = _adaptive_avg_matrix(output_size[1], w)
     return jnp.einsum("Oh,nchw,Pw->ncOP", my, x, mx).astype(x.dtype)
+
+
+def _pool_nd(x, nd, kernel_size, stride, padding, data_format, kind):
+    """Shared N-D window pool (parity: phi pool3d/pool1d kernels —
+    one lax.reduce_window per call, XLA picks the TPU schedule)."""
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * nd
+    channels_first = data_format in ("NCHW", "NCL", "NCDHW")
+    if channels_first:
+        window = (1, 1) + tuple(kernel_size)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + list(padding)
+    else:
+        window = (1,) + tuple(kernel_size) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = [(0, 0)] + list(padding) + [(0, 0)]
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+    return summed / counts
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NCDHW"):
+    return _pool_nd(_v(x), 3, kernel_size, stride, padding, data_format,
+                    "max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NCDHW"):
+    return _pool_nd(_v(x), 3, kernel_size, stride, padding, data_format,
+                    "avg")
+
+
+def adaptive_avg_pool1d(x, output_size):
+    """x [N, C, L] (parity: F.adaptive_avg_pool1d)."""
+    x = _v(x)
+    L = x.shape[2]
+    if isinstance(output_size, (tuple, list)):
+        output_size = output_size[0]
+    if L % output_size == 0:
+        k = L // output_size
+        return _pool_nd(x, 1, (k,), (k,), 0, "NCL", "avg")
+    m = _adaptive_avg_matrix(output_size, L)
+    return jnp.einsum("Ol,ncl->ncO", m, x).astype(x.dtype)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    x = _v(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    if data_format == "NDHWC":
+        return jnp.moveaxis(
+            adaptive_avg_pool3d(jnp.moveaxis(x, -1, 1), output_size),
+            1, -1)
+    d, h, w = x.shape[2:]
+    if all(s % o == 0 for s, o in zip((d, h, w), output_size)):
+        k = tuple(s // o for s, o in zip((d, h, w), output_size))
+        return _pool_nd(x, 3, k, k, 0, "NCDHW", "avg")
+    md = _adaptive_avg_matrix(output_size[0], d)
+    mh = _adaptive_avg_matrix(output_size[1], h)
+    mw = _adaptive_avg_matrix(output_size[2], w)
+    y = jnp.einsum("Dd,ncdhw->ncDhw", md, x)
+    y = jnp.einsum("Hh,ncDhw->ncDHw", mh, y)
+    return jnp.einsum("Ww,ncDHw->ncDHW", mw, y).astype(x.dtype)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False,
+                        data_format="NCHW"):
+    """Adaptive max pool; reference bin edges. Non-divisible sizes use
+    the segment trick: mask each bin from the padded window max.
+    ``return_mask=True`` also returns the flattened h*w argmax index
+    per bin (parity: F.adaptive_max_pool2d mask output)."""
+    x = _v(x)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if data_format == "NHWC":
+        y = adaptive_max_pool2d(jnp.moveaxis(x, -1, 1), output_size,
+                                return_mask)
+        if return_mask:
+            return (jnp.moveaxis(y[0], 1, -1),
+                    jnp.moveaxis(y[1], 1, -1))
+        return jnp.moveaxis(y, 1, -1)
+    h, w = x.shape[2], x.shape[3]
+    if h % output_size[0] == 0 and w % output_size[1] == 0 \
+            and not return_mask:
+        k = (h // output_size[0], w // output_size[1])
+        return _pool_nd(x, 2, k, k, 0, "NCHW", "max")
+    # general case: per-output-bin masked max via the bin matrices
+    my = _adaptive_avg_matrix(output_size[0], h) > 0  # [Oh, h] bin mask
+    mx = _adaptive_avg_matrix(output_size[1], w) > 0  # [Ow, w]
+    neg = jnp.asarray(
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min, x.dtype)
+    # [n, c, Oh, Ow, h, w] masked view is too big; do separable maxes
+    # [1,1,Oh,h,1] mask against [n,c,1,h,w] -> max over h
+    y1 = jnp.where(my[None, None, :, :, None], x[:, :, None, :, :], neg)
+    ih = jnp.argmax(y1, axis=3)  # [n, c, Oh, w] row of each column max
+    y1 = y1.max(axis=3)  # -> [n, c, Oh, w]
+    # [1,1,Ow,w] mask against [n,c,Oh,1,w] -> max over w
+    y2 = jnp.where(mx[None, None, None, :, :],
+                   y1[:, :, :, None, :], neg)
+    iw = jnp.argmax(y2, axis=-1)  # [n, c, Oh, Ow]
+    out = y2.max(axis=-1)
+    if not return_mask:
+        return out
+    # joint argmax: row index gathered at the winning column
+    ih_sel = jnp.take_along_axis(ih, iw, axis=-1)  # [n, c, Oh, Ow]
+    return out, ih_sel * w + iw

@@ -97,3 +97,17 @@ def _bilerp(feat, y, x):
             + feat[:, y0, x1] * ((1 - wy1) * wx1)
             + feat[:, y1, x0] * (wy1 * (1 - wx1))
             + feat[:, y1, x1] * (wy1 * wx1))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    """Inverse of pixel_shuffle (parity: F.pixel_unshuffle)."""
+    r = downscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = x.reshape(b, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(b, c * r * r, h // r, w // r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, h // r, w // r, c * r * r)

@@ -182,3 +182,25 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self.threshold)
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (parity: paddle.nn.RReLU): slope sampled
+    U[lower, upper] per element in training, fixed mean slope in eval."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper,
+                       training=self.training)

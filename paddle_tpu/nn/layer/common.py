@@ -230,6 +230,20 @@ class PixelShuffle(Layer):
         return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
 
 
+_PAD_MODE = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap", "edge": "edge",
+             "wrap": "wrap"}
+
+
+def _np_pad_mode(mode):
+    """Paddle pad-mode names -> numpy/jnp.pad names (replicate->edge,
+    circular->wrap); unknown names raise up front."""
+    try:
+        return _PAD_MODE[mode]
+    except KeyError:
+        raise ValueError(f"unsupported pad mode {mode!r}") from None
+
+
 class Pad2D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
                  data_format="NCHW"):
@@ -249,7 +263,7 @@ class Pad2D(Layer):
             pads = ((0, 0), (t, b), (l, r), (0, 0))
         if self.mode == "constant":
             return jnp.pad(x, pads, constant_values=self.value)
-        return jnp.pad(x, pads, mode=self.mode)
+        return jnp.pad(x, pads, mode=_np_pad_mode(self.mode))
 
 
 class Dropout2D(Layer):
@@ -392,3 +406,124 @@ class ZeroPad2D(Layer):
 
     def forward(self, x):
         return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class Dropout3D(Layer):
+    """Drops whole channels of 5-D input (parity: paddle.nn.Dropout3D)."""
+
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Pad1D(Layer):
+    """[left, right] padding on [N, C, L] (parity: paddle.nn.Pad1D)."""
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 2
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r = self.padding
+        pads = ((0, 0), (0, 0), (l, r)) if self.data_format == "NCL" \
+            else ((0, 0), (l, r), (0, 0))
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=_np_pad_mode(self.mode))
+
+
+class Pad3D(Layer):
+    """[left, right, top, bottom, front, back] on [N, C, D, H, W]
+    (parity: paddle.nn.Pad3D)."""
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 6
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, t, b, f, bk = self.padding
+        if self.data_format == "NCDHW":
+            pads = ((0, 0), (0, 0), (f, bk), (t, b), (l, r))
+        else:
+            pads = ((0, 0), (f, bk), (t, b), (l, r), (0, 0))
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=_np_pad_mode(self.mode))
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor,
+                                 self.data_format)
+
+
+class LayerDict(Layer):
+    """Dict-style sublayer container (parity: paddle.nn.LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, sublayer):
+        self.add_sublayer(str(key), sublayer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[str(key)]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers[key]
+        del self._sub_layers[key]
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        if isinstance(sublayers, dict):
+            sublayers = sublayers.items()
+        for k, v in sublayers:
+            self.add_sublayer(str(k), v)

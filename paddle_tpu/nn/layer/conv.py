@@ -218,3 +218,122 @@ class AvgPool1D(Layer):
         cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,
                                 strides, pads)
         return s / cnt
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride,
+                            self.padding, self.data_format)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride,
+                            self.padding, self.data_format)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False,
+                 data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     self.return_mask, self.data_format)
+
+
+class Conv1DTranspose(Layer):
+    """Weight layout [in_channels, out_channels/groups, k]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *kernel_size),
+            default_initializer=weight_attr or I.KaimingUniform(),
+        )
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.output_padding, self.groups, self.dilation,
+            self.data_format)
+
+
+class Conv3DTranspose(Layer):
+    """Weight layout [in_channels, out_channels/groups, kd, kh, kw]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *kernel_size),
+            default_initializer=weight_attr or I.KaimingUniform(),
+        )
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.output_padding, self.groups, self.dilation,
+            self.data_format)

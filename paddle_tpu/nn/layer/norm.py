@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...core import initializer as I
@@ -205,3 +206,94 @@ class LocalResponseNorm(Layer):
         size, alpha, beta, k, df = self._args
         return F.local_response_norm(x, size, alpha=alpha, beta=beta,
                                      k=k, data_format=df)
+
+
+class BatchNorm1D(BatchNorm2D):
+    """[N, C] or [N, C, L] input (parity: paddle.nn.BatchNorm1D). The
+    base forward derives reduction axes from input rank and from
+    whether the format is channels-first, so only the format spelling
+    and the expected-rank check differ."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        # base switches on 'NCHW' for channels-first; map the 1-D names
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr,
+                         "NCHW" if data_format in ("NCL", "NC", "NCHW")
+                         else "NHWC")
+
+    def forward(self, x):
+        if x.ndim not in (2, 3):
+            raise ValueError(
+                f"BatchNorm1D expects 2-D/3-D input, got {x.ndim}-D")
+        return super().forward(x)
+
+
+class BatchNorm3D(BatchNorm2D):
+    """[N, C, D, H, W] input (parity: paddle.nn.BatchNorm3D)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr,
+                         "NCHW" if data_format in ("NCDHW", "NCHW")
+                         else "NHWC")
+
+    def forward(self, x):
+        if x.ndim != 5:
+            raise ValueError(
+                f"BatchNorm3D expects 5-D input, got {x.ndim}-D")
+        return super().forward(x)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a WEIGHT tensor passed to forward
+    (parity: paddle.nn.SpectralNorm, phi spectral_norm kernel): power
+    iteration on W reshaped to 2-D about ``dim``, returning
+    W / sigma. u/v persist as buffers across calls the way the
+    reference carries them between steps."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from ...core import random as random_mod
+
+        k1, k2 = jax.random.split(random_mod.next_rng_key("params"))
+        self.register_buffer(
+            "weight_u", jax.random.normal(k1, (h,), jnp.float32))
+        self.register_buffer(
+            "weight_v", jax.random.normal(k2, (w,), jnp.float32))
+
+    def forward(self, weight):
+        from ..functional.common import _v
+
+        weight = _v(weight)
+        perm = [self.dim] + [i for i in range(weight.ndim)
+                             if i != self.dim]
+        mat = jnp.transpose(weight, perm).reshape(
+            weight.shape[self.dim], -1).astype(jnp.float32)
+        u = self._buffers["weight_u"]
+        v = self._buffers["weight_v"]
+
+        def _l2(x):
+            return x / (jnp.linalg.norm(x) + self.eps)
+
+        for _ in range(self.power_iters):
+            v = _l2(mat.T @ u)
+            u = _l2(mat @ v)
+        import jax.core as _core
+
+        if not isinstance(u, _core.Tracer):
+            # eager: persist the iteration like the reference kernel
+            self._buffers["weight_u"] = u
+            self._buffers["weight_v"] = v
+        sigma = u @ mat @ v
+        return (weight / sigma.astype(weight.dtype)).astype(weight.dtype)

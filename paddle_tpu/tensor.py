@@ -806,3 +806,113 @@ def unsqueeze_(x, axis, name=None):
 
 def clip_(x, min=None, max=None, name=None):  # noqa: A002
     return jnp.clip(_v(x), min, max)
+
+
+# ---- round-5 migration-surface sweep additions (parity:
+# python/paddle/tensor/math.py, creation.py, attribute.py) ----
+
+def mm(input, mat2, name=None):
+    return jnp.matmul(_v(input), _v(mat2))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = dtype and dtype_mod.convert_dtype(dtype)
+    return jnp.prod(_v(x), axis=axis, keepdims=keepdim, dtype=dt)
+
+
+def tan(x, name=None):
+    return jnp.tan(_v(x))
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(_v(x))
+
+
+def erf(x, name=None):
+    return jax.scipy.special.erf(_v(x))
+
+
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(_v(x), _v(y))
+
+
+def remainder(x, y, name=None):
+    return jnp.remainder(_v(x), _v(y))
+
+
+def mod(x, y, name=None):
+    return jnp.remainder(_v(x), _v(y))
+
+
+def real(x, name=None):
+    return jnp.real(_v(x))
+
+
+def imag(x, name=None):
+    return jnp.imag(_v(x))
+
+
+def conj(x, name=None):
+    return jnp.conj(_v(x))
+
+
+def angle(x, name=None):
+    return jnp.angle(_v(x))
+
+
+def as_complex(x, name=None):
+    """[..., 2] float -> [...] complex (parity: paddle.as_complex)."""
+    x = _v(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x, name=None):
+    """[...] complex -> [..., 2] float (parity: paddle.as_real)."""
+    x = _v(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-index gather (parity: paddle.take; mode 'raise' clamps
+    like 'clip' on TPU — data-dependent errors can't abort a compiled
+    program; 'wrap' wraps)."""
+    x, index = _v(x), _v(index)
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        index = jnp.mod(index, n)
+    else:
+        index = jnp.clip(index, -n, n - 1)
+    return flat[index]
+
+
+def index_add(x, index, axis, value, name=None):
+    """out[index[i]] += value[i] along ``axis`` (parity:
+    paddle.index_add)."""
+    x, index, value = _v(x), _v(index), _v(value)
+    axis = axis % x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value.astype(x.dtype))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    return jnp.cov(_v(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(_v(x), rowvar=rowvar)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(_v(x), q, axis=axis, keepdims=keepdim,
+                           method=interpolation)
