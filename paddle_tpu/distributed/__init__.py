@@ -91,3 +91,15 @@ from .topology import (  # noqa: F401
     get_hybrid_communicate_group,
     set_hybrid_communicate_group,
 )
+
+# ---- round-5 surface sweep ----
+from . import fleet  # noqa: F401,E402
+from . import stream  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from .collective import alltoall as all_to_all  # noqa: F401,E402
+from .auto_parallel import (  # noqa: F401,E402
+    dtensor_to_local,
+    parallelize,
+    unshard_dtensor,
+)
+from .env import ParallelEnv, spawn  # noqa: F401,E402

@@ -214,3 +214,61 @@ def shard_optimizer(optimizer, shard_fn=None):
     if shard_fn is not None and hasattr(optimizer, "_state"):
         optimizer._state = shard_fn(optimizer._state)
     return optimizer
+
+
+def dtensor_to_local(x, mesh=None, placements=None):
+    """Parity: dist.dtensor_to_local — this process's addressable part
+    as a plain array. Replicated arrays return one copy; in a
+    single-process world every shard is addressable, so the local form
+    IS the global array; a multi-host shard set is reassembled along
+    its sharded axes from each shard's global index."""
+    shards = getattr(x, "addressable_shards", None)
+    if not shards:
+        return x
+    if len(shards) == 1:
+        return shards[0].data
+    import jax
+
+    if getattr(x.sharding, "is_fully_replicated", False):
+        return shards[0].data
+    if len(shards) == len(x.sharding.device_set):
+        # single-process: all shards addressable -> local == global
+        return x
+    # multi-host: paste each addressable shard into the bounding box of
+    # the addressable region using its global index
+    import numpy as np
+
+    idxs = [s.index for s in shards]
+    starts = [min(ix[d].start or 0 for ix in idxs)
+              for d in range(x.ndim)]
+    stops = [max(ix[d].stop if ix[d].stop is not None else x.shape[d]
+                 for ix in idxs) for d in range(x.ndim)]
+    out = np.zeros([b - a for a, b in zip(starts, stops)], x.dtype)
+    for s in shards:
+        sl = tuple(slice((ix.start or 0) - a,
+                         ((ix.stop if ix.stop is not None else dim)) - a)
+                   for ix, a, dim in zip(s.index, starts, x.shape))
+        out[sl] = np.asarray(s.data)
+    return jax.numpy.asarray(out)
+
+
+def unshard_dtensor(x):
+    """Parity: dist.unshard_dtensor — gather to a fully replicated
+    array (device_get + re-put keeps it simple and always correct; XLA
+    elides the copy for already-replicated inputs)."""
+    import jax
+
+    return jax.device_put(jax.device_get(x))
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Parity: paddle.distributed.parallelize (the 3.0 one-call API:
+    apply a parallel config to model+optimizer). Sharding here is
+    declared on Parameters (`.spec`) and consumed by TrainStep over the
+    active mesh, so the pair passes through; ``config`` dicts naming
+    dp/mp/pp degrees should instead build a DistributedStrategy (see
+    distributed.strategy) — raising on unknown keys would break the
+    reference's permissive contract, so unknown configs are ignored."""
+    if optimizer is None:
+        return model
+    return model, optimizer

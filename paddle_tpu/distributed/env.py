@@ -71,3 +71,76 @@ def device_count() -> int:
 
 def local_device_count() -> int:
     return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv — rank/world/device
+    queries as attributes (legacy dygraph DDP surface)."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return get_local_rank()
+
+    @property
+    def dev_id(self) -> int:  # legacy spelling
+        return get_local_rank()
+
+    @property
+    def nranks(self) -> int:  # legacy spelling
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Parity: paddle.distributed.spawn — launch ``func`` in ``nprocs``
+    OS processes with PADDLE_* rank env set, as the launch CLI does.
+    On TPU real multi-host runs go through ``paddle_tpu.distributed.
+    launch`` (one process per host; chips are one process's devices),
+    so spawn is for host-side parallelism and CPU-mesh tests."""
+    import multiprocessing as mp
+
+    if nprocs <= 0:
+        nprocs = max(1, local_device_count())
+    # pick a free coordinator port BEFORE forking (paddle's spawn does
+    # the same): without PADDLE_MASTER, a worker's init_parallel_env
+    # would skip jax.distributed.initialize and every worker would run
+    # as an independent rank-0 world
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{s.getsockname()[1]}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs),
+               "PADDLE_LOCAL_RANK": str(rank),
+               "PADDLE_MASTER": master}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn: worker exit codes {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    os.environ.update(env)
+    func(*args)

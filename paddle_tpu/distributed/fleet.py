@@ -1,0 +1,78 @@
+"""paddle.distributed.fleet namespace shim (parity:
+python/paddle/distributed/fleet/__init__.py — the API most migrating
+training scripts drive: ``fleet.init(is_collective=True, strategy)``,
+``fleet.distributed_model/optimizer``, rank/worker queries).
+
+On TPU the heavy machinery behind these calls (DDP reducer, sharded
+optimizer wrappers, communication overlap) is GSPMD's job — the wrapped
+objects come back unchanged and parallelism comes from the mesh +
+shardings consumed by TrainStep. The namespace keeps the call sites
+working and routes the strategy into the global HCG.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import (
+    HybridCommunicateGroup,  # noqa: F401
+    fleet_init,
+    get_hybrid_communicate_group,  # noqa: F401
+)
+from .env import get_rank, get_world_size
+from . import parallel_layers as meta_parallel  # noqa: F401
+
+
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=False,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """Parity: fleet.init. Builds the global HybridCommunicateGroup from
+    the strategy's hybrid_configs (collective mode; parameter-server
+    role makers are N/A on TPU — see MAPPING.md)."""
+    global _strategy
+    _strategy = strategy or DistributedStrategy()
+    fleet_init(_strategy)
+    return None
+
+
+def is_first_worker() -> bool:
+    return get_rank() == 0
+
+
+def worker_index() -> int:
+    return get_rank()
+
+
+def worker_num() -> int:
+    return get_world_size()
+
+
+def barrier_worker():
+    from .collective import barrier
+
+    barrier()
+
+
+def distributed_model(model):
+    """Parity: fleet.distributed_model — upstream wraps with the DDP
+    reducer; GSPMD inserts gradient reductions from shardings, so the
+    model passes through."""
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Parity: fleet.distributed_optimizer — upstream chains
+    meta-optimizers (sharding/amp/recompute passes); here those are
+    TrainStep concerns driven by the SAME strategy object, so the
+    optimizer passes through."""
+    global _strategy
+    if strategy is not None:
+        _strategy = strategy
+    return optimizer
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy

@@ -1,0 +1,31 @@
+"""paddle.distributed.stream namespace (parity:
+python/paddle/distributed/communication/stream/): the stream-explicit
+collective variants. XLA owns stream scheduling on TPU — collectives
+are HLO ops ordered by the compiler — so these are the same collectives
+with ``sync_op``/``use_calc_stream`` accepted and ignored."""
+
+from __future__ import annotations
+
+import functools
+
+from . import collective as _c
+
+
+def _streamified(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kw):
+        return fn(*args, **kw)
+
+    return wrapper
+
+
+all_reduce = _streamified(_c.all_reduce)
+all_gather = _streamified(_c.all_gather)
+reduce_scatter = _streamified(_c.reduce_scatter)
+broadcast = _streamified(_c.broadcast)
+reduce = _streamified(_c.reduce)
+scatter = _streamified(_c.scatter)
+alltoall = _streamified(_c.alltoall)
+alltoall_single = _streamified(_c.alltoall_single)
+send = _streamified(_c.send)
+recv = _streamified(_c.recv)

@@ -5,4 +5,104 @@ ops, LookAhead/ModelAverage optimizer wrappers, EMA)."""
 from . import nn  # noqa: F401
 from .optimizer import EMA, LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["nn", "LookAhead", "ModelAverage", "EMA"]
+__all__ = ["nn", "LookAhead", "ModelAverage", "EMA",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "identity_loss"]
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Parity: paddle.incubate.segment_sum — jax.ops.segment_sum with
+    num_segments = max_id + 1 (matches the reference's dynamic sizing;
+    under jit pass dense ids so the bound is static)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(data, segment_ids, num_segments=n)
+
+
+def _segment_reduce(data, segment_ids, kind):
+    import jax
+    import jax.numpy as jnp
+
+    n = int(jnp.max(segment_ids)) + 1
+    if kind == "mean":
+        s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(data), segment_ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)
+    if kind == "max":
+        return jax.ops.segment_max(data, segment_ids, num_segments=n)
+    return jax.ops.segment_min(data, segment_ids, num_segments=n)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Parity: paddle.incubate.graph_send_recv (graph message passing):
+    gather x at src_index, segment-reduce onto dst_index."""
+    import jax
+    import jax.numpy as jnp
+
+    msgs = x[src_index]
+    n = int(out_size) if out_size is not None \
+        else int(jnp.max(dst_index)) + 1
+    pool = pool_type.lower()
+    if pool == "sum":
+        return jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+    if pool == "mean":
+        s = jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(msgs), dst_index,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)
+    if pool == "max":
+        return jax.ops.segment_max(msgs, dst_index, num_segments=n)
+    if pool == "min":
+        return jax.ops.segment_min(msgs, dst_index, num_segments=n)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Parity: incubate.softmax_mask_fuse (fused_softmax_mask kernel):
+    softmax(x + mask) — one XLA fusion on TPU, no custom kernel needed."""
+    import jax
+
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Parity: incubate.softmax_mask_fuse_upper_triangle — causal-masked
+    softmax over [b, h, sq, sk]."""
+    import jax
+    import jax.numpy as jnp
+
+    sq, sk = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    return jax.nn.softmax(jnp.where(causal, x, -1e30), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Parity: paddle.incubate.identity_loss — marks a tensor as a loss
+    for the static optimizer; functionally a reduction. Paddle's int
+    codes: 0=sum, 1=mean, 2=none."""
+    import jax.numpy as jnp
+
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return jnp.sum(x)
+    if reduction in ("mean", 1):
+        return jnp.mean(x)
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -150,3 +150,24 @@ def load(path: str) -> LoadedFunction:
         payload = pickle.load(f)
     exported = jexport.deserialize(payload["stablehlo"])
     return LoadedFunction(exported)
+
+
+def not_to_static(fn=None):
+    """Parity: paddle.jit.not_to_static — mark a function to be left
+    eager by to_static. Tracing here is jax's (no AST rewriting), so the
+    marker is metadata only."""
+    if fn is None:
+        return not_to_static
+    fn._paddle_tpu_not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    """Parity: paddle.jit.ignore_module — modules the dy2static AST
+    transformer should skip; jax tracing has no AST pass, so this
+    records intent and returns."""
+    return None
+
+
+#: Parity: paddle.jit.TranslatedLayer — the type jit.load returns.
+TranslatedLayer = LoadedFunction

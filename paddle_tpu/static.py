@@ -61,4 +61,53 @@ class InputSpec:
         return jax.ShapeDtypeStruct(tuple(shape), self.dtype)
 
 
-__all__ = ["InputSpec"]
+__all__ = ["InputSpec", "name_scope", "program_guard", "Program",
+           "default_main_program", "default_startup_program"]
+
+
+import contextlib as _contextlib  # noqa: E402 (kept near its users)
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """Parity: paddle.static.name_scope — op-name prefixing in the
+    static graph; surfaces as a jax named scope so the prefix shows up
+    in profiles/HLO instead of a ProgramDesc."""
+    import jax
+
+    with jax.named_scope(prefix or "scope"):
+        yield
+
+
+@_contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """Parity: paddle.static.program_guard. There is no ProgramDesc —
+    jit tracing owns the graph — so this is a structural no-op that
+    keeps legacy static-graph call sites importable."""
+    yield main_program
+
+
+class _Program:
+    """Minimal Program stand-in (parity: paddle.static.Program)."""
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_MAIN = _Program()
+_STARTUP = _Program()
+
+
+def default_main_program():
+    return _MAIN
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+def Program():  # noqa: N802 (paddle spells it as a class)
+    return _Program()

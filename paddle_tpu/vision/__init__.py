@@ -34,3 +34,37 @@ from .models import (  # noqa: F401
 )
 
 from . import ops  # noqa: F401,E402
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Parity: paddle.vision.set_image_backend ('pil' | 'cv2' |
+    'tensor'). Decoding here is PIL/numpy-based; 'cv2' is accepted and
+    served by the same path."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Parity: paddle.vision.image_load — ndarray/PIL image from disk."""
+    import numpy as np
+
+    b = backend or _image_backend
+    try:
+        from PIL import Image
+    except ImportError:
+        Image = None
+    if Image is not None:
+        img = Image.open(path)
+        if b in ("cv2", "tensor"):
+            return np.asarray(img)
+        return img
+    raise RuntimeError("image_load needs PIL (not available)")

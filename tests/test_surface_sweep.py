@@ -230,3 +230,104 @@ def test_adaptive_max_pool_mask_and_bn_formats():
         jnp.ones((1, 1, 2, 2))).shape == (1, 1, 4, 4)
     with pytest.raises(ValueError, match="pad mode"):
         nn.Pad1D(1, mode="bogus")(jnp.ones((1, 1, 2)))
+
+
+def test_distributed_namespace_shims():
+    d = pt.distributed
+    d.fleet.init(is_collective=True)
+    assert d.fleet.worker_num() >= 1 and d.fleet.is_first_worker()
+    m = nn.Linear(2, 2)
+    assert d.fleet.distributed_model(m) is m
+    opt_obj = pt.optimizer.SGD(0.1)
+    assert d.fleet.distributed_optimizer(opt_obj) is opt_obj
+    env = d.ParallelEnv()
+    assert env.rank == 0 and env.nranks >= 1 and env.device_id >= 0
+    assert d.all_to_all is d.alltoall
+    # stream variants accept sync_op/use_calc_stream and delegate; on
+    # the 8-device test mesh each shard holds 1 element -> sum is 8
+    n = jax.device_count()
+    np.testing.assert_allclose(
+        d.stream.all_reduce(jnp.ones((n,)), sync_op=True),
+        np.full((n,), float(n)))
+    x = jnp.ones((4, 2))
+    assert d.unshard_dtensor(x).shape == (4, 2)
+    assert d.parallelize(m) is m
+
+
+def test_incubate_segment_and_graph_ops():
+    inc = pt.incubate
+    np.testing.assert_allclose(
+        inc.segment_sum(jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+                        jnp.asarray([0, 0, 1, 1])), [3.0, 7.0])
+    np.testing.assert_allclose(
+        inc.segment_mean(jnp.asarray([1.0, 3.0, 5.0]),
+                         jnp.asarray([0, 0, 1])), [2.0, 5.0])
+    np.testing.assert_allclose(
+        inc.segment_max(jnp.asarray([1.0, 3.0, 5.0]),
+                        jnp.asarray([0, 0, 1])), [3.0, 5.0])
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((5, 3)),
+                    jnp.float32)
+    out = inc.graph_send_recv(x, jnp.asarray([0, 1, 2]),
+                              jnp.asarray([1, 1, 0]), "sum")
+    np.testing.assert_allclose(np.asarray(out[1]),
+                               np.asarray(x[0] + x[1]), rtol=1e-6)
+    # fused masked softmax == softmax(x + mask)
+    ut = inc.softmax_mask_fuse_upper_triangle(jnp.zeros((1, 1, 2, 2)))
+    np.testing.assert_allclose(np.asarray(ut[0, 0]),
+                               [[1.0, 0.0], [0.5, 0.5]])
+    assert float(inc.identity_loss(jnp.asarray([1.0, 3.0]), "mean")) == 2.0
+
+
+def test_jit_static_vision_shims():
+    @pt.jit.not_to_static
+    def f(x):
+        return x
+
+    assert f._paddle_tpu_not_to_static
+    assert pt.jit.TranslatedLayer is not None
+    with pt.static.name_scope("blk"):
+        pass
+    with pt.static.program_guard():
+        pass
+    assert pt.static.default_main_program().global_block() is not None
+    prev = pt.vision.get_image_backend()
+    pt.vision.set_image_backend("cv2")
+    assert pt.vision.get_image_backend() == "cv2"
+    pt.vision.set_image_backend(prev)
+    with pytest.raises(ValueError):
+        pt.vision.set_image_backend("bogus")
+
+
+def test_incubate_fix_details():
+    inc = pt.incubate
+    # paddle's int reduction codes: 0=sum, 1=mean, 2=none
+    x = jnp.asarray([1.0, 3.0])
+    assert float(inc.identity_loss(x, 0)) == 4.0
+    assert float(inc.identity_loss(x, 1)) == 2.0
+    np.testing.assert_allclose(inc.identity_loss(x, 2), x)
+    # mean pooling with 1-D x keeps rank (regression: count broadcast)
+    out = inc.graph_send_recv(jnp.asarray([2.0, 4.0, 6.0]),
+                              jnp.asarray([0, 1]), jnp.asarray([0, 0]),
+                              "mean")
+    assert out.shape == (1,)
+    assert float(out[0]) == 3.0
+
+
+def _spawn_child(out_dir):
+    import os
+    import pathlib
+
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    master = os.environ.get("PADDLE_MASTER", "")
+    pathlib.Path(out_dir, f"r{rank}").write_text(master)
+
+
+def test_spawn_sets_rank_env(tmp_path):
+    from paddle_tpu.distributed import spawn
+
+    spawn(_spawn_child, args=(str(tmp_path),), nprocs=2)
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["r0", "r1"]
+    masters = {p.read_text() for p in tmp_path.iterdir()}
+    # one shared coordinator address, set before fork
+    assert len(masters) == 1 and ":" in masters.pop()
