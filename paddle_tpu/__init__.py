@@ -54,6 +54,11 @@ from .core.parameter import Parameter  # noqa: F401
 from .core.random import get_rng_state_tracker, seed  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import to_tensor  # noqa: F401
+from .core import tensor_methods as _tensor_methods
+
+# paddle.Tensor METHOD surface onto jax.Array (x.numpy(), x.cast(...),
+# x.unsqueeze(...)) — strictly additive, see core/tensor_methods.py
+_tensor_methods.install()
 from .version import full_version as __version__  # noqa: F401
 
 

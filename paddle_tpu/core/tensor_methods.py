@@ -1,0 +1,247 @@
+"""paddle.Tensor method surface installed onto jax.Array (parity:
+python/paddle/tensor/ methods generated onto the Tensor pybind class).
+
+The tensor type here IS ``jax.Array`` (see tensor.py) — migrating code
+that calls ``x.numpy()``, ``x.cast(...)``, ``x.unsqueeze(...)`` gets
+those as real methods, installed once at package import onto the
+``jax.Array`` ABC (ArrayImpl inherits from it, so lookup works on every
+array). STRICTLY ADDITIVE: a name jax.Array already defines is never
+touched, so jax semantics cannot change. In-place mutators (add_,
+zero_) have no meaning on immutable device arrays and are not provided
+— the _() spelling raises in paddle too when the tensor is a leaf
+requiring grad, and the functional forms are one rename away.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+
+def _unary(fn):
+    return lambda self, name=None: fn(self)
+
+
+def _binary(fn):
+    return lambda self, y, name=None: fn(self, y)
+
+
+def _numpy(self):
+    return _np.asarray(self)
+
+
+def _unsqueeze(self, axis, name=None):
+    return jnp.expand_dims(self, axis)
+
+
+def _numel(self, name=None):
+    return self.size
+
+
+def _detach(self):
+    return jax.lax.stop_gradient(self)
+
+
+def _cpu(self):
+    return jax.device_put(self, jax.devices("cpu")[0])
+
+
+def _cuda(self, device_id=None):
+    return jax.device_put(self, jax.devices()[device_id or 0])
+
+
+def _dim(self):
+    return self.ndim
+
+
+def _t(self, name=None):
+    if self.ndim > 2:
+        raise ValueError("t() expects a tensor with <= 2 dimensions")
+    return self.T
+
+
+def _scale(self, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    if bias_after_scale:
+        return self * scale + bias
+    return (self + bias) * scale
+
+
+def _topk(self, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    x = self if largest else -self
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, self.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx
+
+
+def _index_select(self, index, axis=0, name=None):
+    return jnp.take(self, index, axis=axis)
+
+
+def _masked_fill(self, mask, value, name=None):
+    return jnp.where(mask, jnp.asarray(value, self.dtype), self)
+
+
+def _expand(self, shape, name=None):
+    out = []
+    lead = len(shape) - self.ndim
+    for i, s in enumerate(shape):
+        if s in (-1, None):
+            if i < lead:
+                raise ValueError(
+                    f"expand: dim {i} is new (input has {self.ndim} "
+                    "dims) so -1 has no size to inherit")
+            out.append(self.shape[i - lead])
+        else:
+            out.append(s)
+    return jnp.broadcast_to(self, out)
+
+
+def _tile(self, repeat_times, name=None):
+    return jnp.tile(self, repeat_times)
+
+
+def _split(self, num_or_sections, axis=0, name=None):
+    if isinstance(num_or_sections, int):
+        return jnp.split(self, num_or_sections, axis=axis)
+    sizes = list(num_or_sections)
+    if sizes.count(-1) > 1:
+        raise ValueError("split: at most one section may be -1")
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = self.shape[axis] - known
+    offs = _np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(self, offs, axis=axis)
+
+
+def _chunk(self, chunks, axis=0, name=None):
+    return jnp.array_split(self, chunks, axis=axis)
+
+
+def _equal_all(self, y, name=None):
+    # shapes are static; the VALUE comparison stays traced (works
+    # under jit — paddle's equal_all returns a tensor too)
+    if self.shape != y.shape:
+        return jnp.asarray(False)
+    return (self == y).all()
+
+
+def _stop_gradient_get(self):
+    # plain data arrays are constants to autodiff (paddle's default
+    # True); Parameters — the trainable leaves — carry their own
+    # trainable flag. Assignment is meaningless on an immutable array.
+    return True
+
+
+def _stop_gradient_set(self, value):
+    raise AttributeError(
+        "jax arrays are immutable constants to autodiff; trainability "
+        "lives on Parameter.trainable (gradients are explicit "
+        "transforms, not tape state)")
+
+
+_METHODS = {
+    "numpy": _numpy,
+    "unsqueeze": _unsqueeze,
+    "numel": _numel,
+    "detach": _detach,
+    "cpu": _cpu,
+    "cuda": _cuda,
+    "dim": _dim,
+    "t": _t,
+    "scale": _scale,
+    "topk": _topk,
+    "index_select": _index_select,
+    "masked_fill": _masked_fill,
+    "expand": _expand,
+    "tile": _tile,
+    "split": _split,
+    "chunk": _chunk,
+    "equal_all": _equal_all,
+    "abs": _unary(jnp.abs),
+    "exp": _unary(jnp.exp),
+    "log": _unary(jnp.log),
+    "log2": _unary(jnp.log2),
+    "log10": _unary(jnp.log10),
+    "log1p": _unary(jnp.log1p),
+    "sqrt": _unary(jnp.sqrt),
+    "rsqrt": _unary(lambda x: jax.lax.rsqrt(x)),
+    "sin": _unary(jnp.sin),
+    "cos": _unary(jnp.cos),
+    "tan": _unary(jnp.tan),
+    "tanh": _unary(jnp.tanh),
+    "sigmoid": _unary(jax.nn.sigmoid),
+    "floor": _unary(jnp.floor),
+    "ceil": _unary(jnp.ceil),
+    "sign": _unary(jnp.sign),
+    "erf": _unary(jax.scipy.special.erf),
+    "neg": _unary(jnp.negative),
+    "reciprocal": _unary(jnp.reciprocal),
+    "isnan": _unary(jnp.isnan),
+    "isinf": _unary(jnp.isinf),
+    "isfinite": _unary(jnp.isfinite),
+    "add": _binary(jnp.add),
+    "subtract": _binary(jnp.subtract),
+    "multiply": _binary(jnp.multiply),
+    "divide": _binary(jnp.divide),
+    "floor_divide": _binary(jnp.floor_divide),
+    "mod": _binary(jnp.remainder),
+    "remainder": _binary(jnp.remainder),
+    "pow": _binary(jnp.power),
+    "matmul": _binary(jnp.matmul),
+    "mm": _binary(jnp.matmul),
+    "dot": _binary(jnp.dot),
+    "maximum": _binary(jnp.maximum),
+    "minimum": _binary(jnp.minimum),
+    "allclose": _binary(jnp.allclose),
+    "equal": _binary(jnp.equal),
+    "not_equal": _binary(jnp.not_equal),
+    "greater_than": _binary(jnp.greater),
+    "greater_equal": _binary(jnp.greater_equal),
+    "less_than": _binary(jnp.less),
+    "less_equal": _binary(jnp.less_equal),
+    "logical_and": _binary(jnp.logical_and),
+    "logical_or": _binary(jnp.logical_or),
+}
+
+
+def install():
+    """Install the paddle method surface onto jax.Array — additive
+    only, idempotent. Concrete arrays (ArrayImpl) find methods through
+    the jax.Array ABC; TRACERS route attribute lookup through their
+    aval, so each method is also registered on ShapedArray via jax's
+    own aval_method mechanism (the exact machinery jax uses for .sum) —
+    migrating method calls keep working inside jit/grad."""
+    try:
+        from jax._src import core as _core
+
+        shaped = _core.ShapedArray
+        aval_method = _core.aval_method
+    except (ImportError, AttributeError):  # private-API drift
+        shaped = aval_method = None
+    for name, fn in _METHODS.items():
+        if not hasattr(jax.Array, name):
+            setattr(jax.Array, name, fn)
+            if shaped is not None and not hasattr(shaped, name):
+                setattr(shaped, name, aval_method(fn))
+    if not hasattr(jax.Array, "stop_gradient"):
+        try:
+            jax.Array.stop_gradient = property(_stop_gradient_get,
+                                               _stop_gradient_set)
+            if shaped is not None:
+                shaped.stop_gradient = _core.aval_property(
+                    _stop_gradient_get)
+        except (AttributeError, TypeError):
+            pass
+    if not hasattr(jax.Array, "place"):
+        try:
+            jax.Array.place = property(
+                lambda self: next(iter(self.devices())))
+        except (AttributeError, TypeError):
+            pass

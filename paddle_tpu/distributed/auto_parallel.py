@@ -253,12 +253,21 @@ def dtensor_to_local(x, mesh=None, placements=None):
 
 
 def unshard_dtensor(x):
-    """Parity: dist.unshard_dtensor — gather to a fully replicated
-    array (device_get + re-put keeps it simple and always correct; XLA
-    elides the copy for already-replicated inputs)."""
+    """Parity: dist.unshard_dtensor — replicate across the array's own
+    mesh. Sharded-on-a-mesh inputs get an explicit fully-replicated
+    NamedSharding (XLA inserts the all-gather); plain single-device
+    arrays pass through. Multi-host non-addressable arrays must be
+    gathered by the caller's collective (jax forbids implicit cross-host
+    device_get)."""
     import jax
 
-    return jax.device_put(jax.device_get(x))
+    sharding = getattr(x, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
 
 
 def parallelize(model, optimizer=None, mesh=None, config=None):

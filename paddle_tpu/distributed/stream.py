@@ -14,7 +14,11 @@ from . import collective as _c
 def _streamified(fn):
     @functools.wraps(fn)
     def wrapper(*args, sync_op=True, use_calc_stream=False, **kw):
-        return fn(*args, **kw)
+        out = fn(*args, **kw)
+        if not sync_op:
+            # paddle's async contract returns a waitable task
+            return _c._Task(out)
+        return out
 
     return wrapper
 

@@ -11,22 +11,39 @@ __all__ = ["nn", "LookAhead", "ModelAverage", "EMA",
            "softmax_mask_fuse_upper_triangle", "identity_loss"]
 
 
-def segment_sum(data, segment_ids, name=None):
-    """Parity: paddle.incubate.segment_sum — jax.ops.segment_sum with
-    num_segments = max_id + 1 (matches the reference's dynamic sizing;
-    under jit pass dense ids so the bound is static)."""
-    import jax
+def _n_segments(segment_ids, num_segments):
+    """Segment count: explicit > static-shape inference. paddle sizes
+    the output dynamically (max_id + 1) — legal in an eager op, not in
+    a compiled program, so under tracing callers must pass
+    ``num_segments`` (the jit-able extension paddle lacks)."""
+    if num_segments is not None:
+        return int(num_segments)
     import jax.numpy as jnp
 
-    n = int(jnp.max(segment_ids)) + 1
+    mx = jnp.max(segment_ids)
+    try:
+        return int(mx) + 1
+    except Exception as e:  # traced: no concrete max available
+        raise ValueError(
+            "segment ops under jit need an explicit num_segments= "
+            "(output shapes must be static in a compiled program)"
+        ) from e
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    """Parity: paddle.incubate.segment_sum (+ a ``num_segments``
+    extension so the op works under jit)."""
+    import jax
+
+    n = _n_segments(segment_ids, num_segments)
     return jax.ops.segment_sum(data, segment_ids, num_segments=n)
 
 
-def _segment_reduce(data, segment_ids, kind):
+def _segment_reduce(data, segment_ids, kind, num_segments=None):
     import jax
     import jax.numpy as jnp
 
-    n = int(jnp.max(segment_ids)) + 1
+    n = _n_segments(segment_ids, num_segments)
     if kind == "mean":
         s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
         c = jax.ops.segment_sum(jnp.ones_like(data), segment_ids,
@@ -37,16 +54,16 @@ def _segment_reduce(data, segment_ids, kind):
     return jax.ops.segment_min(data, segment_ids, num_segments=n)
 
 
-def segment_mean(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "mean")
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "mean", num_segments)
 
 
-def segment_max(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "max")
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "max", num_segments)
 
 
-def segment_min(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "min")
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "min", num_segments)
 
 
 def graph_send_recv(x, src_index, dst_index, pool_type="sum",
@@ -57,8 +74,7 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
     import jax.numpy as jnp
 
     msgs = x[src_index]
-    n = int(out_size) if out_size is not None \
-        else int(jnp.max(dst_index)) + 1
+    n = _n_segments(dst_index, out_size)
     pool = pool_type.lower()
     if pool == "sum":
         return jax.ops.segment_sum(msgs, dst_index, num_segments=n)

@@ -87,8 +87,9 @@ def program_guard(main_program=None, startup_program=None):
     yield main_program
 
 
-class _Program:
-    """Minimal Program stand-in (parity: paddle.static.Program)."""
+class Program:
+    """Minimal Program stand-in (parity: paddle.static.Program — a real
+    class so isinstance checks in migrating code keep working)."""
 
     def global_block(self):
         return self
@@ -97,8 +98,8 @@ class _Program:
         return self
 
 
-_MAIN = _Program()
-_STARTUP = _Program()
+_MAIN = Program()
+_STARTUP = Program()
 
 
 def default_main_program():
@@ -107,7 +108,3 @@ def default_main_program():
 
 def default_startup_program():
     return _STARTUP
-
-
-def Program():  # noqa: N802 (paddle spells it as a class)
-    return _Program()

@@ -331,3 +331,85 @@ def test_spawn_sets_rank_env(tmp_path):
     masters = {p.read_text() for p in tmp_path.iterdir()}
     # one shared coordinator address, set before fork
     assert len(masters) == 1 and ":" in masters.pop()
+
+
+def test_tensor_method_surface():
+    """paddle.Tensor methods installed on jax.Array — additive only."""
+    x = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(x.numpy(), np.asarray(x))
+    assert x.unsqueeze(0).shape == (1, 2, 2)
+    assert x.numel() == 4 and x.dim() == 2
+    np.testing.assert_allclose(x.t(), np.asarray(x).T)
+    np.testing.assert_allclose(x.abs(), np.abs(np.asarray(x)))
+    np.testing.assert_allclose(x.scale(2.0, 1.0),
+                               np.asarray(x) * 2 + 1)
+    v, i = x.topk(1)
+    np.testing.assert_allclose(np.asarray(v)[:, 0], [1.0, 4.0])
+    np.testing.assert_allclose(
+        x.masked_fill(x < 0, 0.0), [[1.0, 0.0], [3.0, 4.0]])
+    assert x.expand([3, 2, 2]).shape == (3, 2, 2)
+    parts = x.split(2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 1)
+    assert bool(x.equal_all(x)) and not bool(x.equal_all(x + 1))
+    np.testing.assert_allclose(x.add(x), np.asarray(x) * 2)
+    np.testing.assert_allclose(x.matmul(x), np.asarray(x) @ np.asarray(x))
+    assert bool(x.greater_than(jnp.zeros_like(x))[0, 0])
+    assert x.detach().shape == x.shape
+    assert x.cpu().shape == x.shape
+    # stop_gradient: readable (paddle default True), assignment raises
+    # with the migration hint
+    assert x.stop_gradient is True
+    with pytest.raises(AttributeError, match="Parameter.trainable"):
+        x.stop_gradient = False
+    # jax's own names were NOT overridden
+    assert x.sum() == jnp.sum(x)
+    assert x.reshape(4).shape == (4,)
+
+
+def test_tensor_methods_under_tracing():
+    """Method calls survive jit/grad: tracers resolve them through the
+    aval registration (jax's own .sum mechanism)."""
+
+    @jax.jit
+    def f(x):
+        return x.unsqueeze(0).abs().scale(2.0).squeeze(0) + x.detach()
+
+    np.testing.assert_allclose(f(jnp.asarray([-1.0, 2.0])), [1.0, 6.0])
+    g = jax.grad(lambda x: x.abs().sum())(jnp.asarray([-3.0, 4.0]))
+    np.testing.assert_allclose(g, [-1.0, 1.0])
+
+
+def test_review_fix_details():
+    x = jnp.arange(10.0)
+    # split with -1 = remaining
+    a, b, c = x.split([2, -1, 3])
+    assert (a.shape[0], b.shape[0], c.shape[0]) == (2, 5, 3)
+    with pytest.raises(ValueError, match="-1"):
+        x.split([2, -1, -1])
+    # expand: -1 only inherits existing dims
+    m = jnp.ones((2, 3))
+    with pytest.raises(ValueError, match="new"):
+        m.expand([-1, 2, 3])
+    assert m.expand([4, -1, 3]).shape == (4, 2, 3)
+    # equal_all works under jit (returns a traced scalar)
+    eq = jax.jit(lambda a, b: a.equal_all(b))(m, m)
+    assert bool(eq)
+    # segment ops: num_segments makes them jit-able
+    ids = jnp.asarray([0, 0, 1])
+    f = jax.jit(lambda d: pt.incubate.segment_sum(d, ids,
+                                                  num_segments=2))
+    np.testing.assert_allclose(f(jnp.asarray([1.0, 2.0, 3.0])),
+                               [3.0, 3.0])
+    with pytest.raises(ValueError, match="num_segments"):
+        jax.jit(lambda d, i: pt.incubate.segment_sum(d, i))(
+            jnp.ones((3,)), ids)
+    # async stream collective returns a waitable task
+    pt.distributed.fleet.init(is_collective=True)
+    n = jax.device_count()
+    task = pt.distributed.stream.all_reduce(jnp.ones((n,)),
+                                            sync_op=False)
+    out = task.wait()
+    np.testing.assert_allclose(out, np.full((n,), float(n)))
+    # Program is a class
+    prog = pt.static.default_main_program()
+    assert isinstance(prog, pt.static.Program)
