@@ -31,6 +31,12 @@ def _numpy(self):
     return _np.asarray(self)
 
 
+def _cast(self, dtype, name=None):
+    from . import dtype as _dtype_mod
+
+    return self.astype(_dtype_mod.convert_dtype(dtype))
+
+
 def _unsqueeze(self, axis, name=None):
     return jnp.expand_dims(self, axis)
 
@@ -124,6 +130,12 @@ def _chunk(self, chunks, axis=0, name=None):
     return jnp.array_split(self, chunks, axis=axis)
 
 
+def _allclose(self, y, rtol=1e-05, atol=1e-08, equal_nan=False,
+              name=None):
+    return jnp.allclose(self, y, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
 def _equal_all(self, y, name=None):
     # shapes are static; the VALUE comparison stays traced (works
     # under jit — paddle's equal_all returns a tensor too)
@@ -148,6 +160,7 @@ def _stop_gradient_set(self, value):
 
 _METHODS = {
     "numpy": _numpy,
+    "cast": _cast,
     "unsqueeze": _unsqueeze,
     "numel": _numel,
     "detach": _detach,
@@ -194,12 +207,14 @@ _METHODS = {
     "mod": _binary(jnp.remainder),
     "remainder": _binary(jnp.remainder),
     "pow": _binary(jnp.power),
+    # NOT "dot": jax.Array already defines .dot (matmul semantics), and
+    # the additive-only rule forbids overriding it; paddle's per-row
+    # dot lives at paddle_tpu.dot (tensor.py)
     "matmul": _binary(jnp.matmul),
     "mm": _binary(jnp.matmul),
-    "dot": _binary(jnp.dot),
     "maximum": _binary(jnp.maximum),
     "minimum": _binary(jnp.minimum),
-    "allclose": _binary(jnp.allclose),
+    "allclose": _allclose,
     "equal": _binary(jnp.equal),
     "not_equal": _binary(jnp.not_equal),
     "greater_than": _binary(jnp.greater),

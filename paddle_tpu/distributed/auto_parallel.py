@@ -263,11 +263,18 @@ def unshard_dtensor(x):
 
     sharding = getattr(x, "sharding", None)
     mesh = getattr(sharding, "mesh", None)
-    if mesh is None:
-        return x
-    from jax.sharding import NamedSharding, PartitionSpec
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
 
-    return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    # mesh-less shardings (GSPMDSharding from deserialized executables,
+    # PositionalSharding): replicate via host round-trip when the data
+    # is addressable; single-device arrays pass through
+    if sharding is None or len(getattr(sharding, "device_set", ())) <= 1:
+        return x
+    if getattr(x, "is_fully_addressable", True):
+        return jax.device_put(jax.device_get(x))
+    return x
 
 
 def parallelize(model, optimizer=None, mesh=None, config=None):

@@ -42,6 +42,12 @@ class IterableDataset(Dataset):
 
 class TensorDataset(Dataset):
     def __init__(self, *tensors):
+        # paddle's signature is TensorDataset(tensors) — one LIST of
+        # arrays (python/paddle/io/dataloader/dataset.py); the starred
+        # torch spelling is accepted too since both are common in
+        # migrating code
+        if len(tensors) == 1 and isinstance(tensors[0], (list, tuple)):
+            tensors = tuple(tensors[0])
         self.tensors = [np.asarray(t) for t in tensors]
         assert all(len(t) == len(self.tensors[0]) for t in self.tensors)
 
@@ -453,6 +459,12 @@ class DataLoader:
         if self.batch_sampler is None:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
+
+    def __call__(self):
+        # legacy paddle spelling: `for batch in loader():` — the
+        # fluid-era DataLoader was callable and 2.x kept it working;
+        # many tutorials (and migrating scripts) use this form
+        return iter(self)
 
 
 def prefetch_to_device(iterator: Iterable, size: int = 2,

@@ -234,7 +234,17 @@ def norm(x, p="fro", axis=None, keepdim=False):
     if pp == float("-inf"):
         return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
     return jnp.sum(jnp.abs(x) ** pp, axis=axis, keepdims=keepdim) ** (1.0 / pp)
-dot = _alias(jnp.dot)
+def dot(x, y, name=None):
+    """Parity: paddle.dot — 1-D inner product; 2-D is the PER-ROW inner
+    product returning [batch] (NOT a matmul, unlike numpy/jax dot)."""
+    x, y = _v(x), _v(y)
+    if x.ndim == 1:
+        return jnp.sum(x * y)
+    if x.ndim == 2:
+        return jnp.sum(x * y, axis=-1)
+    raise ValueError(f"dot expects 1-D/2-D inputs, got {x.ndim}-D")
+
+
 outer = _alias(jnp.outer)
 roll = _alias(jnp.roll)
 flip = _alias(jnp.flip)

@@ -13,6 +13,7 @@ EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 SCRIPTS = [
     "train_llama_hybrid.py",
+    "migrate_from_paddle.py",
     "finetune_bert_classifier.py",
     "generate_text.py",
     "audio_keyword_spotting.py",

@@ -337,6 +337,8 @@ def test_tensor_method_surface():
     """paddle.Tensor methods installed on jax.Array — additive only."""
     x = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
     np.testing.assert_allclose(x.numpy(), np.asarray(x))
+    assert x.cast("float16").dtype == jnp.float16
+    assert x.cast(pt.bfloat16).dtype == jnp.bfloat16
     assert x.unsqueeze(0).shape == (1, 2, 2)
     assert x.numel() == 4 and x.dim() == 2
     np.testing.assert_allclose(x.t(), np.asarray(x).T)
@@ -413,3 +415,17 @@ def test_review_fix_details():
     # Program is a class
     prog = pt.static.default_main_program()
     assert isinstance(prog, pt.static.Program)
+
+
+def test_dot_and_allclose_paddle_semantics():
+    # paddle.dot: per-ROW inner product on 2-D (not matmul)
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = jnp.asarray([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose(pt.dot(a, b), [17.0, 53.0])
+    assert float(pt.dot(jnp.asarray([1.0, 2.0]),
+                        jnp.asarray([3.0, 4.0]))) == 11.0
+    with pytest.raises(ValueError, match="1-D/2-D"):
+        pt.dot(jnp.ones((2, 2, 2)), jnp.ones((2, 2, 2)))
+    # method allclose forwards tolerances
+    assert bool(a.allclose(a + 1e-7, rtol=1e-3))
+    assert not bool(a.allclose(a + 1.0, rtol=1e-6))
