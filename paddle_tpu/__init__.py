@@ -216,3 +216,8 @@ class DataParallel(Layer):
             return super().__getattr__(name)
         except AttributeError:
             return getattr(self._layers, name)
+
+from .hapi import Model  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import hub  # noqa: F401,E402

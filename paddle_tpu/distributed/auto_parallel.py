@@ -268,12 +268,20 @@ def unshard_dtensor(x):
 
         return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
     # mesh-less shardings (GSPMDSharding from deserialized executables,
-    # PositionalSharding): replicate via host round-trip when the data
-    # is addressable; single-device arrays pass through
+    # PositionalSharding): replicate over the SAME device set
     if sharding is None or len(getattr(sharding, "device_set", ())) <= 1:
         return x
     if getattr(x, "is_fully_addressable", True):
-        return jax.device_put(jax.device_get(x))
+        try:
+            from jax.sharding import PositionalSharding
+
+            repl = PositionalSharding(
+                sorted(sharding.device_set, key=lambda d: d.id)
+            ).replicate()
+            return jax.device_put(x, repl)
+        except Exception:
+            # last resort keeps correctness on one device
+            return jax.device_put(jax.device_get(x))
     return x
 
 

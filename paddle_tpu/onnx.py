@@ -1,0 +1,18 @@
+"""paddle.onnx namespace (parity: python/paddle/onnx/__init__.py).
+
+ONNX is a CUDA-ecosystem interchange format; the TPU-native export
+path is StableHLO via ``paddle_tpu.jit.save`` (portable, versioned,
+loadable by jax.export everywhere — see MAPPING.md "ONNX export").
+``export`` raises with that pointer instead of silently writing a file
+other TPU tooling could not consume.
+"""
+
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is N/A on the TPU stack (see MAPPING.md): the "
+        "portable export format here is StableHLO — use "
+        "paddle_tpu.jit.save(layer, path, input_spec) and load with "
+        "paddle_tpu.jit.load / jax.export on any jax platform")

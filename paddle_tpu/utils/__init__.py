@@ -1,6 +1,92 @@
 """paddle_tpu.utils (parity: paddle.utils — dlpack interop; the
 cpp_extension/install-check machinery is N/A in this build)."""
 
+import contextlib as _contextlib
+
 from . import dlpack  # noqa: F401
 
-__all__ = ["dlpack"]
+__all__ = ["dlpack", "deprecated", "try_import", "run_check", "unique_name"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Parity: paddle.utils.deprecated. level semantics match the
+    reference: 0 warns once per function, 1 warns on every call,
+    2 raises."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            if level >= 1 or not warned:
+                warned.append(True)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    """Parity: paddle.utils.try_import."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed"
+        ) from e
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — one tiny compiled computation
+    on the available devices, reporting what the install can do."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    out = jax.jit(lambda x: (x @ x).sum())(jnp.eye(8))
+    assert float(out) == 8.0
+    print(f"paddle_tpu is installed and working on {len(devs)} "
+          f"{devs[0].platform} device(s): {devs[0].device_kind}")
+
+
+class _UniqueName:
+    """Parity: paddle.utils.unique_name (generate/guard/switch)."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def switch(self, new_generator=None):
+        old = dict(self._counters)
+        self._counters = {} if new_generator is None else new_generator
+        return old
+
+    @_contextlib.contextmanager
+    def guard(self, new_generator=None):
+        old = self.switch({} if new_generator is None else new_generator)
+        try:
+            yield
+        finally:
+            self._counters = old
+
+
+unique_name = _UniqueName()

@@ -429,3 +429,72 @@ def test_dot_and_allclose_paddle_semantics():
     # method allclose forwards tolerances
     assert bool(a.allclose(a + 1e-7, rtol=1e-3))
     assert not bool(a.allclose(a + 1.0, rtol=1e-6))
+
+
+def test_model_callbacks_utils_hub(tmp_path):
+    import warnings
+
+    assert pt.Model is not None and pt.callbacks.EarlyStopping
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        pt.onnx.export(None, "x")
+
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(scale=1):\n    'doc'\n    return scale * 2\n")
+    assert pt.hub.list(str(tmp_path)) == ["tiny"]
+    assert pt.hub.load(str(tmp_path), "tiny", scale=3) == 6
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        pt.hub.load("github.com/x/y", "m", source="github")
+
+    @pt.utils.deprecated(update_to="new_fn", since="2.0")
+    def old_fn():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+    assert pt.utils.try_import("math").sqrt(4) == 2.0
+    with pytest.raises(ImportError, match="custom msg"):
+        pt.utils.try_import("no_such_module_xyz", "custom msg")
+    g = pt.utils.unique_name
+    a, b = g.generate("w"), g.generate("w")
+    assert a != b
+    with g.guard():
+        assert g.generate("w").endswith("_0")
+
+
+def test_deprecated_levels_and_hub_cache(tmp_path):
+    import warnings
+
+    calls = []
+
+    @pt.utils.deprecated(level=0)
+    def f0():
+        calls.append(0)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        f0()
+        f0()
+    # level 0: once per function, not per call
+    assert sum("deprecated" in str(x.message) for x in w) == 1
+
+    @pt.utils.deprecated(level=2, reason="gone")
+    def f2():
+        pass
+
+    with pytest.raises(RuntimeError, match="gone"):
+        f2()
+
+    # hub executes hubconf once per dir; force_reload re-executes
+    (tmp_path / "hubconf.py").write_text(
+        "import pathlib\n"
+        "_p = pathlib.Path(__file__).parent / 'count'\n"
+        "_p.write_text(str(int(_p.read_text()) + 1) "
+        "if _p.exists() else '1')\n"
+        "def m():\n    return 1\n")
+    pt.hub.list(str(tmp_path))
+    pt.hub.load(str(tmp_path), "m")
+    assert (tmp_path / "count").read_text() == "1"
+    pt.hub.list(str(tmp_path), force_reload=True)
+    assert (tmp_path / "count").read_text() == "2"
