@@ -272,16 +272,14 @@ def unshard_dtensor(x):
     if sharding is None or len(getattr(sharding, "device_set", ())) <= 1:
         return x
     if getattr(x, "is_fully_addressable", True):
-        try:
-            from jax.sharding import PositionalSharding
+        # replicate over the SAME device set via a throwaway 1-axis
+        # mesh (PositionalSharding no longer exists in current jax)
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-            repl = PositionalSharding(
-                sorted(sharding.device_set, key=lambda d: d.id)
-            ).replicate()
-            return jax.device_put(x, repl)
-        except Exception:
-            # last resort keeps correctness on one device
-            return jax.device_put(jax.device_get(x))
+        devs = np.array(sorted(sharding.device_set, key=lambda d: d.id))
+        repl = NamedSharding(Mesh(devs, ("_unshard",)), PartitionSpec())
+        return jax.device_put(x, repl)
     return x
 
 

@@ -46,10 +46,18 @@ from .common import (  # noqa: F401
     _f32up,
     _v,
     alpha_dropout,
+    bilinear,
+    channel_shuffle,
     cosine_similarity,
     dropout,
+    dropout2d,
     dropout3d,
     fold,
+    gumbel_softmax,
+    label_smooth,
+    pairwise_distance,
+    sequence_mask,
+    temporal_shift,
     interpolate,
     linear,
     pad,
@@ -71,6 +79,7 @@ from .flash_attention import (  # noqa: F401
 )
 from .input import embedding, one_hot  # noqa: F401
 from .loss import (  # noqa: F401
+    binary_cross_entropy,
     binary_cross_entropy_with_logits,
     cosine_embedding_loss,
     cross_entropy,
@@ -102,6 +111,9 @@ from .norm import (  # noqa: F401
 )
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d,
+    adaptive_max_pool1d,
+    avg_pool1d,
+    max_pool1d,
     adaptive_avg_pool2d,
     adaptive_avg_pool3d,
     adaptive_max_pool2d,
@@ -113,6 +125,7 @@ from .pooling import (  # noqa: F401
 from .vision import (  # noqa: F401
     _bilerp,
     grid_sample,
+    affine_grid,
     pixel_shuffle,
     pixel_unshuffle,
 )

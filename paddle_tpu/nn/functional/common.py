@@ -298,3 +298,116 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
         shape[1] = shape[2] = shape[3] = 1
     keep = jax.random.bernoulli(key, 1.0 - p, shape)
     return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Parity: F.bilinear — out[b, o] = x1[b] @ W[o] @ x2[b] (+bias);
+    weight [out, in1, in2]."""
+    x1, x2, weight = _v(x1), _v(x2), _v(weight)
+    y = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        y = y + _v(bias)
+    return y
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    """Whole-channel dropout for 4-D input (parity: F.dropout2d)."""
+    x = _v(x)
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_rng_key("dropout2d")
+    shape = list(x.shape)
+    if data_format == "NCHW":
+        shape[2] = shape[3] = 1
+    else:
+        shape[1] = shape[2] = 1
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    """Parity: F.pairwise_distance — ||x - y + eps||_p over the last
+    axis (inf/-inf norms included)."""
+    x, y = _v(x), _v(y)
+    d = jnp.abs(x - y + epsilon)
+    if p == float("inf"):
+        return jnp.max(d, axis=-1, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(d, axis=-1, keepdims=keepdim)
+    return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """Parity: paddle.nn.functional.sequence_mask — [..., maxlen] mask
+    of positions < length."""
+    from ...core import dtype as dtype_mod
+
+    lengths = _v(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < lengths.reshape(-1, 1)
+    mask = mask.reshape(*lengths.shape, maxlen)
+    return mask.astype(dtype_mod.convert_dtype(dtype))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """Parity: F.temporal_shift (TSM): within each segment of seg_num
+    frames, the first shift_ratio of channels shifts one frame back,
+    the next shift_ratio one frame forward, the rest stay."""
+    x = _v(x)
+    if data_format == "NHWC":
+        return jnp.transpose(
+            temporal_shift(jnp.transpose(x, (0, 3, 1, 2)), seg_num,
+                           shift_ratio), (0, 2, 3, 1))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [x5[:, 1:, :c1], jnp.zeros_like(x5[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x5[:, :1, c1:c2]), x5[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, x5[:, :, c2:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Parity: F.channel_shuffle (ShuffleNet)."""
+    x = _v(x)
+    if data_format == "NHWC":
+        return jnp.transpose(
+            channel_shuffle(jnp.transpose(x, (0, 3, 1, 2)), groups),
+            (0, 2, 3, 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """Parity: F.label_smooth — (1-eps)*label + eps*prior (uniform by
+    default over the last axis)."""
+    label = _v(label)
+    k = label.shape[-1]
+    prior = (1.0 / k if prior_dist is None else _v(prior_dist))
+    return (1.0 - epsilon) * label + epsilon * prior
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Parity: F.gumbel_softmax — differentiable categorical samples;
+    ``hard`` straight-through one-hots."""
+    x = _v(x)
+    key = random_mod.next_rng_key("gumbel")
+    g = jax.random.gumbel(key, x.shape, jnp.float32)
+    y = jax.nn.softmax((x.astype(jnp.float32) + g) / temperature,
+                       axis=axis)
+    if hard:
+        # straight-through: one-hot forward, soft gradients backward
+        onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis),
+                                y.shape[axis], axis=axis, dtype=y.dtype)
+        y = y + jax.lax.stop_gradient(onehot - y)
+    return y.astype(x.dtype)

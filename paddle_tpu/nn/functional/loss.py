@@ -421,3 +421,20 @@ def log_loss(input, label, epsilon=1e-4):  # noqa: A002
 
 def square_error_cost(input, label):  # noqa: A002
     return jnp.square(_v(input) - _v(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    """Parity: F.binary_cross_entropy — input are PROBABILITIES (the
+    post-sigmoid form; see binary_cross_entropy_with_logits for
+    logits)."""
+    p = _f32up(_v(input))
+    y = _v(label).astype(p.dtype)
+    eps = 1e-12
+    loss = -(y * jnp.log(jnp.maximum(p, eps))
+             + (1.0 - y) * jnp.log(jnp.maximum(1.0 - p, eps)))
+    if weight is not None:
+        loss = loss * _v(weight).astype(loss.dtype)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)

@@ -200,3 +200,25 @@ def adaptive_max_pool2d(x, output_size, return_mask=False,
     # joint argmax: row index gathered at the winning column
     ih_sel = jnp.take_along_axis(ih, iw, axis=-1)  # [n, c, Oh, Ow]
     return out, ih_sel * w + iw
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0,
+               data_format="NCL"):
+    return _pool_nd(_v(x), 1, kernel_size, stride, padding, data_format,
+                    "max")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0,
+               data_format="NCL"):
+    return _pool_nd(_v(x), 1, kernel_size, stride, padding, data_format,
+                    "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    """x [N, C, L] (parity: F.adaptive_max_pool1d)."""
+    x = _v(x)
+    y = adaptive_max_pool2d(x[:, :, None, :], (1, output_size),
+                            return_mask=return_mask)
+    if return_mask:
+        return y[0][:, :, 0], y[1][:, :, 0]
+    return y[:, :, 0]

@@ -111,3 +111,18 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
     x = x.reshape(b, h // r, r, w // r, r, c)
     x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
     return x.reshape(b, h // r, w // r, c * r * r)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Parity: F.affine_grid — [n, 2, 3] affine params -> [n, h, w, 2]
+    sampling grid in [-1, 1] coords (the grid_sample companion)."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    return jnp.einsum("hwk,nik->nhwi", base, theta)

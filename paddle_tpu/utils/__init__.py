@@ -8,16 +8,14 @@ from . import dlpack  # noqa: F401
 __all__ = ["dlpack", "deprecated", "try_import", "run_check", "unique_name"]
 
 
-def deprecated(update_to="", since="", reason="", level=0):
-    """Parity: paddle.utils.deprecated. level semantics match the
-    reference: 0 warns once per function, 1 warns on every call,
-    2 raises."""
+def deprecated(update_to="", since="", reason="", level=1):
+    """Parity: paddle.utils.deprecated — the reference's documented
+    level semantics: 0 = suppress the message, 1 = warn (default),
+    2 = raise RuntimeError."""
     import functools
     import warnings
 
     def wrap(fn):
-        warned = []
-
         @functools.wraps(fn)
         def inner(*args, **kwargs):
             msg = f"{fn.__name__} is deprecated"
@@ -29,8 +27,7 @@ def deprecated(update_to="", since="", reason="", level=0):
                 msg += f" ({reason})"
             if level >= 2:
                 raise RuntimeError(msg)
-            if level >= 1 or not warned:
-                warned.append(True)
+            if level == 1:
                 warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
 

@@ -212,3 +212,100 @@ class Cifar100(Cifar10):
 
     _batches_train = ["train"]
     _batches_test = ["test"]
+
+
+class Flowers(Dataset):
+    """Oxford-102 Flowers from local files (parity:
+    paddle.vision.datasets.Flowers): ``data_file`` is the image tarball
+    (jpg files), ``label_file`` the imagelabels .mat, ``setid_file``
+    the split ids .mat."""
+
+    _split_key = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend="cv2"):
+        if data_file is None or label_file is None or setid_file is None:
+            _no_download("Flowers")
+        from scipy.io import loadmat
+
+        labels = loadmat(label_file)["labels"][0]
+        ids = loadmat(setid_file)[self._split_key[mode]][0]
+        self.transform = transform
+        self._records = []
+        with tarfile.open(data_file, "r:*") as tf:
+            by_name = {os.path.basename(m.name): m
+                       for m in tf.getmembers() if m.isfile()}
+            for i in ids:
+                name = f"image_{int(i):05d}.jpg"
+                if name in by_name:
+                    data = tf.extractfile(by_name[name]).read()
+                    self._records.append((data, int(labels[i - 1]) - 1))
+
+    def __len__(self):
+        return len(self._records)
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        data, label = self._records[idx]
+        img = np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs from the local devkit tarball
+    (parity: paddle.vision.datasets.VOC2012): yields (image, label
+    mask) uint8 arrays per the split list."""
+
+    _lists = {"train": "train.txt", "valid": "val.txt",
+              "trainval": "trainval.txt", "test": "val.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if data_file is None:
+            _no_download("VOC2012")
+        self.transform = transform
+        with tarfile.open(data_file, "r:*") as tf:
+            members = {m.name: m for m in tf.getmembers() if m.isfile()}
+            list_suffix = ("ImageSets/Segmentation/"
+                           + self._lists[mode])
+            list_name = next(
+                (n for n in members if n.endswith(list_suffix)), None)
+            if list_name is None:
+                raise FileNotFoundError(list_suffix)
+            # devkit root derived once -> O(1) member lookups per name
+            root = list_name[: -len(list_suffix)]
+            names = tf.extractfile(members[list_name]).read() \
+                .decode().split()
+            # store COMPRESSED bytes; decode per __getitem__ (the
+            # trainval split is ~2.9k full-res pairs — eager decode
+            # would cost multi-GB of resident uint8)
+            self._records = []
+            for n in names:
+                img_m = members.get(f"{root}JPEGImages/{n}.jpg")
+                seg_m = members.get(f"{root}SegmentationClass/{n}.png")
+                if img_m is None or seg_m is None:
+                    continue
+                self._records.append(
+                    (tf.extractfile(img_m).read(),
+                     tf.extractfile(seg_m).read()))
+
+    def __len__(self):
+        return len(self._records)
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        img_b, seg_b = self._records[idx]
+        img = np.asarray(Image.open(_io.BytesIO(img_b)).convert("RGB"))
+        seg = np.asarray(Image.open(_io.BytesIO(seg_b)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, seg

@@ -466,18 +466,25 @@ def test_model_callbacks_utils_hub(tmp_path):
 def test_deprecated_levels_and_hub_cache(tmp_path):
     import warnings
 
-    calls = []
-
     @pt.utils.deprecated(level=0)
     def f0():
-        calls.append(0)
+        return 0
 
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
-        f0()
-        f0()
-    # level 0: once per function, not per call
-    assert sum("deprecated" in str(x.message) for x in w) == 1
+        assert f0() == 0
+    # paddle level semantics: 0 = suppressed
+    assert not w
+
+    @pt.utils.deprecated(since="2.0")
+    def f1():
+        return 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert f1() == 1
+    # default level 1 = warn
+    assert any("deprecated" in str(x.message) for x in w)
 
     @pt.utils.deprecated(level=2, reason="gone")
     def f2():
@@ -498,3 +505,136 @@ def test_deprecated_levels_and_hub_cache(tmp_path):
     assert (tmp_path / "count").read_text() == "1"
     pt.hub.list(str(tmp_path), force_reload=True)
     assert (tmp_path / "count").read_text() == "2"
+
+
+def test_functional_additions_parity():
+    rng = np.random.default_rng(0)
+    x1 = rng.standard_normal((3, 4)).astype("f")
+    x2 = rng.standard_normal((3, 5)).astype("f")
+    w = rng.standard_normal((6, 4, 5)).astype("f")
+    b = rng.standard_normal((6,)).astype("f")
+    np.testing.assert_allclose(
+        np.asarray(F.bilinear(jnp.asarray(x1), jnp.asarray(x2),
+                              jnp.asarray(w), jnp.asarray(b))),
+        tF.bilinear(torch.tensor(x1), torch.tensor(x2), torch.tensor(w),
+                    torch.tensor(b)).numpy(), rtol=1e-4, atol=1e-5)
+
+    p = np.clip(rng.random((4, 3)).astype("f"), 1e-3, 1 - 1e-3)
+    y = rng.integers(0, 2, (4, 3)).astype("f")
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy(jnp.asarray(p), jnp.asarray(y))),
+        float(tF.binary_cross_entropy(torch.tensor(p),
+                                      torch.tensor(y))), rtol=1e-5)
+
+    xl = rng.standard_normal((2, 3, 9)).astype("f")
+    np.testing.assert_allclose(
+        np.asarray(F.max_pool1d(jnp.asarray(xl), 3, 3)),
+        tF.max_pool1d(torch.tensor(xl), 3, 3).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.avg_pool1d(jnp.asarray(xl), 3, 3)),
+        tF.avg_pool1d(torch.tensor(xl), 3, 3).numpy(), rtol=1e-5)
+    o, m = F.adaptive_max_pool1d(jnp.asarray(xl), 4, return_mask=True)
+    to, tm = tF.adaptive_max_pool1d(torch.tensor(xl), 4,
+                                    return_indices=True)
+    np.testing.assert_allclose(np.asarray(o), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(m), tm.numpy())
+
+    th = rng.standard_normal((2, 2, 3)).astype("f")
+    for ac in (True, False):
+        np.testing.assert_allclose(
+            np.asarray(F.affine_grid(jnp.asarray(th), (2, 1, 4, 5),
+                                     align_corners=ac)),
+            tF.affine_grid(torch.tensor(th), (2, 1, 4, 5),
+                           align_corners=ac).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    xc = rng.standard_normal((2, 6, 4, 4)).astype("f")
+    np.testing.assert_allclose(
+        np.asarray(F.channel_shuffle(jnp.asarray(xc), 3)),
+        tF.channel_shuffle(torch.tensor(xc), 3).numpy())
+
+    np.testing.assert_array_equal(
+        np.asarray(F.sequence_mask(jnp.asarray([2, 4]), maxlen=5)),
+        [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+
+    lbl = jnp.asarray(np.eye(4, dtype="f")[[0, 2]])
+    np.testing.assert_allclose(
+        np.asarray(F.label_smooth(lbl, epsilon=0.1)).sum(-1),
+        [1.0, 1.0], rtol=1e-6)
+
+    pt.seed(0)
+    g = F.gumbel_softmax(
+        jnp.asarray(rng.standard_normal((5, 8)).astype("f")), hard=True)
+    assert np.allclose(np.asarray(g).sum(-1), 1.0)
+    assert set(np.unique(np.asarray(g))) <= {0.0, 1.0}
+    # straight-through gradients flow
+    gr = jax.grad(lambda z: F.gumbel_softmax(z, hard=True).sum())(
+        jnp.ones((2, 3)))
+    assert gr.shape == (2, 3)
+
+    # temporal shift: zero-padded ends, shifted channel blocks
+    ts_in = jnp.asarray(np.arange(8 * 4, dtype="f").reshape(8, 4, 1, 1))
+    out = F.temporal_shift(ts_in, seg_num=4, shift_ratio=0.25)
+    ref5 = np.asarray(ts_in).reshape(2, 4, 4, 1, 1)
+    got5 = np.asarray(out).reshape(2, 4, 4, 1, 1)
+    np.testing.assert_allclose(got5[:, :-1, 0], ref5[:, 1:, 0])  # back
+    np.testing.assert_allclose(got5[:, -1, 0], 0.0)
+    np.testing.assert_allclose(got5[:, 1:, 1], ref5[:, :-1, 1])  # fwd
+    np.testing.assert_allclose(got5[:, 0, 1], 0.0)
+    np.testing.assert_allclose(got5[:, :, 2:], ref5[:, :, 2:])  # rest
+
+
+def test_voc2012_and_flowers_local(tmp_path):
+    """Synthetic devkit tarball: VOC2012 stores compressed bytes and
+    decodes lazily; member lookup is root-prefix exact (not a scan)."""
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    def _png(arr):
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="PNG")
+        return b.getvalue()
+
+    def _jpg(arr):
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="JPEG")
+        return b.getvalue()
+
+    tar_path = tmp_path / "voc.tar"
+    root = "VOCdevkit/VOC2012/"
+    with tarfile.open(tar_path, "w") as tf:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+        add(root + "ImageSets/Segmentation/train.txt", b"a\nb\n")
+        rng = np.random.default_rng(0)
+        for n in ("a", "b"):
+            add(root + f"JPEGImages/{n}.jpg",
+                _jpg(rng.integers(0, 255, (8, 8, 3)).astype("uint8")))
+            add(root + f"SegmentationClass/{n}.png",
+                _png(rng.integers(0, 20, (8, 8)).astype("uint8")))
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    ds = VOC2012(data_file=str(tar_path), mode="train")
+    assert len(ds) == 2
+    img, seg = ds[0]
+    assert img.shape == (8, 8, 3) and seg.shape == (8, 8)
+    # records hold compressed BYTES, not decoded arrays
+    assert isinstance(ds._records[0][0], bytes)
+
+
+def test_pairwise_distance_inf_norm():
+    x = jnp.asarray([[1.0, 5.0]])
+    y = jnp.zeros((1, 2))
+    assert abs(float(F.pairwise_distance(x, y, p=float("inf"))[0])
+               - 5.0) < 1e-4
+    # sequence_mask defaults to paddle's int64 (which the framework's
+    # dtype convention maps to jax's default int width)
+    out = F.sequence_mask(jnp.asarray([2]))
+    assert jnp.issubdtype(out.dtype, jnp.integer)
+    assert out.dtype != jnp.bool_
