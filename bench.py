@@ -549,6 +549,10 @@ def _maybe_write_baseline(result):
                 r.get("extra", {}).get("platform") == "tpu"):
             base_sec[key] = {"metric": r["metric"], "value": r["value"],
                              "unit": r["unit"]}
+            # config variants the ratio must never silently fold in
+            for variant in ("compute_dtype", "conv_layout"):
+                if variant in r.get("extra", {}):
+                    base_sec[key][variant] = r["extra"][variant]
             changed = True
     if changed:
         with open(BASELINE_PATH, "w") as f:
@@ -582,6 +586,22 @@ def _apply_baseline_ratio(result):
                 and r.get("extra", {}).get("platform") == "tpu"
                 and r.get("value")):
             r["vs_baseline"] = round(r["value"] / float(b["value"]), 3)
+            # same rule as the headline's moment_dtype: the ratio stays
+            # (same training task) but a config-variant change is NAMED
+            # instead of silently folded into the 'speedup'. Baselines
+            # recorded before this field existed were fp32/NCHW captures
+            # (BASELINE.md round-5 note), hence the defaults.
+            notes = []
+            for variant, default in (("compute_dtype", "float32"),
+                                     ("conv_layout", "NCHW")):
+                b_v = b.get(variant, default)
+                r_v = r.get("extra", {}).get(variant)
+                if r_v is not None and r_v != b_v:
+                    notes.append(f"baseline ran {variant}={b_v}, "
+                                 f"this run {r_v}")
+            if notes:
+                r.setdefault("extra", {})["vs_baseline_note"] = \
+                    "; ".join(notes)
 
 
 SECONDARY_TIMEOUT = 560   # per config; each compiles its own programs

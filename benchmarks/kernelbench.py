@@ -1,9 +1,10 @@
-"""Kernel-level roofline micro-bench for the Pallas flash attention.
+"""Kernel-level roofline micro-benches: Pallas flash attention (FLOP
+roofline) and fused GroupNorm+SiLU (HBM-bytes roofline).
 
-Measures forward and forward+backward device time at the headline bench
-shape and reports each against its FLOP roofline (chip peak), the
-number VERDICT r4 item 4 asks to be tracked ("bwd kernel >= 45% of
-roofline or a documented analysis").
+Flash: forward and forward+backward device time at the headline bench
+shape, each against the chip's FLOP peak — the number VERDICT r4 item 4
+asks to be tracked ("bwd kernel >= 45% of roofline or a documented
+analysis").
 
 FLOP accounting (causal): softmax(QK^T)V does 2 matmuls of
 2*b*h*sq*sk*d FLOPs each, halved by causal masking. Backward does 5
@@ -11,6 +12,13 @@ tile-matmuls in the fused kernel (dv, dp, ds->dq, ds->dk, s recompute)
 -> bwd FLOPs = 2.5x fwd. Elementwise VPU work is excluded from the
 denominator, so the ratio is a true MXU roofline (VPU-bound kernels
 show up as a low ratio, which is the point).
+
+GroupNorm: bandwidth-bound (O(1) FLOPs/byte), so its roofline is HBM
+bytes over peak bandwidth — fwd moves 2 activation passes (1 read + 1
+write), fwd+bwd 5. Each SD-UNet-representative NHWC shape reports the
+fused kernel's achieved fraction of that floor, plus the unfused
+XLA-native NCHW GroupNorm at the same shape as the A/B (what the fusion
++ layout policy actually buys).
 
 Usage: python benchmarks/kernelbench.py  (needs the real TPU; prints
 one JSON line per shape).
@@ -81,6 +89,7 @@ def main():
         # grad-of-sum runs fwd (for residuals) + bwd kernels
         bwd_ms = max(tot_ms - fwd_ms, 1e-6)
         out = {
+            "kernel": "flash_attention",
             "shape": f"b{b}xs{s}xh{h}xd{d}",
             "fwd_ms": round(fwd_ms, 3),
             "fwd_bwd_ms": round(tot_ms, 3),
@@ -88,6 +97,91 @@ def main():
             "fwd_roofline": round(fwd_flops / (fwd_ms / 1e3) / peak, 3),
             "bwd_roofline": round(bwd_flops / (bwd_ms / 1e3) / peak, 3),
             "peak_flops": peak,
+        }
+        print(json.dumps(out), flush=True)
+
+    groupnorm_bench()
+
+
+def groupnorm_bench():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.devtime import peak_hbm_bandwidth, traced_step_ms
+    from paddle_tpu.kernels import group_norm as gn
+    from paddle_tpu.nn import functional as F
+
+    bw = peak_hbm_bandwidth(jax.devices()[0])
+    eps = 1e-5
+    # SD-UNet block shapes at the bench config (b4, sample 32): the
+    # widest level-0 tensor and a deep narrow one
+    shapes = [
+        # (batch, h, w, channels, groups)
+        (4, 32, 32, 320, 32),
+        (4, 8, 8, 1280, 32),
+    ]
+    rng = np.random.default_rng(0)
+    for (b, h, w, c, g) in shapes:
+        x = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.bfloat16)
+        gamma = jnp.asarray(rng.standard_normal(c), jnp.float32)
+        beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+        x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+
+        fused = jax.jit(functools.partial(
+            gn.fused_group_norm, num_groups=g, epsilon=eps,
+            activation="silu"))
+
+        def fused_loss(x, ga, be):
+            return gn.fused_group_norm(
+                x, ga, be, g, eps, "silu").astype(jnp.float32).sum()
+
+        def unfused_loss(x, ga, be):
+            y = F.group_norm(x, g, ga, be, eps, "NCHW")
+            return F.silu(y).astype(jnp.float32).sum()
+
+        fused_bwd = jax.jit(jax.grad(fused_loss, argnums=(0, 1, 2)))
+        unfused = jax.jit(
+            lambda x, ga, be: F.silu(F.group_norm(x, g, ga, be, eps,
+                                                  "NCHW")))
+        unfused_bwd = jax.jit(jax.grad(unfused_loss, argnums=(0, 1, 2)))
+
+        for f, args in ((fused, (x, gamma, beta)),
+                        (fused_bwd, (x, gamma, beta)),
+                        (unfused, (x_nchw, gamma, beta)),
+                        (unfused_bwd, (x_nchw, gamma, beta))):
+            jax.device_get(jax.tree_util.tree_leaves(f(*args))[0])
+
+        t_f = traced_step_ms(lambda: fused(x, gamma, beta), n_steps=20)
+        t_fb = traced_step_ms(lambda: fused_bwd(x, gamma, beta),
+                              n_steps=20)
+        t_u = traced_step_ms(lambda: unfused(x_nchw, gamma, beta),
+                             n_steps=20)
+        t_ub = traced_step_ms(lambda: unfused_bwd(x_nchw, gamma, beta),
+                              n_steps=20)
+
+        elems = b * h * w * c
+        bpe = x.dtype.itemsize
+        fwd_bytes = 2 * elems * bpe           # 1 read + 1 write
+        fwd_bwd_bytes = 5 * elems * bpe       # + bwd: 2 reads + 1 write
+        fwd_ms = t_f.device_step_ms or t_f.step_ms
+        tot_ms = t_fb.device_step_ms or t_fb.step_ms
+        out = {
+            "kernel": "group_norm_silu",
+            "shape": f"b{b}x{h}x{w}xc{c}g{g}",
+            "fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(tot_ms, 4),
+            "fwd_hbm_roofline": round(
+                (fwd_bytes / (fwd_ms / 1e3)) / bw, 3),
+            "fwd_bwd_hbm_roofline": round(
+                (fwd_bwd_bytes / (tot_ms / 1e3)) / bw, 3),
+            "unfused_nchw_fwd_ms": round(
+                t_u.device_step_ms or t_u.step_ms, 4),
+            "unfused_nchw_fwd_bwd_ms": round(
+                t_ub.device_step_ms or t_ub.step_ms, 4),
+            "peak_hbm_gbps": round(bw / 1e9, 1),
         }
         print(json.dumps(out), flush=True)
 

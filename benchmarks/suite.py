@@ -119,7 +119,79 @@ def _train_throughput(model, data, loss_fn=None, unit_count=0):
         extra["device_categories"] = {
             k: round(100.0 * v / total, 1)
             for k, v in timing.op_summary.by_category().items()}
+        # top-10 device-time op table — the attribution treatment the
+        # Llama headline got, for every config (what exactly is the
+        # step spending its device time on?). Per-chip like
+        # device_step_ms: row totals sum ALL device planes, so divide
+        # by the plane count too (SPMD: each chip runs the same step).
+        planes = max(timing.op_summary.n_planes, 1)
+        extra["top_ops"] = [
+            {"op": (r.name if len(r.name) <= 64 else r.name[:61] + "..."),
+             "ms_per_step": round(
+                 r.total_ms / timing.n_steps / planes, 3),
+             "pct": round(100.0 * r.total_ms / total, 1),
+             "count": r.count,
+             "category": r.category}
+            for r in timing.op_summary.rows[:10]]
     return rate, extra
+
+
+def _unet_groupnorm_roofline(cfg, batch, bytes_per_elem):
+    """Analytic HBM roofline for every GroupNorm site in the UNet.
+
+    Mirrors UNet2DConditionModel's constructor loops to enumerate each
+    GroupNorm's (channels, resolution), then prices the fused kernel's
+    traffic: forward reads the activation once and writes once, the
+    backward reads (x, dy) and writes dx — 5 activation-passes/step.
+    GroupNorm is bandwidth-bound (O(1) FLOPs/byte), so this byte count
+    over peak HBM bandwidth is its floor device time; comparing the
+    measured GroupNorm rows in ``top_ops`` against ``roofline_ms`` says
+    whether the kernel is at roofline or leaving bandwidth unused."""
+    ch = list(cfg.block_out_channels)
+    s = cfg.sample_size
+    L = len(ch)
+
+    def res(level):
+        return s // (2 ** level)
+
+    sites = []  # (channels, resolution) per GroupNorm call
+    skip = [ch[0]]
+    cur = ch[0]
+    for level, out_c in enumerate(ch):
+        for _ in range(cfg.layers_per_block):
+            sites.append((cur, res(level)))        # resnet norm1
+            sites.append((out_c, res(level)))      # resnet norm2
+            if level >= L - 2:
+                sites.append((out_c, res(level)))  # cross-attn norm
+            cur = out_c
+            skip.append(cur)
+        if level < L - 1:
+            skip.append(cur)
+    r_mid = res(L - 1)
+    sites += [(cur, r_mid)] * 5  # mid res1 (2) + attn (1) + res2 (2)
+    for level, out_c in enumerate(reversed(ch)):
+        r = res(L - 1 - level)
+        for _ in range(cfg.layers_per_block + 1):
+            sites.append((cur + skip.pop(), r))    # resnet norm1
+            sites.append((out_c, r))               # resnet norm2
+            if level < 2:
+                sites.append((out_c, r))           # cross-attn norm
+            cur = out_c
+    sites.append((cur, s))                         # conv_norm_out
+    elems = sum(batch * c * r * r for c, r in sites)
+    hbm_bytes = 5 * elems * bytes_per_elem
+    from benchmarks.devtime import peak_hbm_bandwidth
+
+    bw = peak_hbm_bandwidth(jax.devices()[0])
+    return {
+        "sites": len(sites),
+        "activation_elems_per_step": elems,
+        "hbm_bytes_per_step": hbm_bytes,
+        "roofline_ms": round(hbm_bytes / bw * 1e3, 3),
+        "peak_hbm_gbps": round(bw / 1e9, 1),
+        "assumes": "fused 1r+1w fwd, 2r+1w bwd per site "
+                   "(kernels/group_norm.py); unfused multiplies this",
+    }
 
 
 def bench_moe(tpu_diags):
@@ -148,6 +220,7 @@ def bench_moe(tpu_diags):
     rate, extra = _train_throughput(
         model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
     extra["experts"] = cfg.num_experts
+    extra["compute_dtype"] = "float32"
     return _result("ernie_moe_train_tokens_per_sec", rate, "tokens/s",
                    extra, tpu_diags)
 
@@ -179,6 +252,11 @@ def bench_vit(tpu_diags):
     rate, extra = _train_throughput(
         model, {"input": imgs, "label": labels}, loss_fn=loss_fn,
         unit_count=batch)
+    extra["compute_dtype"] = "bfloat16" if tpu else "float32"
+    from paddle_tpu.nn import layout
+
+    extra["conv_layout"] = (
+        "NHWC" if layout.decide(cfg.channels_last) else "NCHW")
     return _result("vit_l_train_images_per_sec", rate, "images/s",
                    extra, tpu_diags)
 
@@ -225,6 +303,13 @@ def bench_unet(tpu_diags):
     wrap = _Wrap()
     data = {"sample": x, "timestep": t, "context": ctx, "target": x}
     rate, extra = _train_throughput(wrap, data, unit_count=batch)
+    extra["compute_dtype"] = "bfloat16" if tpu else "float32"
+    from paddle_tpu.nn import layout
+
+    extra["conv_layout"] = (
+        "NHWC" if layout.decide(cfg.channels_last) else "NCHW")
+    extra["groupnorm_roofline"] = _unet_groupnorm_roofline(
+        cfg, batch, bytes_per_elem=2 if dt == jnp.bfloat16 else 4)
     return _result("sd_unet_train_samples_per_sec", rate, "samples/s",
                    extra, tpu_diags)
 
@@ -245,6 +330,7 @@ def bench_mamba(tpu_diags):
         np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)))
     rate, extra = _train_throughput(
         model, {"input_ids": ids, "labels": ids}, unit_count=batch * seq)
+    extra["compute_dtype"] = "float32"
     return _result("mamba_train_tokens_per_sec", rate, "tokens/s",
                    extra, tpu_diags)
 
@@ -378,6 +464,7 @@ def bench_infer(tpu_diags):
     return _result(
         "infer_p50_ttft_ms", headline["p50_ttft_ms"], "ms",
         {"latency_basis": "client wall-clock incl. tunnel dispatch RTT",
+         "compute_dtype": "bfloat16" if tpu else "float32",
          "p99_ttft_ms": headline["p99_ttft_ms"],
          "unloaded_ttft_ms": unloaded["p50_ttft_ms"],
          "served_tokens_per_sec": headline["served_tokens_per_sec"],
@@ -541,6 +628,7 @@ def bench_serve7b(tpu_diags):
         "qweight_hbm_bytes": n_linear,
         "dense_params": n_dense,
         "weight_dtype": wdtype,
+        "compute_dtype": "bfloat16" if tpu else "float32",
         "slots": slots, "max_len": max_len,
         "prompt_len": prompt_len, "max_chunk": max_chunk,
         "paged": True, "page_size": ecfg.page_size,

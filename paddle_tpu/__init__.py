@@ -132,6 +132,9 @@ class CPUPlace:
     def __eq__(self, other):
         return type(other) is type(self)
 
+    def __hash__(self):
+        return hash(type(self))
+
 
 class CUDAPlace:
     """Parity: paddle.CUDAPlace(id) — maps to the id-th accelerator."""
@@ -145,6 +148,9 @@ class CUDAPlace:
     def __eq__(self, other):
         return (type(other) is type(self)
                 and other.device_id == self.device_id)
+
+    def __hash__(self):
+        return hash((type(self), self.device_id))
 
 
 XPUPlace = CUDAPlace
@@ -210,6 +216,20 @@ class DataParallel(Layer):
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    def state_dict(self, include_sublayers=True,
+                   structured_name_prefix=""):
+        # delegate like upstream paddle.DataParallel: checkpoint keys
+        # match the UNWRAPPED model, so training with the wrapper and
+        # loading into a bare model (the standard infer path) just works
+        return self._layers.state_dict(include_sublayers,
+                                       structured_name_prefix)
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        return self._layers.set_state_dict(state_dict,
+                                           use_structured_name)
+
+    load_dict = set_state_dict
 
     def __getattr__(self, name):
         try:

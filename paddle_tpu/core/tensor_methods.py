@@ -152,6 +152,12 @@ def _stop_gradient_get(self):
 
 
 def _stop_gradient_set(self, value):
+    if value:
+        # x.stop_gradient = True is the most common paddle idiom and a
+        # semantic no-op here: plain arrays already ARE constants to
+        # autodiff. Only asking for False (tape-style trainability)
+        # warrants the migration error.
+        return
     raise AttributeError(
         "jax arrays are immutable constants to autodiff; trainability "
         "lives on Parameter.trainable (gradients are explicit "
@@ -240,23 +246,37 @@ def install():
         aval_method = _core.aval_method
     except (ImportError, AttributeError):  # private-API drift
         shaped = aval_method = None
-    for name, fn in _METHODS.items():
-        if not hasattr(jax.Array, name):
-            setattr(jax.Array, name, fn)
-            if shaped is not None and not hasattr(shaped, name):
-                setattr(shaped, name, aval_method(fn))
-    if not hasattr(jax.Array, "stop_gradient"):
-        try:
-            jax.Array.stop_gradient = property(_stop_gradient_get,
-                                               _stop_gradient_set)
-            if shaped is not None:
-                shaped.stop_gradient = _core.aval_property(
-                    _stop_gradient_get)
-        except (AttributeError, TypeError):
-            pass
-    if not hasattr(jax.Array, "place"):
-        try:
-            jax.Array.place = property(
-                lambda self: next(iter(self.devices())))
-        except (AttributeError, TypeError):
-            pass
+    # on older jax (<= 0.4.x) the concrete ArrayImpl is only REGISTERED
+    # with the jax.Array ABC, not a subclass — attributes set on the ABC
+    # never reach instances, so install on the concrete class too
+    targets = [jax.Array]
+    try:
+        from jax._src.array import ArrayImpl as _impl
+
+        if not issubclass(_impl, jax.Array) or \
+                jax.Array not in _impl.__mro__:
+            targets.append(_impl)
+    except (ImportError, AttributeError):
+        pass
+    for cls in targets:
+        for name, fn in _METHODS.items():
+            if not hasattr(cls, name):
+                setattr(cls, name, fn)
+                if (cls is jax.Array and shaped is not None
+                        and not hasattr(shaped, name)):
+                    setattr(shaped, name, aval_method(fn))
+        if not hasattr(cls, "stop_gradient"):
+            try:
+                cls.stop_gradient = property(_stop_gradient_get,
+                                             _stop_gradient_set)
+                if cls is jax.Array and shaped is not None:
+                    shaped.stop_gradient = _core.aval_property(
+                        _stop_gradient_get)
+            except (AttributeError, TypeError):
+                pass
+        if not hasattr(cls, "place"):
+            try:
+                cls.place = property(
+                    lambda self: next(iter(self.devices())))
+            except (AttributeError, TypeError):
+                pass

@@ -268,7 +268,9 @@ def dropless_moe_ep_apply(xf, gate_weight, w1, b1, w2, b2, act, top_k,
     Returns (y [t, m], aux scalar) with aux computed from GLOBAL routing
     statistics (pmean over ep).
     """
-    from jax import lax, shard_map
+    from jax import lax
+
+    from ..jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ep = mesh.shape[ep_axis]

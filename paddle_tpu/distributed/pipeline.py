@@ -44,7 +44,9 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..jax_compat import pcast as _pcast
+from ..jax_compat import shard_map
+from ..jax_compat import vma_of as _vma_of
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import initializer as I
@@ -109,7 +111,7 @@ def pipeline_apply(
 
         # mark the carry as pp-varying so scan's carry types line up with
         # the ppermute output
-        init = jax.lax.pcast(
+        init = _pcast(
             jnp.zeros((*mb_shape,), xs.dtype), axis, to="varying"
         )
         _, emits = jax.lax.scan(
@@ -210,9 +212,9 @@ def pipeline_1f1b_step(
         # phantom contribution. Cast to varying so cotangents stay
         # per-device; the caller slices the real device's block.
         fp = jax.tree_util.tree_map(
-            lambda p: jax.lax.pcast(p, (axis,), to="varying"), fp)
+            lambda p: _pcast(p, (axis,), to="varying"), fp)
         lp = jax.tree_util.tree_map(
-            lambda p: jax.lax.pcast(p, (axis,), to="varying"), lp)
+            lambda p: _pcast(p, (axis,), to="varying"), lp)
         chunks = jax.tree_util.tree_map(lambda p: p[0], sp)  # [vpp, ...]
 
         def chunk_params(c):
@@ -222,9 +224,9 @@ def pipeline_1f1b_step(
             # scan carries become pp-varying through the ppermute/axis_index
             # data flow; the zero-init must carry the same vma type.
             # Idempotent: already-varying values pass through.
-            if axis in getattr(jax.typeof(x), "vma", frozenset()):
+            if axis in _vma_of(x):
                 return x
-            return jax.lax.pcast(x, (axis,), to="varying")
+            return _pcast(x, (axis,), to="varying")
 
         zero_h = vary(jnp.zeros(h_sds.shape, h_sds.dtype))
         carry0 = {
@@ -299,7 +301,7 @@ def pipeline_1f1b_step(
                     aux_f = take_mb(auxs, fsafe)
                     loss_f, head_vjp = jax.vjp(
                         lambda lp_, y_: last_fn(lp_, y_, aux_f), lp, out_f)
-                    ct_one = jax.lax.pcast(jnp.ones((), loss_f.dtype),
+                    ct_one = _pcast(jnp.ones((), loss_f.dtype),
                                            (axis,), to="varying")
                     dlast_f, dy_f = head_vjp(ct_one)
                     keep = active_f & is_last_v

@@ -74,6 +74,8 @@ define_flag("default_matmul_precision", "",
 define_flag("log_memory_stats", False, "log device memory after each step")
 define_flag("rng_use_global_seed", True,
             "derive eager rng stream from the global seed")
+define_flag("fused_group_norm", True,
+            "dispatch NHWC GroupNorm to the fused Pallas kernel")
 define_flag("flash_attention_block_q", 256, "Pallas flash attn q block")
 define_flag("flash_attention_block_k", 256, "Pallas flash attn k block")
 define_flag("moe_capacity_factor", 1.25, "default MoE capacity factor")

@@ -1,0 +1,75 @@
+"""Compat shims over jax API drift between the 0.4.x and 0.5+ lines.
+
+The repo targets current jax (``jax.shard_map`` with ``check_vma``),
+but must also import cleanly on 0.4.x containers where shard_map lives
+in ``jax.experimental.shard_map`` and the replication-check kwarg is
+still called ``check_rep``. Every internal user imports shard_map from
+here instead of from jax directly.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exposes it at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+_ACCEPTS_VMA = "check_vma" in _PARAMS
+_ACCEPTS_AXIS_NAMES = "axis_names" in _PARAMS
+
+
+def shard_map(f, *args, **kw):
+    if not _ACCEPTS_VMA and "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    if not _ACCEPTS_AXIS_NAMES and "axis_names" in kw:
+        # 0.4.x has no axis_names; run fully manual instead. The
+        # equivalent `auto=complement` translation CHECK-crashes 0.4.37's
+        # XLA on some programs (dropless-EP ragged_dot under a partial-
+        # auto shard_map), and these callers set check_vma/check_rep
+        # False anyway — unnamed axes just see replicated shards, which
+        # is semantically identical and only costs an extra gather on
+        # the old-jax CPU test path, never on the prod (new-jax) path.
+        kw.pop("axis_names")
+    return _shard_map(f, *args, **kw)
+
+
+try:  # jax >= 0.5: top-level context manager
+    from jax import enable_x64  # noqa: F401
+except ImportError:  # jax 0.4.x
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def pcast(x, axes, to="varying"):
+    """``jax.lax.pcast`` when it exists (new jax's varying-manual-axes
+    bookkeeping inside shard_map); identity on 0.4.x, where the vma
+    concept — and therefore the cast — does not exist."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` (new jax) / ``TPUCompilerParams``
+    (0.4.x) — one shim so Pallas kernels don't each carry the rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+def vma_of(x):
+    """The varying-manual-axes set of ``x``'s type (new jax); empty set
+    on 0.4.x, which has neither ``jax.typeof`` nor vma tracking."""
+    import jax
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())

@@ -6,6 +6,14 @@ embedding, down/up sampling with skip connections).
 TPU-native notes: NCHW at the API (parity), GroupNorm stats in fp32,
 attention through the shared scaled-dot-product path (flash kernel on
 TPU shapes), convs via lax.conv with bf16-friendly accumulation.
+
+Layout fast path (``nn.layout``): with ``channels_last`` on (auto =
+TPU), the forward transposes ONCE at entry, runs the whole
+conv/GroupNorm/attention body in NHWC — TPU's native conv layout, so
+XLA emits no per-op relayout copies (the round-5 capture burned 40% of
+SD-UNet device time on them) — and transposes back at exit. The
+norm→SiLU chains dispatch to the fused Pallas GroupNorm kernel
+(``kernels/group_norm.py``) in that layout.
 """
 
 from __future__ import annotations
@@ -18,6 +26,7 @@ import jax.numpy as jnp
 
 from ..core.module import Layer
 from ..nn import functional as F
+from ..nn import layout
 from ..nn.layer.common import Linear, Upsample
 from ..nn.layer.conv import Conv2D
 from ..nn.layer.norm import GroupNorm
@@ -33,6 +42,9 @@ class UNetConfig:
     attention_head_dim: int = 8
     norm_num_groups: int = 32
     sample_size: int = 64
+    # None = follow PT_FLAGS_conv_layout (auto: NHWC on TPU); the
+    # paddle-facing API stays NCHW either way
+    channels_last: Optional[bool] = None
 
     @classmethod
     def tiny(cls, **kw):
@@ -59,17 +71,21 @@ def timestep_embedding(timesteps, dim: int, max_period: float = 10000.0):
 class ResnetBlock(Layer):
     def __init__(self, in_c, out_c, temb_c, groups):
         super().__init__()
-        self.norm1 = GroupNorm(groups, in_c)
+        # SiLU fused into the norm (one HBM pass through the Pallas
+        # kernel under NHWC; functionally applied on the NCHW path)
+        self.norm1 = GroupNorm(groups, in_c, activation="silu")
         self.conv1 = Conv2D(in_c, out_c, 3, padding=1)
         self.time_emb_proj = Linear(temb_c, out_c)
-        self.norm2 = GroupNorm(groups, out_c)
+        self.norm2 = GroupNorm(groups, out_c, activation="silu")
         self.conv2 = Conv2D(out_c, out_c, 3, padding=1)
         self.shortcut = Conv2D(in_c, out_c, 1) if in_c != out_c else None
 
     def forward(self, x, temb):
-        h = self.conv1(F.silu(self.norm1(x)))
-        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
-        h = self.conv2(F.silu(self.norm2(h)))
+        h = self.conv1(self.norm1(x))
+        t = self.time_emb_proj(F.silu(temb))
+        h = h + (t[:, None, None, :] if layout.active()
+                 else t[:, :, None, None])
+        h = self.conv2(self.norm2(h))
         skip = x if self.shortcut is None else self.shortcut(x)
         return skip + h
 
@@ -111,9 +127,15 @@ class CrossAttnBlock(Layer):
         return out.reshape(b, sq, c)
 
     def forward(self, x, context):
-        b, c, hh, ww = x.shape
+        cl = layout.active()
+        if cl:
+            b, hh, ww, c = x.shape
+            # channels-last: spatial→token flatten is a pure reshape
+            h = self.norm(x).reshape(b, hh * ww, c)
+        else:
+            b, c, hh, ww = x.shape
+            h = self.norm(x).reshape(b, c, hh * ww).transpose(0, 2, 1)
         residual_spatial = x
-        h = self.norm(x).reshape(b, c, hh * ww).transpose(0, 2, 1)
         h = self.proj_in(h)
         # self attention
         hn = self.norm1(h)
@@ -131,7 +153,8 @@ class CrossAttnBlock(Layer):
         a, gate = jnp.split(self.ff1(hn), 2, axis=-1)
         h = h + self.ff2(a * F.gelu(gate))
         h = self.proj_out(h)
-        h = h.transpose(0, 2, 1).reshape(b, c, hh, ww)
+        h = h.reshape(b, hh, ww, c) if cl \
+            else h.transpose(0, 2, 1).reshape(b, c, hh, ww)
         return residual_spatial + h
 
 
@@ -218,7 +241,8 @@ class UNet2DConditionModel(Layer):
             if level < len(ch) - 1:
                 self.upsamplers.append(UpsampleBlock(cur))
 
-        self.conv_norm_out = GroupNorm(config.norm_num_groups, cur)
+        self.conv_norm_out = GroupNorm(config.norm_num_groups, cur,
+                                       activation="silu")
         self.conv_out = Conv2D(cur, config.out_channels, 3, padding=1)
 
     def forward(self, sample, timestep, encoder_hidden_states):
@@ -230,39 +254,47 @@ class UNet2DConditionModel(Layer):
         temb = temb.astype(self.time_embedding1.weight.value.dtype)
         temb = self.time_embedding2(F.silu(self.time_embedding1(temb)))
 
-        h = self.conv_in(sample)
-        skips = [h]
-        cfg = self.config
-        ri, di = 0, 0
-        for level in range(len(cfg.block_out_channels)):
-            for _ in range(cfg.layers_per_block):
-                h = self.down_resnets[ri](h, temb)
-                attn = self.down_attns[ri]
-                if attn is not None:
-                    h = attn(h, encoder_hidden_states)
-                ri += 1
-                skips.append(h)
-            if level < len(cfg.block_out_channels) - 1:
-                h = self.downsamplers[di](h)
-                di += 1
-                skips.append(h)
+        cl = layout.decide(self.config.channels_last)
+        if cl:
+            # the ONLY layout transposes in the program: NCHW boundary →
+            # NHWC body here, and back at the return
+            sample = layout.nchw_to_nhwc(sample)
+        cat_axis = -1 if cl else 1
+        with layout.channels_last_scope(cl):
+            h = self.conv_in(sample)
+            skips = [h]
+            cfg = self.config
+            ri, di = 0, 0
+            for level in range(len(cfg.block_out_channels)):
+                for _ in range(cfg.layers_per_block):
+                    h = self.down_resnets[ri](h, temb)
+                    attn = self.down_attns[ri]
+                    if attn is not None:
+                        h = attn(h, encoder_hidden_states)
+                    ri += 1
+                    skips.append(h)
+                if level < len(cfg.block_out_channels) - 1:
+                    h = self.downsamplers[di](h)
+                    di += 1
+                    skips.append(h)
 
-        h = self.mid_res1(h, temb)
-        h = self.mid_attn(h, encoder_hidden_states)
-        h = self.mid_res2(h, temb)
+            h = self.mid_res1(h, temb)
+            h = self.mid_attn(h, encoder_hidden_states)
+            h = self.mid_res2(h, temb)
 
-        ri, ui = 0, 0
-        for level in range(len(cfg.block_out_channels)):
-            for _ in range(cfg.layers_per_block + 1):
-                skip = skips.pop()
-                h = jnp.concatenate([h, skip], axis=1)
-                h = self.up_resnets[ri](h, temb)
-                attn = self.up_attns[ri]
-                if attn is not None:
-                    h = attn(h, encoder_hidden_states)
-                ri += 1
-            if level < len(cfg.block_out_channels) - 1:
-                h = self.upsamplers[ui](h)
-                ui += 1
+            ri, ui = 0, 0
+            for level in range(len(cfg.block_out_channels)):
+                for _ in range(cfg.layers_per_block + 1):
+                    skip = skips.pop()
+                    h = jnp.concatenate([h, skip], axis=cat_axis)
+                    h = self.up_resnets[ri](h, temb)
+                    attn = self.up_attns[ri]
+                    if attn is not None:
+                        h = attn(h, encoder_hidden_states)
+                    ri += 1
+                if level < len(cfg.block_out_channels) - 1:
+                    h = self.upsamplers[ui](h)
+                    ui += 1
 
-        return self.conv_out(F.silu(self.conv_norm_out(h)))
+            out = self.conv_out(self.conv_norm_out(h))
+        return layout.nhwc_to_nchw(out) if cl else out

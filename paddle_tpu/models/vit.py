@@ -39,6 +39,8 @@ class ViTConfig:
     num_classes: int = 1000
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-6
+    # None = follow PT_FLAGS_conv_layout (auto: NHWC patch conv on TPU)
+    channels_last: "bool | None" = None
 
     @property
     def num_patches(self):
@@ -113,10 +115,22 @@ class ViT(Layer):
         self.head = Linear(config.hidden_size, config.num_classes)
 
     def forward(self, pixel_values, labels=None):
-        # accepts NCHW (paddle convention)
-        x = self.patch_embed(pixel_values)  # [b, h, gh, gw]
-        b, c = x.shape[0], x.shape[1]
-        x = x.reshape(b, c, -1).transpose(0, 2, 1)  # [b, patches, h]
+        # accepts NCHW (paddle convention); under the channels-last
+        # policy the patch conv runs NHWC (TPU-native) and the
+        # patches→tokens flatten becomes a pure reshape — the one
+        # transpose happens on the small pixel input, not the embedding
+        from ..nn import layout
+
+        cl = layout.decide(getattr(self.config, "channels_last", None))
+        if cl:
+            with layout.channels_last_scope(True):
+                x = self.patch_embed(layout.nchw_to_nhwc(pixel_values))
+            b, c = x.shape[0], x.shape[-1]
+            x = x.reshape(b, -1, c)  # [b, patches, h]
+        else:
+            x = self.patch_embed(pixel_values)  # [b, h, gh, gw]
+            b, c = x.shape[0], x.shape[1]
+            x = x.reshape(b, c, -1).transpose(0, 2, 1)  # [b, patches, h]
         cls = jnp.broadcast_to(
             self.cls_token.value, (b, 1, c)
         ).astype(x.dtype)

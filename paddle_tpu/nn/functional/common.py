@@ -131,6 +131,23 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     gather; area is adaptive average pooling.
     """
     x = _v(x)
+    if x.ndim == 4:
+        from .. import layout
+
+        data_format = layout.resolve(data_format)
+    if data_format == "NHWC" and x.ndim == 4 and mode == "nearest":
+        # native channels-last nearest (the UNet upsampler under the
+        # NHWC layout policy): index H/W directly, no transposes
+        n, h, w, c = x.shape
+        if size is not None:
+            oh, ow = (size, size) if isinstance(size, int) else tuple(size)
+        else:
+            sf = (scale_factor, scale_factor) if not isinstance(
+                scale_factor, (tuple, list)) else scale_factor
+            oh, ow = int(h * sf[0]), int(w * sf[1])
+        iy = jnp.minimum(jnp.arange(oh) * h // oh, h - 1)
+        ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
+        return x[:, iy][:, :, ix]
     if data_format in ("NWC", "NHWC", "NDHWC"):
         fmt = {"NWC": "NCW", "NHWC": "NCHW", "NDHWC": "NCDHW"}
         return jnp.moveaxis(

@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from .. import layout
 from .common import _v
 
 
@@ -46,6 +47,7 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     f32 cotangent and bf16 weight into a gradient conv, and
     ``conv_general_dilated`` rejects mixed operand dtypes)."""
     x, weight = _v(x), _v(weight)
+    data_format = layout.resolve(data_format)
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(dilation, int):
@@ -112,6 +114,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     exact transpose of the forward conv, which XLA maps to the MXU the
     same way."""
     x, weight = _v(x), _v(weight)
+    data_format = layout.resolve(data_format)
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(dilation, int):

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -35,10 +36,40 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     return y
 
 
-def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", activation=None):
+    """GroupNorm with an optional fused activation (None | "silu").
+
+    Under the NHWC layout policy (``nn.layout``), a declared-NCHW call
+    inside a channels-last scope resolves to NHWC and dispatches to the
+    fused Pallas kernel (``kernels/group_norm.py``) — one HBM pass for
+    moments + normalize + affine + activation; over-budget shapes use
+    the transpose-free lax reference instead."""
     x = _v(x)
+    if x.ndim == 4:
+        from .. import layout
+
+        data_format = layout.resolve(data_format)
+    if data_format == "NHWC" and x.ndim == 4:
+        from ... import flags
+        from ...kernels import group_norm as gn
+
+        w = _v(weight) if weight is not None else None
+        b = _v(bias) if bias is not None else None
+        c = x.shape[-1]
+        if flags.flag("fused_group_norm") and \
+                gn.supports_fused(x.shape, num_groups):
+            gamma = w if w is not None else jnp.ones((c,), jnp.float32)
+            beta = b if b is not None else jnp.zeros((c,), jnp.float32)
+            return gn.fused_group_norm(x, gamma, beta, num_groups,
+                                       epsilon, activation)
+        return gn.group_norm_reference(x, w, b, num_groups, epsilon,
+                                       activation)
     if data_format == "NHWC":
-        x = jnp.moveaxis(x, -1, 1)
+        # non-4D channels-last: normalize channels-first, move back
+        y = group_norm(jnp.moveaxis(x, -1, 1), num_groups, weight, bias,
+                       epsilon, "NCHW", activation)
+        return jnp.moveaxis(y, 1, -1)
     n, c = x.shape[:2]
     spatial = x.shape[2:]
     g = num_groups
@@ -51,8 +82,10 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format=
         y = y * _v(weight).reshape(1, c, *([1] * len(spatial)))
     if bias is not None:
         y = y + _v(bias).reshape(1, c, *([1] * len(spatial)))
-    if data_format == "NHWC":
-        y = jnp.moveaxis(y, 1, -1)
+    if activation == "silu":
+        y = y * jax.nn.sigmoid(y.astype(jnp.float32)).astype(y.dtype)
+    elif activation is not None:
+        raise ValueError(f"group_norm: unknown activation {activation!r}")
     return y
 
 

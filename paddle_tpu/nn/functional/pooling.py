@@ -5,11 +5,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from .. import layout
 from .common import _v
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     x = _v(x)
+    data_format = layout.resolve(data_format)
     if isinstance(kernel_size, int):
         kernel_size = (kernel_size, kernel_size)
     stride = stride or kernel_size
@@ -33,6 +35,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     x = _v(x)
+    data_format = layout.resolve(data_format)
     if isinstance(kernel_size, int):
         kernel_size = (kernel_size, kernel_size)
     stride = stride or kernel_size
@@ -70,11 +73,18 @@ def _adaptive_avg_matrix(out_len, in_len):
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     x = _v(x)
+    data_format = layout.resolve(data_format)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     if data_format == "NHWC":
-        return jnp.moveaxis(
-            adaptive_avg_pool2d(jnp.moveaxis(x, -1, 1), output_size), 1, -1)
+        h, w = x.shape[1], x.shape[2]
+        if h % output_size[0] == 0 and w % output_size[1] == 0:
+            # native channels-last: window pool directly, no transposes
+            k = (h // output_size[0], w // output_size[1])
+            return avg_pool2d(x, k, k, 0, "NHWC")
+        my = _adaptive_avg_matrix(output_size[0], h)
+        mx = _adaptive_avg_matrix(output_size[1], w)
+        return jnp.einsum("Oh,nhwc,Pw->nOPc", my, x, mx).astype(x.dtype)
     h, w = x.shape[2], x.shape[3]
     if h % output_size[0] == 0 and w % output_size[1] == 0:
         k = (h // output_size[0], w // output_size[1])
@@ -165,6 +175,7 @@ def adaptive_max_pool2d(x, output_size, return_mask=False,
     ``return_mask=True`` also returns the flattened h*w argmax index
     per bin (parity: F.adaptive_max_pool2d mask output)."""
     x = _v(x)
+    data_format = layout.resolve(data_format)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     if data_format == "NHWC":

@@ -57,13 +57,21 @@ class RMSNorm(Layer):
 
 
 class GroupNorm(Layer):
+    """``activation`` ("silu" | None) fuses the following nonlinearity
+    into the norm — under the NHWC layout policy the fused Pallas
+    kernel applies it in the same HBM pass (the UNet's norm→SiLU
+    chain); on the NCHW path it is applied functionally, so semantics
+    are layout-independent."""
+
     def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", name=None):
+                 bias_attr=None, data_format="NCHW", name=None,
+                 activation=None):
         super().__init__()
         self.num_groups = num_groups
         self.num_channels = num_channels
         self.epsilon = epsilon
         self.data_format = data_format
+        self.activation = activation
         if weight_attr is False:
             self.weight = None
         else:
@@ -78,7 +86,7 @@ class GroupNorm(Layer):
     def forward(self, x):
         return F.group_norm(
             x, self.num_groups, self.weight, self.bias, self.epsilon,
-            self.data_format,
+            self.data_format, activation=self.activation,
         )
 
 
@@ -107,7 +115,11 @@ class BatchNorm2D(Layer):
         self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
 
     def forward(self, x):
-        c_axis = 1 if self.data_format == "NCHW" else -1
+        from .. import layout
+
+        df = layout.resolve(self.data_format) if x.ndim == 4 \
+            else self.data_format
+        c_axis = 1 if df == "NCHW" else -1
         axes = tuple(i for i in range(x.ndim) if i != (c_axis % x.ndim))
         if self.training:
             import jax.core
@@ -159,8 +171,11 @@ class InstanceNorm2D(Layer):
             self.create_parameter((num_features,), is_bias=True)
 
     def forward(self, x):
-        axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
-        c_axis = 1 if self.data_format == "NCHW" else 3
+        from .. import layout
+
+        df = layout.resolve(self.data_format)
+        axes = (2, 3) if df == "NCHW" else (1, 2)
+        c_axis = 1 if df == "NCHW" else 3
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=axes, keepdims=True)
         var = jnp.var(xf, axis=axes, keepdims=True)

@@ -1,0 +1,103 @@
+"""Channels-last (NHWC) internal layout policy for conv workloads.
+
+TPU convolutions are native channels-last: the MXU contracts over the
+input-channel dimension, and XLA lays NHWC activations out with C on the
+128-wide lane dimension — NCHW inputs force a relayout copy in front of
+(and behind) every conv/norm/pool. The round-5 SD-UNet capture measured
+exactly that: 40% of device time in {1,0,3,2}<->{0,1,3,2} copies.
+
+This module keeps the paddle-facing convention (NCHW at every public
+API boundary) while letting a MODEL hoist the layout change to its
+entry/exit: the model transposes once, opens a ``channels_last_scope``,
+and every conv/pool/norm functional inside resolves its declared
+"NCHW" format to "NHWC" — the tensors flowing through them really are
+channels-last, and no per-op transposes exist for XLA to clean up.
+
+Policy resolution order (per model forward):
+1. explicit per-model setting (``UNetConfig.channels_last``,
+   ``ResNet(channels_last=...)``) when not None;
+2. the ``PT_FLAGS_conv_layout`` flag / ``paddle_tpu.set_flags``:
+   "NHWC" forces on, "NCHW" forces off;
+3. "auto" (default): NHWC on TPU, NCHW elsewhere (CPU tests keep the
+   reference layout bit-for-bit).
+
+The scope is trace-time state: it is entered inside the model's
+``forward`` while jit tracing, so the resolved layout is baked into the
+compiled program (no runtime branching).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .. import flags
+
+flags.define_flag(
+    "conv_layout", "auto",
+    "internal conv/pool/norm layout: NHWC | NCHW | auto (NHWC on TPU)")
+
+# trace-time nesting depth of channels_last_scope; tracing is
+# single-threaded per program, so a module-level counter suffices
+_scope_depth = 0
+
+# declared channels-first formats a scope retargets to channels-last
+_CHANNELS_LAST_OF = {"NCHW": "NHWC"}
+
+
+def channels_last_preferred() -> bool:
+    """The env/flag policy (no per-model override applied)."""
+    v = str(flags.flag("conv_layout")).upper()
+    if v == "NHWC":
+        return True
+    if v == "NCHW":
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def decide(explicit=None) -> bool:
+    """Per-model policy: explicit setting wins, else the flag/auto."""
+    if explicit is not None:
+        return bool(explicit)
+    return channels_last_preferred()
+
+
+def active() -> bool:
+    return _scope_depth > 0
+
+
+@contextlib.contextmanager
+def channels_last_scope(enabled: bool = True):
+    """While open (and ``enabled``), 4-D ops declared NCHW resolve to
+    NHWC — the model has already transposed its activations."""
+    global _scope_depth
+    if not enabled:
+        yield False
+        return
+    _scope_depth += 1
+    try:
+        yield True
+    finally:
+        _scope_depth -= 1
+
+
+def resolve(declared: str) -> str:
+    """Map a layer's declared data_format to the format of the tensors
+    actually flowing through it. Idempotent outside a scope and for
+    formats that are already channels-last."""
+    if _scope_depth > 0:
+        return _CHANNELS_LAST_OF.get(declared, declared)
+    return declared
+
+
+def nchw_to_nhwc(x):
+    import jax.numpy as jnp
+
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def nhwc_to_nchw(x):
+    import jax.numpy as jnp
+
+    return jnp.transpose(x, (0, 3, 1, 2))

@@ -6,12 +6,20 @@ which tiles onto the MXU; BN runs frozen-stats inside jitted steps (see
 nn.layer.norm.BatchNorm2D) matching how the reference's distributed
 vision recipes freeze BN; for from-scratch jit training, pass
 ``norm_layer=GroupNorm``-style factory.
+
+Layout fast path: ``channels_last`` (default: follow
+``PT_FLAGS_conv_layout``, auto = NHWC on TPU) transposes once at entry
+and runs the whole conv/BN/pool body channels-last — TPU's native conv
+layout — with the NCHW paddle convention preserved at the API boundary.
+The residual blocks themselves are layout-neutral (convs/norms resolve
+via ``nn.layout``; ReLU and adds are elementwise).
 """
 
 from __future__ import annotations
 
 from ...core.module import Layer
 from ...nn import functional as F
+from ...nn import layout
 from ...nn.layer.common import Linear, Sequential
 from ...nn.layer.conv import AdaptiveAvgPool2D, Conv2D, MaxPool2D
 from ...nn.layer.norm import BatchNorm2D
@@ -69,8 +77,9 @@ class BottleneckBlock(Layer):
 class ResNet(Layer):
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
                  norm_layer=BatchNorm2D, in_channels=3, groups=1,
-                 width=64):
+                 width=64, channels_last=None):
         super().__init__()
+        self.channels_last = channels_last
         self.inplanes = 64
         self.norm_layer = norm_layer
         self.groups = groups
@@ -111,14 +120,20 @@ class ResNet(Layer):
         return Sequential(*layers)
 
     def forward(self, x, labels=None):
-        x = F.relu(self.bn1(self.conv1(x)))
-        x = self.maxpool(x)
-        x = self.layer1(x)
-        x = self.layer2(x)
-        x = self.layer3(x)
-        x = self.layer4(x)
-        if self.with_pool:
-            x = self.avgpool(x)
+        cl = layout.decide(self.channels_last)
+        if cl:
+            x = layout.nchw_to_nhwc(x)
+        with layout.channels_last_scope(cl):
+            x = F.relu(self.bn1(self.conv1(x)))
+            x = self.maxpool(x)
+            x = self.layer1(x)
+            x = self.layer2(x)
+            x = self.layer3(x)
+            x = self.layer4(x)
+            if self.with_pool:
+                x = self.avgpool(x)
+        if cl:
+            x = layout.nhwc_to_nchw(x)
         if self.num_classes > 0:
             x = x.reshape(x.shape[0], -1)
             x = self.fc(x)

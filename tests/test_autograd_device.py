@@ -10,6 +10,7 @@ import torch
 
 import paddle_tpu as pt
 from paddle_tpu import autograd
+from paddle_tpu.jax_compat import enable_x64 as _enable_x64
 
 
 class TestFunctionalAutograd:
@@ -18,7 +19,7 @@ class TestFunctionalAutograd:
             size=(3, 4)).astype(np.float64))
         x = jnp.asarray(np.random.default_rng(1).normal(
             size=(4,)).astype(np.float64))
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             J = autograd.jacobian(lambda v: A @ v, x)
         np.testing.assert_allclose(np.asarray(J), np.asarray(A),
                                    rtol=1e-10)

@@ -9,6 +9,9 @@ import pytest
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
 
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
+
 
 def test_layer_norm_vs_numpy():
     x = np.random.randn(2, 5, 8).astype(np.float32)

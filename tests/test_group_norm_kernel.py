@@ -1,0 +1,132 @@
+"""Fused Pallas GroupNorm(+SiLU) kernel: fwd+bwd parity vs the lax
+reference in interpreter mode, fallback behavior, and the functional
+dispatch under the NHWC layout policy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt  # noqa: F401  (installs tensor methods)
+from paddle_tpu.kernels import group_norm as gn
+from paddle_tpu.nn import functional as F
+
+pytestmark = pytest.mark.fast
+
+
+def _case(n=2, h=5, w=7, c=32, g=8, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, h, w, c)), dtype)
+    gamma = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    return x, gamma, beta
+
+
+@pytest.mark.parametrize("act", [None, "silu"])
+def test_fused_forward_matches_reference(act):
+    x, gamma, beta = _case()
+    ref = gn.group_norm_reference(x, gamma, beta, 8, 1e-5, act)
+    got = gn.fused_group_norm(x, gamma, beta, 8, 1e-5, act)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("act", [None, "silu"])
+def test_fused_backward_matches_reference(act):
+    x, gamma, beta = _case(seed=1)
+    ct = jnp.asarray(
+        np.random.default_rng(9).standard_normal(x.shape), jnp.float32)
+
+    def loss(f):
+        return lambda x, ga, be: jnp.sum(f(x, ga, be, 8, 1e-5, act) * ct)
+
+    ref = jax.grad(loss(gn.group_norm_reference),
+                   argnums=(0, 1, 2))(x, gamma, beta)
+    got = jax.grad(loss(gn.fused_group_norm),
+                   argnums=(0, 1, 2))(x, gamma, beta)
+    for name, a, b in zip(("dx", "dgamma", "dbeta"), ref, got):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-5,
+            err_msg=name)
+
+
+def test_fused_under_jit_and_grad_of_mean():
+    """The UNet-shaped use: jitted loss with the kernel inside."""
+    x, gamma, beta = _case(c=16, g=4, seed=2)
+
+    @jax.jit
+    def loss(x, ga, be):
+        y = gn.fused_group_norm(x, ga, be, 4, 1e-5, "silu")
+        return jnp.mean(y ** 2)
+
+    l0 = loss(x, gamma, beta)
+    g0 = jax.jit(jax.grad(loss))(x, gamma, beta)
+    assert np.isfinite(float(l0))
+    assert g0.shape == x.shape and np.isfinite(np.asarray(g0)).all()
+
+
+def test_bfloat16_inputs():
+    x, gamma, beta = _case(dtype=jnp.bfloat16, seed=3)
+    ref = gn.group_norm_reference(x, gamma, beta, 8, 1e-5, "silu")
+    got = gn.fused_group_norm(x, gamma, beta, 8, 1e-5, "silu")
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_channel_blocking_paths():
+    """Shapes forcing different group-aligned channel slabs (c_block ==
+    cg, and c_block == c) agree with the reference."""
+    for (c, g) in ((24, 8), (64, 2), (10, 10)):
+        x, gamma, beta = _case(c=c, g=g, seed=c)
+        ref = gn.group_norm_reference(x, gamma, beta, g, 1e-5, None)
+        got = gn.fused_group_norm(x, gamma, beta, g, 1e-5, None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"c={c} g={g}")
+
+
+def test_supports_fused_budget_gate(monkeypatch):
+    assert gn.supports_fused((2, 5, 7, 32), 8)
+    assert not gn.supports_fused((2, 5, 7, 30), 8)  # c % g != 0
+    assert not gn.supports_fused((2, 5, 7, 32, 1), 8)  # not 4-D
+    # shrink the budget below one group's slab -> fallback
+    monkeypatch.setattr(gn, "VMEM_BUDGET_BYTES", 64)
+    assert not gn.supports_fused((2, 5, 7, 32), 8)
+
+
+def test_functional_dispatch_nhwc_vs_nchw():
+    """F.group_norm NHWC (fused kernel) == NCHW jnp path on transposed
+    input, with and without the fused activation."""
+    x, gamma, beta = _case(seed=4)
+    x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+    for act in (None, "silu"):
+        y_nhwc = F.group_norm(x, 8, gamma, beta, 1e-5, "NHWC",
+                              activation=act)
+        y_nchw = F.group_norm(x_nchw, 8, gamma, beta, 1e-5, "NCHW",
+                              activation=act)
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(y_nhwc, (0, 3, 1, 2))),
+            np.asarray(y_nchw), rtol=1e-5, atol=1e-5)
+
+
+def test_functional_fallback_matches_fused(monkeypatch):
+    """Over-budget shapes route to the lax reference with identical
+    semantics (same tolerance band as the kernel)."""
+    x, gamma, beta = _case(seed=5)
+    fused = F.group_norm(x, 8, gamma, beta, 1e-5, "NHWC",
+                         activation="silu")
+    monkeypatch.setattr(gn, "VMEM_BUDGET_BYTES", 64)
+    fallback = F.group_norm(x, 8, gamma, beta, 1e-5, "NHWC",
+                            activation="silu")
+    np.testing.assert_allclose(np.asarray(fallback), np.asarray(fused),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_groupnorm_no_affine_nhwc():
+    x, _, _ = _case(seed=6)
+    ref = gn.group_norm_reference(x, None, None, 8, 1e-5, None)
+    got = F.group_norm(x, 8, None, None, 1e-5, "NHWC")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -7,6 +7,7 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import distribution, fft, jit, linalg, nn, quantization as q
+from paddle_tpu.jax_compat import enable_x64 as _enable_x64
 
 
 def test_linalg_basics():
@@ -263,7 +264,7 @@ def _linalg_x64(request):
     if "TestLinalgExtended" in request.node.nodeid:
         import jax
 
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             yield
     else:
         yield
@@ -461,7 +462,9 @@ class TestTensorOpsRound3:
                                               jnp.asarray(b), axes=2))
         ref = torch.tensordot(torch.tensor(a), torch.tensor(b),
                               dims=2).numpy()
-        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+        # ours runs f32 (jnp default) vs torch's f64; the contraction
+        # order XLA picks varies by version, so allow f32-edge slack
+        np.testing.assert_allclose(ours, ref, rtol=3e-5, atol=1e-6)
 
     def test_renorm(self):
         import torch

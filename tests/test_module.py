@@ -10,6 +10,9 @@ import paddle_tpu as pt
 from paddle_tpu import nn
 from paddle_tpu.core.functional import extract_params, functional_call
 
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
+
 
 class MLP(nn.Layer):
     def __init__(self):

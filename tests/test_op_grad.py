@@ -19,6 +19,10 @@ import numpy as np
 import pytest
 
 import paddle_tpu.nn.functional as F
+from paddle_tpu.jax_compat import enable_x64 as _enable_x64
+
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
 
 
 def _rand(shape, seed, scale=1.0):
@@ -182,7 +186,7 @@ OPS = [
 @pytest.mark.parametrize("name,fn,build,argnums", OPS,
                          ids=[o[0] for o in OPS])
 def test_numeric_grad_fp64(name, fn, build, argnums):
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         args = [jnp.asarray(a, jnp.float64)
                 if np.asarray(a).dtype.kind == "f" else jnp.asarray(a)
                 for a in build()]

@@ -10,6 +10,9 @@ import paddle_tpu as pt
 from paddle_tpu import nn, optimizer as opt
 from paddle_tpu.core.functional import extract_params, functional_call
 
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
+
 
 def _numpy_adamw(w, g, m, v, step, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
     m = b1 * m + (1 - b1) * g

@@ -12,6 +12,9 @@ import paddle_tpu as pt
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
+
 torch = pytest.importorskip("torch")
 tF = torch.nn.functional
 
@@ -61,6 +64,10 @@ def test_places_grad_flag_dataparallel():
     assert pt.Tensor is jax.Array
     assert pt.CPUPlace() == pt.CPUPlace()
     assert pt.CUDAPlace(1) != pt.CUDAPlace(0)
+    # Places are hashable (sets / dict keys), consistent with __eq__
+    assert len({pt.CPUPlace(), pt.CPUPlace()}) == 1
+    assert len({pt.CUDAPlace(0), pt.CUDAPlace(0), pt.CUDAPlace(1)}) == 2
+    assert {pt.CUDAPlace(0): "a"}[pt.CUDAPlace(0)] == "a"
     g = pt.grad(lambda x: (x ** 3).sum(), (jnp.asarray([2.0]),))
     assert float(g[0][0]) == 12.0  # one gradient per input (tuple)
     gx, gy = pt.grad(lambda x, y: (x * y).sum(),
@@ -77,7 +84,18 @@ def test_places_grad_flag_dataparallel():
     m = nn.Linear(2, 2)
     dp = pt.DataParallel(m)
     assert dp(jnp.ones((1, 2))).shape == (1, 2)
-    assert set(dp.state_dict()) == {"_layers.weight", "_layers.bias"}
+    # upstream delegation: checkpoint keys match the UNWRAPPED model, so
+    # a DataParallel-trained state_dict loads into a bare model
+    sd = dp.state_dict()
+    assert set(sd) == {"weight", "bias"}
+    bare = nn.Linear(2, 2)
+    missing, unexpected = bare.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_array_equal(np.asarray(bare.weight.value),
+                                  np.asarray(m.weight.value))
+    # and the wrapper loads a bare model's checkpoint
+    missing, unexpected = dp.set_state_dict(bare.state_dict())
+    assert not missing and not unexpected
 
 
 def test_param_attr():
@@ -358,8 +376,11 @@ def test_tensor_method_surface():
     assert bool(x.greater_than(jnp.zeros_like(x))[0, 0])
     assert x.detach().shape == x.shape
     assert x.cpu().shape == x.shape
-    # stop_gradient: readable (paddle default True), assignment raises
-    # with the migration hint
+    # stop_gradient: readable (paddle default True); assigning True is
+    # the common migration idiom and a semantic no-op; only False (tape
+    # trainability) raises with the migration hint
+    assert x.stop_gradient is True
+    x.stop_gradient = True  # no-op, must not raise
     assert x.stop_gradient is True
     with pytest.raises(AttributeError, match="Parameter.trainable"):
         x.stop_gradient = False

@@ -11,6 +11,9 @@ import paddle_tpu as pt
 from paddle_tpu import nn
 import paddle_tpu.nn.functional as F
 
+# core-engine fast lane (see README "Tests")
+pytestmark = pytest.mark.fast
+
 
 class TestMathOps:
     def setup_method(self, _):

@@ -381,3 +381,247 @@ class TestGeometricTransforms:
         img = jnp.ones((1, 8, 8))
         out = T.RandomAffine(10.0, shear=(-5.0, 5.0), seed=0)(img)
         assert out.shape == (1, 8, 8)
+
+
+class TestLayoutPolicy:
+    """NHWC<->NCHW round-trip parity for the conv-workload fast path
+    (nn/layout.py): conv/pool outputs and grads bit-compared across
+    layouts, GroupNorm within fp32 tolerance (its fused kernel reduces
+    in a different order), and the scope/resolve mechanics."""
+
+    def _x(self, shape=(2, 8, 9, 10), seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    def test_conv2d_layout_roundtrip_bitexact(self):
+        from paddle_tpu.nn import functional as F
+
+        x = self._x()
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.standard_normal((6, 10, 3, 3)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(6), jnp.float32)
+        x = jnp.moveaxis(x, -1, 1)  # NCHW [2, 10, 8, 9]
+        xl = jnp.transpose(x, (0, 2, 3, 1))
+        y0 = F.conv2d(x, w, b, stride=2, padding=1)
+        y1 = F.conv2d(xl, w, b, stride=2, padding=1, data_format="NHWC")
+        np.testing.assert_array_equal(
+            np.asarray(y0), np.asarray(jnp.transpose(y1, (0, 3, 1, 2))))
+
+    def test_conv2d_layout_grads_bitexact(self):
+        from paddle_tpu.nn import functional as F
+
+        x = jnp.moveaxis(self._x(seed=2), -1, 1)
+        w = jnp.asarray(
+            np.random.default_rng(3).standard_normal((4, 10, 3, 3)),
+            jnp.float32)
+
+        def f_nchw(x, w):
+            return jnp.sum(F.conv2d(x, w, None, padding=1) ** 2)
+
+        def f_nhwc(x, w):
+            xl = jnp.transpose(x, (0, 2, 3, 1))
+            return jnp.sum(F.conv2d(xl, w, None, padding=1,
+                                    data_format="NHWC") ** 2)
+
+        g0 = jax.grad(f_nchw, argnums=(0, 1))(x, w)
+        g1 = jax.grad(f_nhwc, argnums=(0, 1))(x, w)
+        np.testing.assert_array_equal(np.asarray(g0[0]), np.asarray(g1[0]))
+        np.testing.assert_array_equal(np.asarray(g0[1]), np.asarray(g1[1]))
+
+    def test_pool_layout_roundtrip_bitexact(self):
+        from paddle_tpu.nn import functional as F
+
+        x = jnp.moveaxis(self._x(seed=4), -1, 1)
+        xl = jnp.transpose(x, (0, 2, 3, 1))
+        for fn in (F.max_pool2d, F.avg_pool2d):
+            y0 = fn(x, 2, 2)
+            y1 = fn(xl, 2, 2, data_format="NHWC")
+            np.testing.assert_array_equal(
+                np.asarray(y0),
+                np.asarray(jnp.transpose(y1, (0, 3, 1, 2))))
+        y0 = F.adaptive_avg_pool2d(x, 2)
+        y1 = F.adaptive_avg_pool2d(xl, 2, data_format="NHWC")
+        np.testing.assert_array_equal(
+            np.asarray(y0), np.asarray(jnp.transpose(y1, (0, 3, 1, 2))))
+
+    def test_group_norm_layout_roundtrip(self):
+        from paddle_tpu.nn import functional as F
+
+        x = jnp.moveaxis(self._x((2, 6, 5, 32), seed=5), -1, 1)
+        rng = np.random.default_rng(6)
+        gamma = jnp.asarray(rng.standard_normal(32), jnp.float32)
+        beta = jnp.asarray(rng.standard_normal(32), jnp.float32)
+        xl = jnp.transpose(x, (0, 2, 3, 1))
+
+        y0 = F.group_norm(x, 8, gamma, beta)
+        y1 = F.group_norm(xl, 8, gamma, beta, data_format="NHWC")
+        np.testing.assert_allclose(
+            np.asarray(y0), np.asarray(jnp.transpose(y1, (0, 3, 1, 2))),
+            rtol=1e-5, atol=1e-5)
+
+        def f_nchw(x, ga, be):
+            return jnp.sum(F.group_norm(x, 8, ga, be) ** 2)
+
+        def f_nhwc(x, ga, be):
+            xl = jnp.transpose(x, (0, 2, 3, 1))
+            return jnp.sum(F.group_norm(xl, 8, ga, be,
+                                        data_format="NHWC") ** 2)
+
+        g0 = jax.grad(f_nchw, argnums=(0, 1, 2))(x, gamma, beta)
+        g1 = jax.grad(f_nhwc, argnums=(0, 1, 2))(x, gamma, beta)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_scope_resolves_declared_nchw(self):
+        from paddle_tpu.nn import layout
+
+        assert layout.resolve("NCHW") == "NCHW"
+        with layout.channels_last_scope(True):
+            assert layout.active()
+            assert layout.resolve("NCHW") == "NHWC"
+            assert layout.resolve("NHWC") == "NHWC"  # idempotent
+            assert layout.resolve("NCL") == "NCL"    # 1-D untouched
+        assert not layout.active()
+        with layout.channels_last_scope(False):
+            assert not layout.active()
+
+    def test_conv_layout_flag_policy(self):
+        import paddle_tpu as pt
+        from paddle_tpu.nn import layout
+
+        orig = pt.flags.flag("conv_layout")
+        try:
+            pt.flags.set_flags({"FLAGS_conv_layout": "NHWC"})
+            assert layout.decide(None) is True
+            pt.flags.set_flags({"FLAGS_conv_layout": "NCHW"})
+            assert layout.decide(None) is False
+            assert layout.decide(True) is True   # explicit overrides
+            pt.flags.set_flags({"FLAGS_conv_layout": "auto"})
+            # auto on the CPU test backend = channels-first
+            assert layout.decide(None) is False
+        finally:
+            pt.flags.set_flags({"FLAGS_conv_layout": orig})
+
+    def test_layer_under_scope_runs_channels_last(self):
+        """A Conv2D declared NCHW, fed NHWC inside the scope, matches
+        the plain NCHW run bit-for-bit."""
+        import paddle_tpu as pt
+        from paddle_tpu.nn import layout
+        from paddle_tpu.nn.layer.conv import Conv2D
+
+        pt.seed(7)
+        conv = Conv2D(10, 4, 3, padding=1)
+        x = jnp.moveaxis(self._x(seed=8), -1, 1)
+        y0 = conv(x)
+        with layout.channels_last_scope(True):
+            y1 = conv(jnp.transpose(x, (0, 2, 3, 1)))
+        np.testing.assert_array_equal(
+            np.asarray(y0), np.asarray(jnp.transpose(y1, (0, 3, 1, 2))))
+
+    def test_unet_channels_last_parity(self):
+        import dataclasses
+
+        import paddle_tpu as pt
+        from paddle_tpu.core.functional import (
+            extract_params,
+            functional_call,
+        )
+        from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+        pt.seed(0)
+        cfg = UNetConfig.tiny()
+        net = UNet2DConditionModel(cfg)
+        rng = np.random.default_rng(0)
+        sample = jnp.asarray(rng.standard_normal((2, 4, 16, 16)),
+                             jnp.float32)
+        t = jnp.asarray([1, 500])
+        ctx = jnp.asarray(rng.standard_normal((2, 8, 32)), jnp.float32)
+
+        net.config = dataclasses.replace(cfg, channels_last=False)
+        out_nchw = net(sample, t, ctx)
+        net.config = dataclasses.replace(cfg, channels_last=True)
+        out_nhwc = net(sample, t, ctx)
+        np.testing.assert_allclose(np.asarray(out_nhwc),
+                                   np.asarray(out_nchw),
+                                   rtol=1e-4, atol=1e-5)
+
+        params = extract_params(net)
+
+        def loss(p, cl):
+            net.config = dataclasses.replace(cfg, channels_last=cl)
+            pred = functional_call(net, p, sample, t, ctx)
+            return jnp.mean((pred - sample) ** 2)
+
+        g0 = jax.grad(lambda p: loss(p, False))(params)
+        g1 = jax.grad(lambda p: loss(p, True))(params)
+        for k in g0:
+            np.testing.assert_allclose(np.asarray(g1[k]),
+                                       np.asarray(g0[k]),
+                                       rtol=1e-3, atol=1e-5, err_msg=k)
+
+    def test_resnet_channels_last_parity(self):
+        import paddle_tpu as pt
+        from paddle_tpu.core.functional import (
+            extract_params,
+            functional_call,
+        )
+        from paddle_tpu.nn.layer.norm import GroupNorm
+        from paddle_tpu.vision.models.resnet import resnet18
+
+        pt.seed(0)
+        net = resnet18(num_classes=10, norm_layer=lambda c: GroupNorm(4, c))
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 3, 32, 32)),
+            jnp.float32)
+        labels = jnp.asarray([1, 2])
+
+        net.channels_last = False
+        y0 = net(x)
+        net.channels_last = True
+        y1 = net(x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-4, atol=1e-5)
+
+        params = extract_params(net)
+
+        def loss(p, cl):
+            net.channels_last = cl
+            return functional_call(net, p, x, labels).mean()
+
+        g0 = jax.grad(lambda p: loss(p, False))(params)
+        g1 = jax.grad(lambda p: loss(p, True))(params)
+        for k in g0:
+            np.testing.assert_allclose(np.asarray(g1[k]),
+                                       np.asarray(g0[k]),
+                                       rtol=1e-3, atol=1e-5, err_msg=k)
+
+    def test_vit_channels_last_parity(self):
+        import dataclasses
+
+        import paddle_tpu as pt
+        from paddle_tpu.models import ViT, ViTConfig
+
+        pt.seed(1)
+        cfg = ViTConfig.tiny()
+        vit = ViT(cfg)
+        x = jnp.asarray(
+            np.random.default_rng(2).standard_normal((2, 3, 32, 32)),
+            jnp.float32)
+        vit.config = dataclasses.replace(cfg, channels_last=False)
+        y0 = vit(x)
+        vit.config = dataclasses.replace(cfg, channels_last=True)
+        y1 = vit(x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_interpolate_nearest_nhwc_native(self):
+        from paddle_tpu.nn import functional as F
+
+        x = jnp.moveaxis(self._x(seed=9), -1, 1)
+        xl = jnp.transpose(x, (0, 2, 3, 1))
+        y0 = F.interpolate(x, scale_factor=2, mode="nearest")
+        y1 = F.interpolate(xl, scale_factor=2, mode="nearest",
+                           data_format="NHWC")
+        np.testing.assert_array_equal(
+            np.asarray(y0), np.asarray(jnp.transpose(y1, (0, 3, 1, 2))))
