@@ -85,6 +85,13 @@ def _train_throughput(model, data, loss_fn=None, unit_count=0):
     loss = ts.run(data)
     fetch_sync(loss)
 
+    # cost analysis BEFORE the timed phase, and prime the TrainStep's
+    # telemetry FLOPs cache with it — the lazy probe (an AOT
+    # lower+compile) must never fire inside a traced timing window
+    flops = compiled_flops(ts.lower(data))
+    ts._flops_per_step = flops
+    ts._flops_probed = True
+
     # phase 1: short trace to learn the true device step time
     timing = traced_step_ms(lambda: ts.run(data), n_steps=3)
     # phase 2: if the traced window is too short for stable numbers,
@@ -92,8 +99,6 @@ def _train_throughput(model, data, loss_fn=None, unit_count=0):
     if tpu and timing.device_step_ms and timing.device_step_ms * 3 < 200:
         n = min(100, max(5, int(400 / timing.device_step_ms)))
         timing = traced_step_ms(lambda: ts.run(data), n_steps=n)
-
-    flops = compiled_flops(ts.lower(data))
     plaus = check_plausible(flops, timing.step_ms)
     if tpu and timing.device_step_ms is None:
         # no device plane in the trace: wall clock through the tunnel
@@ -351,6 +356,7 @@ def _run_load(eng, prompts, new_tokens, gap, max_chunk, mode="chunked"):
     if mode not in ("chunked", "blocking", "adaptive"):
         raise ValueError(f"unknown load mode {mode!r}")
     eng._finished.clear()
+    eng.metrics_window_reset()  # one telemetry window per sweep
     t_start = time.perf_counter()
     submitted = 0
     next_arrival = t_start
@@ -373,15 +379,32 @@ def _run_load(eng, prompts, new_tokens, gap, max_chunk, mode="chunked"):
     t_total = time.perf_counter() - t_start
 
     reqs = [eng._finished[r] for r in sorted(eng._finished)]
-    ttfts = np.array([r.ttft_ms for r in reqs if r.ttft_ms is not None])
     total_toks = sum(len(r.output) for r in reqs)
-    return {
+    out = {
         "gap_ms": round(gap * 1e3, 1),
-        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
-        "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
         "served_tokens_per_sec": round(total_toks / t_total, 1),
         "n_requests": len(reqs),
     }
+    # TTFT percentiles + scheduler peaks come from the shared telemetry
+    # registry (the same numbers a live /metrics scrape reports), not a
+    # bench-private accounting path; raw Request fields remain the
+    # fallback when PT_FLAGS_telemetry=off
+    snap = eng.metrics_snapshot()
+    ttft = snap.get("ttft_ms") or {}
+    if ttft.get("p50") is not None:
+        out["p50_ttft_ms"] = round(float(ttft["p50"]), 2)
+        out["p99_ttft_ms"] = round(float(ttft["p99"]), 2)
+        out["peak_queue_depth"] = int(snap["queue_depth"]["peak"])
+        out["peak_batch_occupancy"] = round(
+            float(snap["batch_occupancy"]["peak"]), 3)
+        out["peak_kv_pool_utilization"] = round(
+            float(snap["kv_pool"]["peak_utilization"]), 3)
+    else:
+        ttfts = np.array(
+            [r.ttft_ms for r in reqs if r.ttft_ms is not None])
+        out["p50_ttft_ms"] = round(float(np.percentile(ttfts, 50)), 2)
+        out["p99_ttft_ms"] = round(float(np.percentile(ttfts, 99)), 2)
+    return out
 
 
 def bench_infer(tpu_diags):

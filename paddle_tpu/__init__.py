@@ -105,6 +105,7 @@ def finfo(dtype):
 
 # ---- round-5 migration-surface sweep (top-level paddle names) ----
 
+from . import observability  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..jax_compat import shard_map
 
+from ..observability import record_collective as _record
 from .topology import get_hybrid_communicate_group
 
 
@@ -39,9 +40,13 @@ class ReduceOp:
 
 
 # ---------------------------------------------------------------------------
-# in-jit collectives (call inside shard_map with a named axis)
+# in-jit collectives (call inside shard_map with a named axis).
+# Each records (op, axis, payload bytes, call site) at TRACE time via
+# observability.comm — one entry per collective baked into a compiled
+# program, so a program's communication volume is queryable.
 # ---------------------------------------------------------------------------
 def all_reduce_in(x, op: str = ReduceOp.SUM, axis: str = "dp"):
+    _record("all_reduce", axis, x)
     if op == ReduceOp.SUM:
         return jax.lax.psum(x, axis)
     if op == ReduceOp.MAX:
@@ -56,20 +61,24 @@ def all_reduce_in(x, op: str = ReduceOp.SUM, axis: str = "dp"):
 
 
 def all_gather_in(x, axis: str = "dp", tiled_dim: int = 0):
+    _record("all_gather", axis, x)
     return jax.lax.all_gather(x, axis, axis=tiled_dim, tiled=True)
 
 
 def reduce_scatter_in(x, axis: str = "dp", scatter_dim: int = 0):
+    _record("reduce_scatter", axis, x)
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
                                 tiled=True)
 
 
 def all_to_all_in(x, axis: str = "sep", split_dim: int = 0, concat_dim: int = 0):
+    _record("all_to_all", axis, x)
     return jax.lax.all_to_all(x, axis, split_axis=split_dim,
                               concat_axis=concat_dim, tiled=True)
 
 
 def ppermute_in(x, axis: str, perm):
+    _record("ppermute", axis, x)
     return jax.lax.ppermute(x, axis, perm)
 
 
@@ -426,6 +435,7 @@ def alltoall_single_in(x, send_sizes, axis: str = "ep",
     send_buf = jnp.where(
         valid, x[jnp.clip(src_idx, 0, max(n - 1, 0))],
         jnp.zeros((), x.dtype))
+    _record("alltoall_single", axis, send_buf)
     recv = jax.lax.all_to_all(send_buf, axis, 0, 0)
     recv_sizes = jax.lax.all_to_all(send_sizes, axis, 0, 0, tiled=True)
     return recv, recv_sizes

@@ -23,7 +23,7 @@ def define_flag(name: str, default, help_: str = ""):
     value = default
     if env is not None:
         if isinstance(default, bool):
-            value = env.lower() in ("1", "true", "yes")
+            value = env.lower() in ("1", "true", "yes", "on")
         elif isinstance(default, int):
             value = int(env)
         elif isinstance(default, float):
@@ -71,7 +71,22 @@ define_flag("check_nan_inf", False,
             "debug-check gradients for NaN/Inf each step (jax.debug)")
 define_flag("default_matmul_precision", "",
             "override jax matmul precision: bfloat16|tensorfloat32|highest")
-define_flag("log_memory_stats", False, "log device memory after each step")
+define_flag("log_memory_stats", False,
+            "record device bytes_in_use/peak_bytes_in_use through the "
+            "telemetry registry on sampled steps")
+define_flag("telemetry", True,
+            "always-on runtime telemetry (observability.MetricsRegistry); "
+            "off = every instrumented path is a no-op")
+define_flag("telemetry_sample_every", 10,
+            "fetch loss/grad-norm/memory host-side every N train steps "
+            "(non-sampled steps never force a device sync)")
+define_flag("telemetry_flight_window", 64,
+            "flight-recorder ring buffer size (last K step records)")
+define_flag("telemetry_dump_dir", "flight_records",
+            "directory for flight-recorder JSON dumps")
+define_flag("telemetry_grad_spike_factor", 10.0,
+            "anomaly watchdog trips when grad norm exceeds this factor "
+            "times the running median")
 define_flag("rng_use_global_seed", True,
             "derive eager rng stream from the global seed")
 define_flag("fused_group_norm", True,

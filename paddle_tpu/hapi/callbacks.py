@@ -1,12 +1,19 @@
 """High-level training callbacks (parity: python/paddle/hapi/callbacks.py
 — Callback ABC, ProgBarLogger, ModelCheckpoint, EarlyStopping,
-LRScheduler, VisualDL→ a generic scalar logger)."""
+LRScheduler, VisualDL→ a generic scalar logger, plus MetricsLogger
+publishing through the observability registry).
+
+Scalar emission (ProgBarLogger prints, VisualDL JSONL) is also routed
+through ``observability.record_scalars`` so hapi training feeds the
+process-wide telemetry registry for free."""
 
 from __future__ import annotations
 
 import os
 import time
 from typing import List, Optional
+
+from ..observability import record_scalars
 
 
 class Callback:
@@ -81,6 +88,8 @@ class ProgBarLogger(Callback):
             print(f"Epoch {epoch + 1}")
 
     def on_train_batch_end(self, step, logs=None):
+        if step % self.log_freq == 0:
+            record_scalars("hapi_train", logs)
         if self.verbose and step % self.log_freq == 0:
             items = " - ".join(
                 f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
@@ -226,6 +235,7 @@ class VisualDL(Callback):
     def _write(self, tag, logs):
         import json
 
+        record_scalars(f"hapi_{tag}", logs)
         os.makedirs(self.log_dir, exist_ok=True)
         path = os.path.join(self.log_dir, "scalars.jsonl")
         rec = {"tag": tag, "step": self._step}
@@ -244,3 +254,34 @@ class VisualDL(Callback):
 
     def on_eval_end(self, logs=None):
         self._write("eval", logs)
+
+
+class MetricsLogger(Callback):
+    """Publish every hapi train/eval scalar through the observability
+    registry (``pt_hapi_train_*`` / ``pt_hapi_eval_*`` gauges plus step
+    and epoch counters), so ``Model.fit`` runs show up on the same
+    ``/metrics`` scrape as TrainStep and the serving engine."""
+
+    def __init__(self, log_freq: int = 1):
+        self.log_freq = max(1, int(log_freq))
+
+    def on_train_begin(self, logs=None):
+        from ..observability import get_registry
+
+        reg = get_registry()
+        self._steps = reg.counter(
+            "pt_hapi_steps_total", "hapi train batches")
+        self._epochs = reg.counter(
+            "pt_hapi_epochs_total", "hapi epochs completed")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps.inc()
+        if step % self.log_freq == 0:
+            record_scalars("hapi_train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epochs.inc()
+        record_scalars("hapi_train", logs)
+
+    def on_eval_end(self, logs=None):
+        record_scalars("hapi_eval", logs)

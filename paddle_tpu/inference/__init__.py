@@ -347,6 +347,7 @@ from .serving import (  # noqa: E402,F401
     ContinuousBatchingEngine,
     EngineConfig,
     Request,
+    start_metrics_server,
 )
 
 

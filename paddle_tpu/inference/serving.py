@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability
 from ..core.functional import (
     extract_buffers,
     extract_params,
@@ -182,6 +183,11 @@ class ContinuousBatchingEngine:
         self._insert_c = None
         self._scatter_c = None
 
+        # telemetry (None when PT_FLAGS_telemetry=off → scheduling loop
+        # pays a single identity check per hook site)
+        self._tel = (observability.ServingTelemetry()
+                     if observability.enabled() else None)
+
     def _shard_kv(self, arr, axis=-2):
         """Shard the kv-head axis over tp (requires kv_heads % tp == 0):
         axis -2 for contiguous [..., kv_heads, head_dim] caches, axis 0
@@ -213,6 +219,8 @@ class ContinuousBatchingEngine:
                       _submit_t=time.perf_counter())
         self._next_rid += 1
         self._queue.append(req)
+        if self._tel is not None:
+            self._tel.on_submit(len(self._queue))
         return req.rid
 
     def _free_slots(self) -> List[int]:
@@ -438,6 +446,8 @@ class ContinuousBatchingEngine:
             req.output.append(first)
             self.seq_lens[slot] = req.prompt.size
             self.last_tok[slot] = first
+            if self._tel is not None:
+                self._tel.on_admit(req.ttft_ms)
             self._maybe_finish(slot, first)
 
     def _admit(self):
@@ -457,6 +467,8 @@ class ContinuousBatchingEngine:
             del self._slot_req[slot]
             if self.pool is not None:
                 self.pool.free(slot)
+            if self._tel is not None:
+                self._tel.on_finish()
 
     def step(self) -> bool:
         """Admit waiting requests, run one decode step for all active
@@ -464,6 +476,7 @@ class ContinuousBatchingEngine:
         self._admit()
         if not self.active.any():
             return bool(self._queue)
+        t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
@@ -478,6 +491,7 @@ class ContinuousBatchingEngine:
                 nxt, self.caches = self._decode()(
                     self._pb, toks, self.caches, lens, sub)
         nxt = np.asarray(nxt)
+        emitted = 0
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
                 continue
@@ -485,7 +499,12 @@ class ContinuousBatchingEngine:
             self._slot_req[slot].output.append(tok)
             self.seq_lens[slot] += 1
             self.last_tok[slot] = tok
+            emitted += 1
             self._maybe_finish(slot, tok)
+        if self._tel is not None:
+            self._tel.on_tokens(emitted,
+                                (time.perf_counter() - t0) * 1e3)
+            self._tel.on_state(*self._tel_state())
         return True
 
     def _slot_budgets(self) -> np.ndarray:
@@ -518,6 +537,7 @@ class ContinuousBatchingEngine:
             self._admit()
             if not self.active.any():
                 return bool(self._queue)
+        t0 = time.perf_counter()
         K = max_chunk
         # capture the chunk's view BEFORE admission: newly admitted
         # slots must not decode mid-chunk (their lengths land at
@@ -543,6 +563,12 @@ class ContinuousBatchingEngine:
         # chunk → prefills → inserts into the chunk's output caches)
         pending = self._admit_dispatch()
         toks_np = np.asarray(toks_all)  # ONE sync for K tokens
+        # TPOT window closes at the chunk's token sync — before the
+        # admitted requests' first-token syncs in _admit_integrate, so
+        # loaded chunks report decode latency, not admission latency
+        # (matches what step() measures)
+        t_sync = time.perf_counter()
+        emitted = 0
         for k in range(K):
             for slot in range(self.cfg.max_slots):
                 # chunk_slots: was in this chunk; active: not finished
@@ -554,8 +580,12 @@ class ContinuousBatchingEngine:
                 self._slot_req[slot].output.append(tok)
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
+                emitted += 1
                 self._maybe_finish(slot, tok)
         self._admit_integrate(pending)
+        if self._tel is not None:
+            self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
+            self._tel.on_state(*self._tel_state())
         return True
 
     def step_adaptive(self, max_chunk: int = 8,
@@ -612,3 +642,109 @@ class ContinuousBatchingEngine:
                 self.active.any():
             pass
         return [self._finished[r] for r in rids]
+
+    # ---------------- telemetry ----------------
+    def _tel_state(self):
+        """(queue_depth, occupancy, kv_used, kv_total) — all host-side
+        scheduler state, no device traffic. Thread-note: also called
+        from the /healthz scrape thread; ``pages_of`` has fixed slot
+        keys (created once in PagePool.__init__, values replaced whole
+        on free), so concurrent iteration never sees a resized dict —
+        a scrape racing the scheduler can read a momentarily stale
+        count, which is acceptable for a gauge."""
+        occ = float(self.active.sum()) / self.cfg.max_slots
+        if self.cfg.paged:
+            used = float(sum(
+                len(self.pool.pages_of[s])
+                for s in range(self.pool.slots)))
+            total = used + self.pool.free_pages
+        else:
+            used = float(self.seq_lens[self.active].sum())
+            total = float(self.cfg.max_slots * self.cfg.max_len)
+        return len(self._queue), occ, used, total
+
+    def metrics_snapshot(self) -> dict:
+        """Aggregated serving metrics: TTFT/TPOT percentiles, queue
+        depth (current/peak), batch occupancy, KV-pool utilization and
+        request/token counters. ``{"telemetry": "off"}`` when the
+        telemetry flag is disabled."""
+        if self._tel is None:
+            return {"telemetry": "off"}
+        # refresh point-in-time gauges so an idle engine still reports
+        # its current state
+        self._tel.on_state(*self._tel_state())
+        snap = self._tel.snapshot()
+        snap["slots"] = {
+            "active": int(self.active.sum()),
+            "max": self.cfg.max_slots,
+        }
+        return snap
+
+    def metrics_window_reset(self):
+        """Reset percentile windows + peak trackers (cumulative
+        counters keep running) — one measurement window per benchmark
+        sweep."""
+        if self._tel is not None:
+            self._tel.window_reset()
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /healthz exposition (parity: FastDeploy-style serving
+# endpoints; scrape target for Prometheus)
+# ---------------------------------------------------------------------------
+def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
+                         host: str = "127.0.0.1", port: int = 0):
+    """Serve ``/metrics`` (Prometheus text exposition of the process
+    registry) and ``/healthz`` (JSON liveness + engine snapshot) on a
+    daemon thread. Returns the ``ThreadingHTTPServer``; read
+    ``server.server_address`` for the bound port (``port=0`` picks a
+    free one), call ``server.shutdown()`` to stop."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def _send(self, code, body: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            # a scrape must never die on a transient error: the
+            # liveness endpoint failing under load defeats its purpose
+            try:
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    text = observability.global_registry() \
+                        .prometheus_text()
+                    self._send(200, text.encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    payload = {"status": "ok",
+                               "telemetry": observability.enabled()}
+                    if engine is not None:
+                        payload["engine"] = engine.metrics_snapshot()
+                    self._send(
+                        200, json.dumps(payload, default=str).encode(),
+                        "application/json")
+                else:
+                    self._send(404, b"not found", "text/plain")
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self._send(500, repr(e).encode(), "text/plain")
+                except Exception:
+                    pass
+
+        def log_message(self, fmt, *args):  # quiet scrape noise
+            pass
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="pt-metrics-server")
+    thread.start()
+    server._pt_thread = thread
+    return server

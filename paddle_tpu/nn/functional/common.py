@@ -149,11 +149,16 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         ix = jnp.minimum(jnp.arange(ow) * w // ow, w - 1)
         return x[:, iy][:, :, ix]
     if data_format in ("NWC", "NHWC", "NDHWC"):
+        from .. import layout
+
         fmt = {"NWC": "NCW", "NHWC": "NCHW", "NDHWC": "NCDHW"}
-        return jnp.moveaxis(
-            interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor, mode,
-                        align_corners, align_mode, fmt[data_format]),
-            1, -1)
+        # the tensor is explicitly transposed to channel-first here, so
+        # the recursion's declared NCHW must NOT re-resolve to NHWC
+        with layout.declared_scope():
+            y = interpolate(jnp.moveaxis(x, -1, 1), size, scale_factor,
+                            mode, align_corners, align_mode,
+                            fmt[data_format])
+        return jnp.moveaxis(y, 1, -1)
     if x.ndim == 3:
         n, c, w = x.shape
         if size is not None:

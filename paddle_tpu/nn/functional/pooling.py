@@ -179,8 +179,11 @@ def adaptive_max_pool2d(x, output_size, return_mask=False,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     if data_format == "NHWC":
-        y = adaptive_max_pool2d(jnp.moveaxis(x, -1, 1), output_size,
-                                return_mask)
+        # explicit transpose to channel-first: suspend scope resolution
+        # or the recursion's declared NCHW re-resolves to NHWC forever
+        with layout.declared_scope():
+            y = adaptive_max_pool2d(jnp.moveaxis(x, -1, 1), output_size,
+                                    return_mask)
         if return_mask:
             return (jnp.moveaxis(y[0], 1, -1),
                     jnp.moveaxis(y[1], 1, -1))

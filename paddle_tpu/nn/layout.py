@@ -91,6 +91,22 @@ def resolve(declared: str) -> str:
     return declared
 
 
+@contextlib.contextmanager
+def declared_scope():
+    """Temporarily suspend scope resolution: inner calls see their
+    declared data_format verbatim. Required when an op's NHWC branch
+    transposes explicitly and recurses into its own NCHW
+    implementation — without this, ``resolve`` re-maps the recursion's
+    declared NCHW back to NHWC forever (RecursionError)."""
+    global _scope_depth
+    prev = _scope_depth
+    _scope_depth = 0
+    try:
+        yield
+    finally:
+        _scope_depth = prev
+
+
 def nchw_to_nhwc(x):
     import jax.numpy as jnp
 

@@ -1,0 +1,28 @@
+"""Always-on runtime telemetry.
+
+One process-wide ``MetricsRegistry`` (Counter / Gauge / Histogram with
+Prometheus text exposition and JSON snapshot) that the trainer, the
+continuous-batching engine, the collectives and the hapi callbacks all
+publish through; a flight recorder + anomaly watchdog for postmortems;
+and a one-shot dump CLI (``python -m paddle_tpu.observability.dump``).
+
+``PT_FLAGS_telemetry=off`` turns every instrumented path into a true
+no-op (shared null objects, no dict churn). See README "Observability".
+"""
+
+from .comm import comm_log, record as record_collective, reset_comm_log  # noqa: F401
+from .recorder import AnomalyWatchdog, FlightRecorder  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    DEFAULT_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+    enabled,
+    exp_buckets,
+    get_registry,
+    global_registry,
+)
+from .serve import ServingTelemetry  # noqa: F401
+from .train import TrainTelemetry, record_scalars  # noqa: F401

@@ -1,0 +1,79 @@
+"""One-shot telemetry snapshot CLI.
+
+``python -m paddle_tpu.observability.dump`` prints a JSON snapshot of
+the in-process registry, the per-call-site collective log, and per-
+device ``memory_stats()`` — the no-debugger inspection path. For a
+*running* server, ``--url http://host:port/metrics`` scrapes its
+Prometheus endpoint instead (a separate process cannot see this
+process's registry).
+
+Options:
+  --prometheus   emit Prometheus text format instead of JSON
+  --no-device    skip device queries (safe on a wedged accelerator)
+  --url URL      fetch a live /metrics endpoint and print it
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _device_memory():
+    import jax
+
+    out = []
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({
+            "device": f"{d.platform}:{d.id}",
+            "kind": getattr(d, "device_kind", ""),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.dump",
+        description="one-shot paddle_tpu telemetry snapshot")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="Prometheus text format instead of JSON")
+    ap.add_argument("--no-device", action="store_true",
+                    help="skip jax device queries")
+    ap.add_argument("--url", default=None,
+                    help="scrape a live /metrics endpoint instead")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        from urllib.request import urlopen
+
+        with urlopen(args.url, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode("utf-8", "replace"))
+        return 0
+
+    from . import comm, registry
+
+    if args.prometheus:
+        sys.stdout.write(registry.global_registry().prometheus_text())
+        return 0
+
+    snap = {
+        "telemetry_enabled": registry.enabled(),
+        "metrics": registry.global_registry().snapshot(),
+        "collectives": comm.comm_log(),
+    }
+    if not args.no_device:
+        snap["device_memory"] = _device_memory()
+    json.dump(snap, sys.stdout, indent=1, default=str)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

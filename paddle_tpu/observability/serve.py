@@ -1,0 +1,157 @@
+"""Serving telemetry for the continuous-batching engine.
+
+Aggregates TTFT/TPOT histograms, queue-depth and batch-occupancy
+gauges, KV-pool utilization and request/token counters. The engine
+calls the ``on_*`` hooks from its scheduling loop; everything here is
+host-side bookkeeping over values the scheduler already holds — no
+extra device traffic.
+
+Every metric carries an ``engine`` label (a process-monotonic id), so
+two engines in one process — bench sweeps, multi-model serving — keep
+distinct series on the same ``/metrics`` scrape, and one engine's
+``window_reset()`` cannot clobber another's peaks.
+
+``window_reset()`` clears the raw percentile windows (histogram-side)
+and peak trackers without touching the cumulative Prometheus totals,
+so a benchmark sweep (benchmarks/suite.py ``_run_load``) reads
+per-window percentiles from the same registry a live scrape sees.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from .registry import exp_buckets, get_registry
+
+_ENGINE_SEQ = itertools.count()
+
+
+class ServingTelemetry:
+    def __init__(self):
+        reg = get_registry()
+        self.engine_id = str(next(_ENGINE_SEQ))
+        L = ("engine",)
+        self._ttft = reg.histogram(
+            "pt_serve_ttft_ms", "time to first token (ms)", labels=L,
+            buckets=exp_buckets(1.0, 2.0, 18))
+        self._tpot = reg.histogram(
+            "pt_serve_tpot_ms", "time per output token (ms)", labels=L,
+            buckets=exp_buckets(0.25, 2.0, 16))
+        self._queue = reg.gauge(
+            "pt_serve_queue_depth", "requests waiting for a slot", L)
+        self._queue_peak = reg.gauge(
+            "pt_serve_queue_depth_peak", "peak queue depth this window",
+            L)
+        self._occ = reg.gauge(
+            "pt_serve_batch_occupancy", "active slots / max_slots", L)
+        self._occ_peak = reg.gauge(
+            "pt_serve_batch_occupancy_peak",
+            "peak occupancy this window", L)
+        self._kv = reg.gauge(
+            "pt_serve_kv_pool_utilization",
+            "KV pool occupancy (pages or cache rows in use, 0-1)", L)
+        self._kv_peak = reg.gauge(
+            "pt_serve_kv_pool_utilization_peak",
+            "peak KV pool occupancy this window", L)
+        self._kv_used = reg.gauge(
+            "pt_serve_kv_pool_used", "KV pool units in use",
+            ("engine", "unit"))
+        self._submitted = reg.counter(
+            "pt_serve_requests_submitted_total", "requests enqueued", L)
+        self._admitted = reg.counter(
+            "pt_serve_requests_admitted_total",
+            "requests given a decode slot", L)
+        self._finished = reg.counter(
+            "pt_serve_requests_finished_total", "requests completed", L)
+        self._tokens = reg.counter(
+            "pt_serve_tokens_generated_total", "output tokens produced",
+            L)
+    def _lab(self) -> dict:
+        return {"engine": self.engine_id}
+
+    # ---------------- hooks ----------------
+    def on_submit(self, queue_depth: int):
+        self._submitted.inc(**self._lab())
+        self._note_queue(queue_depth)
+
+    def on_admit(self, ttft_ms: Optional[float]):
+        lab = self._lab()
+        self._admitted.inc(**lab)
+        self._tokens.inc(**lab)  # prefill samples the first output token
+        if ttft_ms is not None:
+            self._ttft.observe(ttft_ms, **lab)
+
+    def on_finish(self):
+        self._finished.inc(**self._lab())
+
+    def on_tokens(self, n_tokens: int, wall_ms: float):
+        if n_tokens <= 0:
+            return
+        lab = self._lab()
+        self._tokens.inc(n_tokens, **lab)
+        self._tpot.observe(wall_ms / n_tokens, **lab)
+
+    def _note_queue(self, depth: int):
+        lab = self._lab()
+        self._queue.set(depth, **lab)
+        self._queue_peak.set_max(depth, **lab)
+
+    def on_state(self, queue_depth: int, occupancy: float,
+                 kv_used: float, kv_total: float):
+        lab = self._lab()
+        self._note_queue(queue_depth)
+        self._occ.set(occupancy, **lab)
+        self._occ_peak.set_max(occupancy, **lab)
+        self._kv_used.set(kv_used, unit="used", **lab)
+        self._kv_used.set(kv_total, unit="total", **lab)
+        util = kv_used / kv_total if kv_total else 0.0
+        self._kv.set(util, **lab)
+        self._kv_peak.set_max(util, **lab)
+
+    # ---------------- read side ----------------
+    def snapshot(self) -> dict:
+        lab = self._lab()
+        return {
+            "engine": self.engine_id,
+            "ttft_ms": {
+                "p50": self._ttft.percentile(50, **lab),
+                "p90": self._ttft.percentile(90, **lab),
+                "p99": self._ttft.percentile(99, **lab),
+                "count": self._ttft.window_len(**lab),
+            },
+            "tpot_ms": {
+                "p50": self._tpot.percentile(50, **lab),
+                "p90": self._tpot.percentile(90, **lab),
+            },
+            "queue_depth": {
+                "current": self._queue.value(**lab),
+                "peak": self._queue_peak.value(**lab),
+            },
+            "batch_occupancy": {
+                "current": self._occ.value(**lab),
+                "peak": self._occ_peak.value(**lab),
+            },
+            "kv_pool": {
+                "used": self._kv_used.value(unit="used", **lab),
+                "total": self._kv_used.value(unit="total", **lab),
+                "utilization": self._kv.value(**lab),
+                "peak_utilization": self._kv_peak.value(**lab),
+            },
+            "requests": {
+                "submitted": self._submitted.value(**lab),
+                "admitted": self._admitted.value(**lab),
+                "finished": self._finished.value(**lab),
+            },
+            "tokens_generated": self._tokens.value(**lab),
+        }
+
+    def window_reset(self):
+        """Clear percentile windows + this engine's peaks (cumulative
+        counters and the Prometheus bucket totals keep running)."""
+        lab = self._lab()
+        self._ttft.reset_window(**lab)
+        self._tpot.reset_window(**lab)
+        self._queue_peak.set(0, **lab)
+        self._occ_peak.set(0.0, **lab)
+        self._kv_peak.set(0.0, **lab)

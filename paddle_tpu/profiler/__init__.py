@@ -107,6 +107,12 @@ class Profiler:
         self.log_dir = log_dir
         self.timer_only = timer_only
         self.on_trace_ready = on_trace_ready
+        # an export_chrome_tracing callback names the dir the trace must
+        # land in — repoint BEFORE any start_trace, not after the trace
+        # was already written to the old dir
+        export_dir = getattr(on_trace_ready, "_export_dir", None)
+        if export_dir:
+            self.log_dir = export_dir
         self.step_num = 0
         self._tracing = False
         self._records: List[_StepRecord] = []
@@ -119,6 +125,14 @@ class Profiler:
         return self
 
     def stop(self):
+        # record the final in-flight step: a run that ends between
+        # step() calls would otherwise drop its last step from summary()
+        if self._t0 is not None:
+            self._records.append(
+                _StepRecord(self.step_num,
+                            (time.perf_counter() - self._t0) * 1e3)
+            )
+            self._t0 = None
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
@@ -192,9 +206,13 @@ class Profiler:
 
 def export_chrome_tracing(dir_name: str):
     """Parity helper: the XLA trace is already perfetto/chrome-compatible;
-    returns an on_trace_ready callback recording the export dir."""
+    returns an on_trace_ready callback carrying the export dir. The
+    ``_export_dir`` attribute lets Profiler repoint ``log_dir`` BEFORE
+    ``start_trace`` (mutating it afterwards left the trace stranded in
+    the old dir)."""
 
     def cb(prof: Profiler):
         prof.log_dir = dir_name
 
+    cb._export_dir = dir_name
     return cb

@@ -14,12 +14,14 @@ in-place in HBM (parity: in-place fused adamw).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observability
 from ..core.functional import extract_param_objs, functional_call
 from ..core.module import Layer
 from ..distributed.sharding import (
@@ -30,6 +32,22 @@ from ..distributed.sharding import (
 )
 from ..distributed.strategy import DistributedStrategy
 from ..optimizer.optimizer import Optimizer
+
+
+def _batch_tokens(batch) -> int:
+    """Telemetry unit count: tokens for LM batches (first integer 2-D
+    leaf), else the leading batch dim (samples)."""
+    sample = 0
+    for v in batch.values():
+        if not hasattr(v, "ndim") or v.ndim == 0:
+            continue
+        if not sample:
+            sample = int(v.shape[0])
+        dt = getattr(v, "dtype", None)
+        if v.ndim == 2 and dt is not None and \
+                jnp.issubdtype(dt, jnp.integer):
+            return int(v.shape[0]) * int(v.shape[1])
+    return sample
 
 
 def _param_shardings(param_objs, mesh, strategy):
@@ -89,6 +107,7 @@ class TrainStep:
         rng_seed: int = 0,
         abstract: bool = False,
         master_residency: str = "paired",
+        telemetry=None,
     ):
         """``abstract=True`` builds the full sharded step WITHOUT
         materializing parameters or optimizer state — params may be
@@ -108,7 +127,17 @@ class TrainStep:
         itemsize(model_dtype) bytes/param — ~1.75 GB on the 876M
         headline — which is what buys the larger batch (parity intent:
         fleet GroupShardedOptimizerStage2 master-weight handling, which
-        likewise keeps one authoritative fp32 copy)."""
+        likewise keeps one authoritative fp32 copy).
+
+        ``telemetry``: ``None`` (default) auto-wires an
+        ``observability.TrainTelemetry`` when ``PT_FLAGS_telemetry`` is
+        on; ``False`` disables instrumentation for this step; or pass a
+        preconfigured ``TrainTelemetry`` (custom sampling cadence /
+        flight-recorder window). When enabled, the compiled step also
+        emits the global gradient norm — sampled steps publish loss /
+        grad-norm / tokens-per-sec / MFU / memory through the registry
+        and feed the flight recorder + NaN watchdog; non-sampled steps
+        never force an extra host sync."""
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -191,6 +220,25 @@ class TrainStep:
         self.step_count = 0
         self._rng_key = jax.random.PRNGKey(rng_seed)
 
+        # telemetry: the grad-norm output is baked into the compiled
+        # step only when instrumentation is live, so telemetry-off
+        # compiles the exact pre-telemetry program (zero overhead).
+        # abstract mode keeps the same program shape (AOT memory plans
+        # must match what a real run would compile) but holds no
+        # telemetry object.
+        want_tel = (observability.enabled() if telemetry is None
+                    else bool(telemetry))
+        emit_gnorm = want_tel
+        self._emit_gnorm = emit_gnorm
+        self.telemetry = None
+        if want_tel and not abstract:
+            self.telemetry = (
+                telemetry
+                if isinstance(telemetry, observability.TrainTelemetry)
+                else observability.TrainTelemetry())
+        self._flops_per_step = None
+        self._flops_probed = False
+
         model_ref = model
         loss_ref = loss_fn
         merge_k = (self.strategy.gradient_merge_k_steps
@@ -264,15 +312,28 @@ class TrainStep:
                 grads = jax.tree_util.tree_map(
                     lambda a: a / merge_k, acc)
                 loss = loss_sum / merge_k
+            if emit_gnorm:
+                # pre-clip global grad norm, fp32 accumulation — a
+                # single reduction pass, negligible next to fwd+bwd
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
             new_params, new_state = optimizer.update(grads, opt_state, params)
             if master_dtypes:
                 # the low-precision copies are not carried: drop them so
                 # XLA dead-code-eliminates the cast-back
                 new_params = {n: v for n, v in new_params.items()
                               if n not in master_dtypes}
+            if emit_gnorm:
+                return new_params, new_state, loss, gnorm
             return new_params, new_state, loss
 
         donate_argnums = (0, 1) if donate else ()
+        repl = NamedSharding(mesh, P())
+        out_shardings = (carried_param_shardings, self.state_shardings,
+                         repl)
+        if emit_gnorm:
+            out_shardings = out_shardings + (repl,)
         self._step = jax.jit(
             step_fn,
             in_shardings=(
@@ -281,11 +342,7 @@ class TrainStep:
                 None,  # batch shardings resolve from committed inputs
                 NamedSharding(mesh, P()),
             ),
-            out_shardings=(
-                carried_param_shardings,
-                self.state_shardings,
-                NamedSharding(mesh, P()),
-            ),
+            out_shardings=out_shardings,
             donate_argnums=donate_argnums,
         )
 
@@ -335,14 +392,30 @@ class TrainStep:
                 "TrainStep(abstract=True) holds no real parameters; "
                 "use lower() for AOT compilation, or rebuild without "
                 "abstract for execution")
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
         if not sharded:
             batch = self.shard_batch(batch)
         self._rng_key, sub = jax.random.split(self._rng_key)
+        gnorm = None
         with mesh_context(self.mesh):
-            self.params, self.opt_state, loss = self._step(
-                self.params, self.opt_state, batch, sub
-            )
+            if self._emit_gnorm:
+                self.params, self.opt_state, loss, gnorm = self._step(
+                    self.params, self.opt_state, batch, sub
+                )
+            else:
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, batch, sub
+                )
         self.step_count += 1
+        if tel is not None:
+            # loss/gnorm stay async device futures unless this is a
+            # sampled step (TrainTelemetry fetches them only then)
+            tel.on_step(
+                self.step_count, loss, gnorm,
+                tokens=_batch_tokens(batch),
+                wall_s=time.perf_counter() - t0,
+                flops_getter=lambda: self._cost_flops(batch, sub))
         if not self._master_dtypes:
             self.sync_to_model()
         else:
@@ -356,6 +429,26 @@ class TrainStep:
         if self.optimizer._lr_scheduler is not None:
             self.optimizer._lr_scheduler.step()
         return loss
+
+    def _cost_flops(self, batch, rng):
+        """Per-step FLOPs from XLA cost analysis, probed once (the
+        lowering retrace + compile-cache hit costs one sampled step,
+        never the steady loop); None when the backend can't say."""
+        if self._flops_probed:
+            return self._flops_per_step
+        self._flops_probed = True
+        try:
+            with mesh_context(self.mesh):
+                ca = self._step.lower(
+                    self.params, self.opt_state, batch, rng
+                ).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            f = (ca or {}).get("flops")
+            self._flops_per_step = float(f) if f and f > 0 else None
+        except Exception:
+            self._flops_per_step = None
+        return self._flops_per_step
 
     def _materialized_params(self):
         """Full param dict at model dtype; in master_only mode the

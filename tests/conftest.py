@@ -9,6 +9,13 @@ overridden through jax.config *before first backend use*, not via env.
 
 import os
 
+# telemetry defaults ON for real runs, but the suite's hundreds of
+# tiny-model TrainStep compilations would each pay the instrumented
+# step's extra grad-norm output for no assertion value — keep the CI
+# session un-instrumented; tests/test_observability.py flips the flag
+# on (set_flags) for the paths that actually assert on telemetry
+os.environ.setdefault("PT_FLAGS_telemetry", "off")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -41,10 +41,30 @@ def test_profiler_timer_only():
 
     p = Profiler(timer_only=True)
     p.start()
-    for _ in range(3):
+    for _ in range(2):
         p.step()
+    # stop() records the final in-flight step (work since the last
+    # step() call would otherwise vanish from summary())
     p.stop()
     assert "steps: 3" in p.summary()
+    p.stop()  # idempotent: no double-record
+    assert "steps: 3" in p.summary()
+
+
+def test_export_chrome_tracing_repoints_before_start(tmp_path):
+    from paddle_tpu.profiler import Profiler, export_chrome_tracing
+
+    target = str(tmp_path / "chrome_out")
+    cb = export_chrome_tracing(target)
+    p = Profiler(log_dir=str(tmp_path / "default"), timer_only=True,
+                 on_trace_ready=cb)
+    # the export dir must be in effect BEFORE any start_trace, not
+    # swapped in by the callback after the trace was already written
+    assert p.log_dir == target
+    p.start()
+    p.step()
+    p.stop()
+    assert p.log_dir == target
 
 
 def test_metrics():

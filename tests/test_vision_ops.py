@@ -393,6 +393,31 @@ class TestLayoutPolicy:
         rng = np.random.default_rng(seed)
         return jnp.asarray(rng.standard_normal(shape), jnp.float32)
 
+    def test_scope_recursion_ops_no_infinite_resolve(self):
+        """Ops whose NHWC branch transposes and recurses into their own
+        NCHW implementation must suspend scope resolution — declared
+        NCHW inside channels_last_scope used to re-resolve to NHWC on
+        every recursive call (RecursionError)."""
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.nn import layout
+
+        x_nchw = jnp.moveaxis(self._x((2, 6, 8, 4)), -1, 1)  # [2,4,6,8]
+        x_nhwc = jnp.transpose(x_nchw, (0, 2, 3, 1))
+        want_interp = F.interpolate(x_nchw, scale_factor=2,
+                                    mode="bilinear")
+        want_amp = F.adaptive_max_pool2d(x_nchw, 2)
+        with layout.channels_last_scope():
+            got_interp = F.interpolate(x_nhwc, scale_factor=2,
+                                       mode="bilinear")
+            got_amp = F.adaptive_max_pool2d(x_nhwc, 2)
+        np.testing.assert_allclose(
+            np.asarray(want_interp),
+            np.asarray(jnp.transpose(got_interp, (0, 3, 1, 2))),
+            rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(want_amp),
+            np.asarray(jnp.transpose(got_amp, (0, 3, 1, 2))))
+
     def test_conv2d_layout_roundtrip_bitexact(self):
         from paddle_tpu.nn import functional as F
 
