@@ -552,6 +552,44 @@ def _build_7b_int8(cfg, group_size=128, seed=0, weight_dtype="int8"):
     return model
 
 
+def _decode_attn_roofline(mcfg, ecfg, steady_len, cache_bytes):
+    """Analytic HBM roofline for the decode-attention stage at this
+    bench's steady state (mirrors ``_unet_groupnorm_roofline``): every
+    layer's RoPE + KV-append + attention priced through the kernelbench
+    traffic model, fused vs unfused, at the mid-measurement sequence
+    length. Decode attention is bandwidth-bound, so bytes over peak HBM
+    bandwidth is its floor device time per step; comparing against the
+    measured chunk time says how much of the step the KV stream is."""
+    from benchmarks.devtime import peak_hbm_bandwidth
+    from benchmarks.kernelbench import decode_hbm_bytes
+
+    lens = [steady_len] * ecfg.max_slots
+    kvh = mcfg.num_key_value_heads
+    group = mcfg.num_attention_heads // kvh
+    kw = (dict(page_size=ecfg.page_size) if ecfg.paged
+          else dict(max_len=ecfg.max_len))
+    mode = "paged" if ecfg.paged else "contiguous"
+    act_bytes = 2 if mcfg.dtype == "bfloat16" else 4
+    fused = mcfg.num_hidden_layers * decode_hbm_bytes(
+        mode, True, lens, kvh, group, mcfg.head_dim,
+        cache_bytes=cache_bytes, act_bytes=act_bytes, **kw)
+    unfused = mcfg.num_hidden_layers * decode_hbm_bytes(
+        mode, False, lens, kvh, group, mcfg.head_dim,
+        cache_bytes=cache_bytes, act_bytes=act_bytes, **kw)
+    bw = peak_hbm_bandwidth(jax.devices()[0])
+    return {
+        "steady_seq_len": steady_len,
+        "fused_hbm_bytes_per_step": fused,
+        "unfused_hbm_bytes_per_step": unfused,
+        "fused_roofline_ms": round(fused / bw * 1e3, 3),
+        "unfused_roofline_ms": round(unfused / bw * 1e3, 3),
+        "peak_hbm_gbps": round(bw / 1e9, 1),
+        "assumes": "per-layer rope+append+attention traffic "
+                   "(benchmarks/kernelbench.decode_hbm_bytes); "
+                   "PT_FLAGS_fused_decode picks the fused row on TPU",
+    }
+
+
 def bench_serve7b(tpu_diags):
     """7B-class int8 weight-only decode through the paged continuous-
     batching engine — the first production-scale silicon path (VERDICT
@@ -648,6 +686,9 @@ def bench_serve7b(tpu_diags):
 
     extra = {
         "params": n_params,
+        "decode_attn_roofline": _decode_attn_roofline(
+            cfg, ecfg, prompt_len + measure_tokens // 2,
+            2 if cache_dtype == jnp.bfloat16 else 4),
         "qweight_hbm_bytes": n_linear,
         "dense_params": n_dense,
         "weight_dtype": wdtype,

@@ -91,6 +91,15 @@ define_flag("rng_use_global_seed", True,
             "derive eager rng stream from the global seed")
 define_flag("fused_group_norm", True,
             "dispatch NHWC GroupNorm to the fused Pallas kernel")
+define_flag("fused_decode", "auto",
+            "fused single-pass decode attention (in-kernel RoPE + KV "
+            "append + length-pruned streaming): auto = compiled kernel "
+            "on TPU when shapes tile, lax reference elsewhere; "
+            "on = force (Pallas interpret mode off-TPU); off = unfused")
+define_flag("kv_cache_dtype", "auto",
+            "serving KV-cache dtype when EngineConfig.cache_dtype is "
+            "'auto': auto = bfloat16 on TPU (halves decode KV traffic), "
+            "float32 elsewhere; or explicit bfloat16|float16|float32")
 define_flag("flash_attention_block_q", 256, "Pallas flash attn q block")
 define_flag("flash_attention_block_k", 256, "Pallas flash attn k block")
 define_flag("moe_capacity_factor", 1.25, "default MoE capacity factor")

@@ -100,8 +100,10 @@ def _use_pallas_decode(cache: PagedLayerCache) -> bool:
 
     import jax as _jax
 
+    from ..kernels.decode_attention import decode_tiles_ok
+
     page_size, d = cache.k_pages.shape[2], cache.k_pages.shape[3]
-    aligned = d % 128 == 0 and page_size % 16 == 0
+    aligned = decode_tiles_ok(d, page_size)
     if os.environ.get("PADDLE_TPU_FORCE_PALLAS"):
         return aligned
     return aligned and _jax.default_backend() == "tpu"
@@ -132,6 +134,15 @@ def paged_attention(q, cache: PagedLayerCache, state: PagedState,
             state.seq_lens, scale=scale,
         )
         return out.reshape(slots, 1, h, d)
+    return dense_paged_attention(q, cache, state, scale=scale)
+
+
+def dense_paged_attention(q, cache: PagedLayerCache, state: PagedState,
+                          scale=None):
+    """Dense-gather decode fallback (and the kernels' numeric reference):
+    materializes each slot's full [max_ctx] view and masks — the
+    slots × max_len traffic the Pallas paths avoid."""
+    slots, one, h, d = q.shape
     k, v = gather_kv(cache, state)  # [slots, ctx, kvh, d]
     ctx = k.shape[1]
     kvh = k.shape[2]

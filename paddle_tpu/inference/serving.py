@@ -15,7 +15,9 @@ ongoing decode — the essence of continuous batching.
 
 from __future__ import annotations
 
+import bisect
 import collections
+import heapq
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -24,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import observability
+from .. import flags, observability
 from ..core.functional import (
     extract_buffers,
     extract_params,
@@ -42,10 +44,39 @@ class EngineConfig:
     paged: bool = False
     page_size: int = 64
     n_pages: Optional[int] = None  # default: slots*max_len/page_size (+sink)
-    cache_dtype: object = jnp.float32
+    # "auto" resolves through PT_FLAGS_kv_cache_dtype: bf16 on TPU
+    # (halves decode KV traffic), fp32 elsewhere; explicit dtypes win
+    cache_dtype: object = "auto"
     greedy: bool = True
     temperature: float = 1.0
     seed: int = 0
+
+
+def _resolve_cache_dtype(requested):
+    """EngineConfig.cache_dtype → concrete dtype. ``"auto"`` defers to
+    the ``PT_FLAGS_kv_cache_dtype`` flag (auto = bfloat16 on TPU,
+    float32 elsewhere — decode is KV-bandwidth-bound, so the cache
+    dtype IS the decode traffic); explicit dtypes pass through."""
+    named = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+             "float16": jnp.float16, "fp16": jnp.float16,
+             "float32": jnp.float32, "fp32": jnp.float32}
+
+    def lookup(val, origin):
+        if val not in named:
+            raise ValueError(
+                f"{origin} must be 'auto' or one of {sorted(named)}; "
+                f"got {val!r}")
+        return named[val]
+
+    if isinstance(requested, str) and requested != "auto":
+        return lookup(requested, "EngineConfig.cache_dtype")
+    if requested not in (None, "auto"):
+        return requested
+    val = str(flags.flag("kv_cache_dtype")).lower()
+    if val == "auto":
+        return (jnp.bfloat16 if jax.default_backend() == "tpu"
+                else jnp.float32)
+    return lookup(val, "PT_FLAGS_kv_cache_dtype")
 
 
 @dataclass
@@ -133,9 +164,17 @@ class ContinuousBatchingEngine:
         self._pb = {"p": self.params, "b": self.buffers}
         cfg = self.cfg
 
+        self.cache_dtype = _resolve_cache_dtype(cfg.cache_dtype)
         self.seq_lens = np.zeros((cfg.max_slots,), np.int64)
         self.active = np.zeros((cfg.max_slots,), bool)
         self.last_tok = np.zeros((cfg.max_slots,), np.int64)
+        # O(log slots) admission bookkeeping: a min-heap of free slots
+        # (lowest index first, matching the old scan's choice) and a
+        # sorted bucket table for bisect lookup — _admit_dispatch used
+        # to rescan all slots twice and all buckets per queued request
+        self._free_heap = list(range(cfg.max_slots))
+        self._buckets = sorted(
+            {min(b, cfg.max_len) for b in cfg.seq_buckets})
         self._slot_req: Dict[int, Request] = {}
         self._queue: collections.deque = collections.deque()
         self._next_rid = 0
@@ -162,7 +201,7 @@ class ContinuousBatchingEngine:
                                  max_pages_per_slot, reserve_sink=True)
             self.layer_caches = init_paged_pool(
                 self._n_layers, n_pages, cfg.page_size, kvh, hd,
-                dtype=cfg.cache_dtype)
+                dtype=self.cache_dtype)
             if mesh is not None:
                 self.layer_caches = [
                     PagedLayerCache(self._shard_kv(c.k_pages, axis=0),
@@ -171,7 +210,7 @@ class ContinuousBatchingEngine:
         else:
             self.pool = None
             self.caches = model.init_kv_caches(
-                cfg.max_slots, cfg.max_len, dtype=cfg.cache_dtype)
+                cfg.max_slots, cfg.max_len, dtype=self.cache_dtype)
             if mesh is not None:
                 self.caches = [
                     (self._shard_kv(k), self._shard_kv(v))
@@ -224,14 +263,13 @@ class ContinuousBatchingEngine:
         return req.rid
 
     def _free_slots(self) -> List[int]:
-        return [i for i in range(self.cfg.max_slots) if not self.active[i]]
+        return sorted(self._free_heap)
 
     # ---------------- compiled programs ----------------
     def _bucket(self, n: int) -> int:
-        for b in self.cfg.seq_buckets:
-            if n <= b:
-                return min(b, self.cfg.max_len)
-        return self.cfg.max_len
+        i = bisect.bisect_left(self._buckets, n)
+        return self._buckets[i] if i < len(self._buckets) \
+            else self.cfg.max_len
 
     def _prefill(self):
         # one jitted fn serves every bucket: jit specializes per shape.
@@ -393,9 +431,9 @@ class ContinuousBatchingEngine:
         pending (req, slot, first_token_future) list for
         ``_admit_integrate``."""
         pending = []
-        while self._queue and self._free_slots():
+        while self._queue and self._free_heap:
             req = self._queue[0]
-            slot = self._free_slots()[0]
+            slot = self._free_heap[0]  # peek; claimed only on success
             n = req.prompt.size
             # paged: allocate for the full prefill bucket too — the
             # prefill scatter writes bucket//page_size whole pages, and
@@ -411,23 +449,40 @@ class ContinuousBatchingEngine:
                         "request running — size n_pages up")
                 break  # pool exhausted: wait for a finisher
             self._queue.popleft()
-            bucket = self._bucket(n)
-            padded = np.zeros((1, bucket), np.int64)
-            padded[0, :n] = req.prompt
-            one_caches = self.model.init_kv_caches(
-                1, bucket, dtype=self.cfg.cache_dtype)
-            self._key, sub = jax.random.split(self._key)
-            with self._ctx():
-                first_dev, filled = self._prefill()(
-                    self._pb, jnp.asarray(padded, jnp.int32), one_caches,
-                    n - 1, sub)
-                if self.cfg.paged:
-                    self.layer_caches = self._scatter_paged()(
-                        self.layer_caches, filled,
-                        jnp.asarray(self.pool.block_tables[slot]))
-                else:
-                    self.caches = self._insert_contig()(
-                        self.caches, filled, slot)
+            heapq.heappop(self._free_heap)
+            try:
+                bucket = self._bucket(n)
+                padded = np.zeros((1, bucket), np.int64)
+                padded[0, :n] = req.prompt
+                one_caches = self.model.init_kv_caches(
+                    1, bucket, dtype=self.cache_dtype)
+                self._key, sub = jax.random.split(self._key)
+                with self._ctx():
+                    first_dev, filled = self._prefill()(
+                        self._pb, jnp.asarray(padded, jnp.int32),
+                        one_caches, n - 1, sub)
+                    if self.cfg.paged:
+                        self.layer_caches = self._scatter_paged()(
+                            self.layer_caches, filled,
+                            jnp.asarray(self.pool.block_tables[slot]))
+                    else:
+                        self.caches = self._insert_contig()(
+                            self.caches, filled, slot)
+            except BaseException:
+                # the heap no longer self-heals from the active mask:
+                # give the claimed slot (and its pages) back AND requeue
+                # the request before propagating, or a caught admission
+                # error would shrink the engine by one slot forever and
+                # strand the request's rid incomplete. Requests admitted
+                # EARLIER in this call are already active — integrate
+                # them now (lengths/first tokens) so a caller that
+                # catches the error doesn't decode them from seq_len 0
+                heapq.heappush(self._free_heap, slot)
+                if self.pool is not None:
+                    self.pool.free(slot)
+                self._queue.appendleft(req)
+                self._admit_integrate(pending)
+                raise
             # mark the slot taken now so the next iteration can't hand
             # it out again; lengths/last_tok land at integrate
             self.active[slot] = True
@@ -464,6 +519,7 @@ class ContinuousBatchingEngine:
             self._finished[req.rid] = req
             self.active[slot] = False
             self.seq_lens[slot] = 0
+            heapq.heappush(self._free_heap, slot)
             del self._slot_req[slot]
             if self.pool is not None:
                 self.pool.free(slot)

@@ -1,0 +1,336 @@
+"""Fused single-pass decode attention for CONTIGUOUS per-slot KV caches
+(+ the dispatch gate and lax reference paths shared with the paged
+variant in ``paged_attention.py``).
+
+Parity: phi ``masked_multihead_attention`` — the reference's single
+fused decode op that rotates the new token, writes it into the cache and
+attends, all in one kernel. The engine's default contiguous mode
+previously paid three HBM round-trips per decoder layer per token
+(RoPE materializes rotated q/k, the per-slot scatter writes K/V, dense
+masked SDPA then re-reads ``[slots, max_len]`` including every padding
+row); this kernel does all three in one pass with LENGTH-PRUNED
+streaming — per-step traffic ∝ Σ ceil(len_i/chunk)·chunk, the same
+``Σ seq_lens`` scaling the paged kernel already has, instead of
+``slots × max_len``.
+
+Structure (mirrors kernels/paged_attention.py):
+  - the cache rides as ``[slots, max_len, kvh*d]`` (a free reshape of
+    the engine's ``[slots, max_len, kvh, d]`` layout): the per-grid-step
+    block is one slot's ``chunk`` rows with minor dims
+    ``(chunk, kvh*d)`` — full tiled minor dims, no head-strided DMA —
+    and all kv heads stream in one fetch, with a static per-head loop
+    inside the kernel;
+  - grid = (slots, n_chunks), chunks innermost; chunks past a slot's
+    length are pruned (index map clamps → no DMA, pl.when skips
+    compute);
+  - RoPE is applied in-kernel from scalar-prefetched positions (the
+    cos/sin table row is the block index — one row read per slot);
+  - the new token's K/V is merged into the streamed chunk in VMEM and
+    written back as ONE aliased row (``input_output_aliases``), so the
+    token never round-trips through HBM before attention reads it and
+    the separate append scatter disappears from the decode trace.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import flags
+from ..jax_compat import tpu_compiler_params
+from .paged_attention import (
+    NEG_INF,
+    _interpret,
+    kernel_rope_rot,
+    online_softmax_update,
+)
+
+
+def contiguous_chunk(max_len: int) -> int:
+    """Streaming granularity over the [slots, max_len] cache rows:
+    gcd(max_len, 128) — i.e. the largest power-of-two divisor of
+    max_len capped at 128 — keeps blocks tile-aligned without
+    constraining the engine's max_len choice."""
+    return math.gcd(max_len, 128)
+
+
+def decode_tiles_ok(head_dim: int, minor: int) -> bool:
+    """THE tiling rule for every Pallas decode kernel (block-table and
+    fused, both cache modes — ``inference.paged._use_pallas_decode``
+    shares it): d fills the lane dim, and ``minor`` (page_size or the
+    contiguous chunk) respects the bf16 sublane tile, so one rule
+    covers both pool dtypes."""
+    return head_dim % 128 == 0 and minor % 16 == 0
+
+
+def fused_decode_active(head_dim: int, minor: int) -> bool:
+    """Gate for the fused decode kernels (PT_FLAGS_fused_decode).
+
+    ``minor``: page_size (paged mode) or the contiguous chunk length —
+    the streamed block's sublane dim. auto = compiled kernel on TPU when
+    the block tiles (``decode_tiles_ok``); the lax reference elsewhere.
+    ``on`` forces the kernel (Pallas interpret mode off-TPU — how the
+    tier-1 parity tests run it); ``off`` forces the reference path.
+    """
+    val = str(flags.flag("fused_decode")).lower()
+    if val in ("off", "0", "false", "no"):
+        return False
+    if jax.default_backend() != "tpu":
+        return val in ("on", "1", "true", "yes")
+    if val in ("on", "1", "true", "yes"):
+        return True
+    return decode_tiles_ok(head_dim, minor)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel — contiguous per-slot caches
+# ---------------------------------------------------------------------------
+def _fused_contig_kernel(lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
+                         k_ref, v_ref, cos_ref, sin_ref,
+                         o_ref, ko_ref, vo_ref,
+                         q_scratch, m_scratch, l_scratch, acc_scratch,
+                         *, scale, chunk, n_chunks, kvh, d):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    seq_len = lens_ref[s]  # position of THIS token (== tokens cached)
+    last_chunk = seq_len // chunk
+    offs = seq_len % chunk
+
+    cos = cos_ref[...].astype(jnp.float32)  # [1, d/2] row at pos_ref[s]
+    sin = sin_ref[...].astype(jnp.float32)
+
+    def rot(x):
+        return kernel_rope_rot(x, cos, sin)
+
+    # rotated new-token K for all heads, flattened to the cache row
+    # layout [1, kvh*d]; written back as ONE aliased row per slot.
+    # Attention merges the CACHE-DTYPE-ROUNDED values — same rounding
+    # the unfused path's appended row gets — so bf16 caches cannot
+    # flip a greedy argmax between the fused and unfused engines
+    k_store = rot(kn_ref[...].astype(jnp.float32)) \
+        .reshape(1, kvh * d).astype(ko_ref.dtype)
+    v_store = vn_ref[...].reshape(1, kvh * d).astype(vo_ref.dtype)
+    ko_ref[...] = k_store
+    vo_ref[...] = v_store
+    k_new = k_store.astype(jnp.float32)
+    v_new = v_store.astype(jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+        q_scratch[:] = rot(q_ref[...].astype(jnp.float32))
+
+    @pl.when(j <= last_chunk)
+    def _step():
+        is_last = j == last_chunk
+        row = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)
+        sel = (row == offs) & is_last
+        # merge the new token into the streamed chunk in VMEM
+        k_blk = jnp.where(sel, k_new, k_ref[...].astype(jnp.float32))
+        v_blk = jnp.where(sel, v_new, v_ref[...].astype(jnp.float32))
+        valid = (j * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk), 1)) <= seq_len  # [1, chunk]
+        for h in range(kvh):  # static unroll; all heads share the fetch
+            kh = k_blk[:, h * d:(h + 1) * d]  # [chunk, d]
+            vh = v_blk[:, h * d:(h + 1) * d]
+            q = q_scratch[h]  # [group_pad, d] rotated f32
+            sc = jax.lax.dot_general(
+                q, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [group_pad, chunk]
+            sc = jnp.where(valid, sc, NEG_INF)
+            m_new, l_new, acc = online_softmax_update(
+                sc, vh, m_scratch[h, :, :1], l_scratch[h, :, :1],
+                acc_scratch[h])
+            acc_scratch[h] = acc
+            m_scratch[h] = jnp.broadcast_to(m_new, m_scratch.shape[1:])
+            l_scratch[h] = jnp.broadcast_to(l_new, l_scratch.shape[1:])
+
+    @pl.when(j == n_chunks - 1)
+    def _fin():
+        for h in range(kvh):
+            l = l_scratch[h, :, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = (acc_scratch[h] / l).astype(o_ref.dtype)
+
+
+def fused_contiguous_decode_attention(q, k_new, v_new, ck, cv, seq_lens,
+                                      positions, cos, sin, scale=None):
+    """Single-pass decode over the engine's contiguous per-slot caches:
+    RoPE(q, k_new) + write (k_new, v_new) at each slot's current length
+    + length-pruned online-softmax attention, one kernel per layer.
+
+    q: [slots, kv_heads, group, d] UNROTATED; k_new/v_new:
+    [slots, kv_heads, d]. ck/cv: [slots, max_len, kv_heads, d] — ALIASED
+    into the outputs (donate under jit). seq_lens: [slots] int32 tokens
+    already cached; slot i attends to [0, seq_lens[i]] inclusive of the
+    appended token. positions: [slots] int32 RoPE positions. cos/sin:
+    [max_pos, d//2].
+
+    PRECONDITION (unchecked — indices are traced): seq_lens[i] <
+    max_len (the cache has room for the appended row; Pallas CLAMPS
+    out-of-range block indices, so violating this silently overwrites
+    the last cached row) and positions[i] < cos.shape[0]. The serving
+    engine guarantees both (add_request length check + _maybe_finish).
+
+    Returns (out [slots, kv_heads, group, d], ck', cv').
+    """
+    slots, kvh, group, d = q.shape
+    max_len = ck.shape[1]
+    chunk = contiguous_chunk(max_len)
+    n_chunks = max_len // chunk
+    if scale is None:
+        scale = d ** -0.5
+
+    group_pad = max(8, -(-group // 8) * 8)
+    if group_pad != group:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, group_pad - group), (0, 0)))
+    k_new = k_new.reshape(slots, kvh, 1, d)
+    v_new = v_new.reshape(slots, kvh, 1, d)
+    # free layout view: one streamed block is (chunk, kvh*d) — full
+    # tiled minor dims; a head-minor 4D block would DMA sublane-strided
+    ck2 = ck.reshape(slots, max_len, kvh * d)
+    cv2 = cv.reshape(slots, max_len, kvh * d)
+    half = d // 2
+
+    def q_index(s, j, lens_ref, pos_ref):
+        return (s, 0, 0, 0)
+
+    def kv_index(s, j, lens_ref, pos_ref):
+        # clamp to the slot's last active chunk: pruned steps revisit
+        # the previous block, so no DMA is issued for them
+        return (s, jnp.minimum(j, lens_ref[s] // chunk), 0)
+
+    def rope_index(s, j, lens_ref, pos_ref):
+        return (pos_ref[s], 0)
+
+    def append_index(s, j, lens_ref, pos_ref):
+        return (s, lens_ref[s], 0)  # the new token's row, constant in j
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, n_chunks),
+        in_specs=[
+            pl.BlockSpec((None, kvh, group_pad, d),
+                         lambda s, j, l, p: (s, 0, 0, 0)),
+            pl.BlockSpec((None, kvh, 1, d),
+                         lambda s, j, l, p: (s, 0, 0, 0)),
+            pl.BlockSpec((None, kvh, 1, d),
+                         lambda s, j, l, p: (s, 0, 0, 0)),
+            pl.BlockSpec((None, chunk, kvh * d), kv_index),
+            pl.BlockSpec((None, chunk, kvh * d), kv_index),
+            pl.BlockSpec((1, half), rope_index),
+            pl.BlockSpec((1, half), rope_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, kvh, group_pad, d), q_index),
+            pl.BlockSpec((None, 1, kvh * d), append_index),
+            pl.BlockSpec((None, 1, kvh * d), append_index),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kvh, group_pad, d), jnp.float32),
+            pltpu.VMEM((kvh, group_pad, 128), jnp.float32),
+            pltpu.VMEM((kvh, group_pad, 128), jnp.float32),
+            pltpu.VMEM((kvh, group_pad, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _fused_contig_kernel, scale=scale, chunk=chunk,
+        n_chunks=n_chunks, kvh=kvh, d=d,
+    )
+    out, ck2, cv2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
+            jax.ShapeDtypeStruct(ck2.shape, ck2.dtype),
+            jax.ShapeDtypeStruct(cv2.shape, cv2.dtype),
+        ],
+        # operand order: 2 prefetch scalars, q, kn, vn, ck(5), cv(6),
+        # cos, sin — caches alias outputs 1/2 (in-place append)
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(seq_lens, jnp.int32),
+      jnp.asarray(positions, jnp.int32),
+      q, k_new, v_new, ck2, cv2, cos, sin)
+    return (out[:, :, :group, :],
+            ck2.reshape(slots, max_len, kvh, d),
+            cv2.reshape(slots, max_len, kvh, d))
+
+
+# ---------------------------------------------------------------------------
+# lax reference paths (numeric source of truth for parity tests)
+# ---------------------------------------------------------------------------
+def _rope_rotate(x, positions, cos, sin):
+    """x: [slots, heads, d] (one token per slot) → rotated via the
+    canonical ``kernels/rope.apply_rope`` (so the oracle can never
+    drift from the model path's rope convention)."""
+    from .rope import apply_rope
+
+    x4 = x[:, None]  # [slots, 1, heads, d]
+    out, _ = apply_rope(x4, x4, cos, sin, positions[:, None])
+    return out[:, 0]
+
+
+def fused_paged_decode_reference(q, k_new, v_new, k_pages, v_pages,
+                                 block_tables, seq_lens, positions,
+                                 cos, sin, scale=None):
+    """Unfused reference for ``fused_paged_decode_attention``: rope →
+    append_kv scatter → dense gathered attention (the pre-fusion decode
+    path, kept as the parity oracle)."""
+    from ..inference.paged import (
+        PagedLayerCache,
+        PagedState,
+        append_kv,
+        dense_paged_attention,
+    )
+
+    slots, kvh, group, d = q.shape
+    qr = _rope_rotate(q.reshape(slots, kvh * group, d), positions,
+                      cos, sin).reshape(slots, kvh, group, d)
+    kr = _rope_rotate(k_new, positions, cos, sin)
+    cache = PagedLayerCache(k_pages, v_pages)
+    state = PagedState(jnp.asarray(block_tables, jnp.int32),
+                       jnp.asarray(seq_lens, jnp.int32))
+    cache = append_kv(cache, state, kr[:, None], v_new[:, None])
+    out = dense_paged_attention(
+        qr.reshape(slots, 1, kvh * group, d), cache, state, scale=scale)
+    return (out[:, 0].reshape(slots, kvh, group, d),
+            cache.k_pages, cache.v_pages)
+
+
+def fused_contiguous_decode_reference(q, k_new, v_new, ck, cv, seq_lens,
+                                      positions, cos, sin, scale=None):
+    """Unfused reference for ``fused_contiguous_decode_attention``:
+    rope → per-slot scatter → dense masked attention over the full
+    [slots, max_len] cache (the pre-fusion contiguous decode path)."""
+    slots, kvh, group, d = q.shape
+    max_len = ck.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    qr = _rope_rotate(q.reshape(slots, kvh * group, d), positions,
+                      cos, sin).reshape(slots, kvh, group, d)
+    kr = _rope_rotate(k_new, positions, cos, sin)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    ck = ck.at[jnp.arange(slots), lens].set(kr.astype(ck.dtype))
+    cv = cv.at[jnp.arange(slots), lens].set(v_new.astype(cv.dtype))
+    k = jnp.repeat(ck.astype(jnp.float32), group, axis=2)
+    v = jnp.repeat(cv.astype(jnp.float32), group, axis=2)
+    qf = qr.reshape(slots, kvh * group, 1, d).astype(jnp.float32) * scale
+    s = jnp.einsum("shqd,skhd->shqk", qf, k)
+    mask = jnp.arange(max_len)[None, :] <= lens[:, None]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shqk,skhd->shqd", p, v)
+    return (out[:, :, 0].reshape(slots, kvh, group, d).astype(q.dtype),
+            ck, cv)

@@ -156,3 +156,217 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     )(jnp.asarray(block_tables, jnp.int32),
       jnp.asarray(seq_lens, jnp.int32), q, k_pages, v_pages)
     return out[:, :, :group, :]
+
+
+# ---------------------------------------------------------------------------
+# Fused single-pass decode: in-kernel RoPE + KV-append + attention
+# ---------------------------------------------------------------------------
+def kernel_rope_rot(x, cos, sin):
+    """In-kernel half-rotation (Neox/Llama convention, matching
+    kernels/rope.apply_rope): x [..., d] f32, cos/sin broadcastable
+    [..., d/2]. ONE definition shared by the paged and contiguous fused
+    kernels so the convention cannot drift between them."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
+def online_softmax_update(sc, v, m_prev, l_prev, acc_prev):
+    """One streaming-softmax step shared by the fused decode kernels:
+    fold scores ``sc`` [q, kblock] and values ``v`` [kblock, d] into the
+    running (m, l, acc); returns the updated triple (keepdims stats)."""
+    m_cur = jnp.max(sc, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(sc - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_prev * alpha + pv
+
+
+def _fused_decode_kernel(bt_ref, lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
+                         k_ref, v_ref, cos_ref, sin_ref,
+                         o_ref, ko_ref, vo_ref,
+                         q_scratch, m_scratch, l_scratch, acc_scratch,
+                         *, scale, page_size, max_pages, group_pad):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    seq_len = lens_ref[s]  # position of THIS token (== tokens cached)
+    last_page = seq_len // page_size
+    offs = seq_len % page_size
+
+    cos = cos_ref[...].astype(jnp.float32)  # [1, d/2] row at pos_ref[s]
+    sin = sin_ref[...].astype(jnp.float32)
+
+    def rot(x):
+        return kernel_rope_rot(x, cos, sin)
+
+    # rotated new-token K — also the row written back to the pool.
+    # The write-back block index is constant over j (the slot's current
+    # page + in-page row), so the single row is DMA'd once per (s, h):
+    # append traffic is 2 rows/slot/head, not a page rewrite, and the
+    # token never round-trips through HBM before attention reads it.
+    # Attention merges the CACHE-DTYPE-ROUNDED values (not the f32
+    # intermediates): the unfused path attends to the appended row
+    # as the pool stores it, and bf16 pools must not flip a greedy
+    # argmax between the fused and unfused engines
+    k_store = rot(kn_ref[0, 0].astype(jnp.float32)) \
+        .astype(ko_ref.dtype)  # [1, d]
+    v_store = vn_ref[0, 0].astype(vo_ref.dtype)
+    ko_ref[...] = k_store
+    vo_ref[...] = v_store
+    k_new = k_store.astype(jnp.float32)
+    v_new = v_store.astype(jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+        # RoPE q once per (s, h) into scratch (input-ref mutations don't
+        # persist across grid steps in interpret mode; scratch does)
+        q_scratch[:] = rot(q_ref[0, 0].astype(jnp.float32))
+
+    @pl.when(j <= last_page)
+    def _step():
+        q = q_scratch[...]  # [group_pad, d] rotated f32
+        is_last = j == last_page
+        row = jax.lax.broadcasted_iota(jnp.int32, (page_size, 1), 0)
+        sel = (row == offs) & is_last
+        # merge the new token into the streamed page IN VMEM: the HBM
+        # page still holds stale data at `offs`; attention must see the
+        # rotated k / raw v of the token being appended this step
+        k = jnp.where(sel, k_new, k_ref[...].astype(jnp.float32))
+        v = jnp.where(sel, v_new, v_ref[...].astype(jnp.float32))
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group_pad, page_size]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1
+        )
+        sc = jnp.where(pos <= seq_len, sc, NEG_INF)
+
+        m_new, l_new, acc = online_softmax_update(
+            sc, v, m_scratch[:, :1], l_scratch[:, :1], acc_scratch[:])
+        acc_scratch[:] = acc
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(j == max_pages - 1)
+    def _fin():
+        l = l_scratch[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
+                                 block_tables, seq_lens, positions,
+                                 cos, sin, scale=None):
+    """Single-pass decode: RoPE(q, k_new) + append (k_new, v_new) into
+    each slot's current page + length-pruned online-softmax attention,
+    one kernel per layer.
+
+    q: [slots, kv_heads, group, d] UNROTATED; k_new/v_new:
+    [slots, kv_heads, d] the new token's unrotated K / V per slot.
+    k_pages/v_pages: [kv_heads, n_pages, page_size, d] head-major pool
+    (see ``paged_decode_attention``); ALIASED into the outputs — under
+    jit the caller should donate them. seq_lens: [slots] int32, tokens
+    already cached (== the new token's in-slot position; slot i attends
+    to [0, seq_lens[i]] inclusive of the appended token). positions:
+    [slots] int32 RoPE positions (== seq_lens for the serving engine;
+    kept separate so callers with custom position_ids stay correct).
+    cos/sin: [max_pos, d//2] rope tables — the per-slot row is selected
+    by scalar-prefetched position, so rotation costs one table-row read
+    instead of a q/k materialization round-trip.
+
+    PRECONDITION (unchecked — indices are traced): seq_lens[i] <
+    max_pages * page_size (the slot has a page for the appended row;
+    Pallas CLAMPS out-of-range block indices, so violating this
+    silently overwrites the last allocated row) and positions[i] <
+    cos.shape[0]. The serving engine guarantees both.
+
+    Returns (out [slots, kv_heads, group, d], k_pages', v_pages').
+    """
+    slots, kvh, group, d = q.shape
+    _, n_pages, page_size, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+
+    group_pad = max(8, -(-group // 8) * 8)
+    if group_pad != group:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, group_pad - group), (0, 0)))
+    k_new = k_new.reshape(slots, kvh, 1, d)
+    v_new = v_new.reshape(slots, kvh, 1, d)
+    half = d // 2
+
+    def q_index(s, h, j, bt_ref, lens_ref, pos_ref):
+        return (s, h, 0, 0)
+
+    def kv_index(s, h, j, bt_ref, lens_ref, pos_ref):
+        last = lens_ref[s] // page_size
+        return (h, bt_ref[s, jnp.minimum(j, last)], 0, 0)
+
+    def rope_index(s, h, j, bt_ref, lens_ref, pos_ref):
+        return (pos_ref[s], 0)
+
+    def append_index(s, h, j, bt_ref, lens_ref, pos_ref):
+        # the new token's row: current page, in-page offset — constant
+        # over j, so exactly one row is written back per (s, h)
+        return (h, bt_ref[s, lens_ref[s] // page_size],
+                lens_ref[s] % page_size, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(slots, kvh, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, group_pad, d), q_index),
+            pl.BlockSpec((1, 1, 1, d), q_index),
+            pl.BlockSpec((1, 1, 1, d), q_index),
+            pl.BlockSpec((None, None, page_size, d), kv_index),
+            pl.BlockSpec((None, None, page_size, d), kv_index),
+            pl.BlockSpec((1, half), rope_index),
+            pl.BlockSpec((1, half), rope_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, group_pad, d), q_index),
+            pl.BlockSpec((None, None, 1, d), append_index),
+            pl.BlockSpec((None, None, 1, d), append_index),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, d), jnp.float32),
+            pltpu.VMEM((group_pad, 128), jnp.float32),
+            pltpu.VMEM((group_pad, 128), jnp.float32),
+            pltpu.VMEM((group_pad, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _fused_decode_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages, group_pad=group_pad,
+    )
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # operand order: 3 prefetch scalars, q, kn, vn, k_pages(6),
+        # v_pages(7), cos, sin — the pools alias outputs 1/2 so the
+        # append is in-place on the donated cache buffers
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32),
+      jnp.asarray(positions, jnp.int32),
+      q, k_new, v_new, k_pages, v_pages, cos, sin)
+    return out[:, :, :group, :], k_pages, v_pages

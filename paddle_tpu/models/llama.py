@@ -132,12 +132,57 @@ class LlamaAttention(Layer):
         q = shard_activation(q, ("dp", "fsdp"), "sep", "tp", None)
         k = shard_activation(k, ("dp", "fsdp"), "sep", "tp", None)
         v = shard_activation(v, ("dp", "fsdp"), "sep", "tp", None)
-        q, k = apply_rope(q, k, cos, sin, position_ids)
         if kv_cache is not None:
+            from ..distributed.sharding import current_mesh
             from ..inference.paged import (PagedLayerCache, append_kv,
                                            paged_attention)
+            from ..kernels import decode_attention as da
 
-            if isinstance(kv_cache[0], PagedLayerCache):
+            paged_mode = isinstance(kv_cache[0], PagedLayerCache)
+            per_slot = getattr(cache_index, "ndim", 0) == 1
+            # fused single-pass decode (PT_FLAGS_fused_decode): RoPE +
+            # KV-append + length-pruned attention in one kernel — no
+            # separate append_kv program, no rotated-q/k HBM round-trip.
+            # Single-token per-slot decode only; under a mesh the
+            # GSPMD-partitioned reference path stays in charge.
+            fused = s == 1 and (paged_mode or per_slot) \
+                and current_mesh() is None
+            if fused:
+                minor = (kv_cache[0].k_pages.shape[2] if paged_mode
+                         else da.contiguous_chunk(kv_cache[0].shape[1]))
+                fused = da.fused_decode_active(cfg.head_dim, minor)
+            if not fused:
+                q, k = apply_rope(q, k, cos, sin, position_ids)
+            kvh = cfg.num_key_value_heads
+            hd = cfg.head_dim
+            if fused:
+                lens = (kv_cache[1].seq_lens if paged_mode
+                        else jnp.asarray(cache_index, jnp.int32))
+                pos = (jnp.asarray(position_ids[:, 0], jnp.int32)
+                       if position_ids is not None else lens)
+                qg = q[:, 0].reshape(b, kvh, cfg.num_attention_heads
+                                     // kvh, hd)
+                rope_cos = cos.astype(jnp.float32)
+                rope_sin = sin.astype(jnp.float32)
+                if paged_mode:
+                    from ..kernels.paged_attention import (
+                        fused_paged_decode_attention,
+                    )
+
+                    cache, state = kv_cache
+                    og, kp, vp = fused_paged_decode_attention(
+                        qg, k[:, 0], v[:, 0], cache.k_pages,
+                        cache.v_pages, state.block_tables,
+                        state.seq_lens, pos, rope_cos, rope_sin)
+                    new_cache = (PagedLayerCache(kp, vp), state)
+                else:
+                    ck, cv = kv_cache
+                    og, ck, cv = da.fused_contiguous_decode_attention(
+                        qg, k[:, 0], v[:, 0], ck, cv, lens, pos,
+                        rope_cos, rope_sin)
+                    new_cache = (ck, cv)
+                out = og.reshape(b, 1, cfg.num_attention_heads, hd)
+            elif paged_mode:
                 # paged decode (s == 1): write this token's kv into its
                 # slot's page, then attend over the gathered page view
                 cache, state = kv_cache
@@ -148,7 +193,6 @@ class LlamaAttention(Layer):
                 ck, cv = kv_cache
                 k = k.astype(ck.dtype)
                 v = v.astype(cv.dtype)
-                per_slot = getattr(cache_index, "ndim", 0) == 1
                 if per_slot:
                     # continuous batching: each slot writes at its own
                     # length (s == 1) and masks to its own history
@@ -181,6 +225,7 @@ class LlamaAttention(Layer):
         else:
             from ..distributed.sharding import current_mesh
 
+            q, k = apply_rope(q, k, cos, sin, position_ids)
             mesh = current_mesh()
             sep = mesh.shape.get("sep", 1) if mesh is not None else 1
             if sep > 1 and cfg.sep_attention == "ring":

@@ -268,6 +268,17 @@ class MetricsRegistry:
                         f"metric {name!r} re-registered as {cls.kind} "
                         f"labels={tuple(labels)}; existing is {m.kind} "
                         f"labels={m.label_names}")
+                # buckets=None on re-registration means "fetch whatever
+                # exists" (the common re-fetch idiom); only an EXPLICIT
+                # conflicting edge set is an error
+                want = kw.get("buckets")
+                if want is not None and \
+                        tuple(want) != tuple(getattr(m, "buckets", ())):
+                    raise ValueError(
+                        f"histogram {name!r} re-registered with "
+                        f"different buckets {tuple(want)}; existing "
+                        f"{tuple(m.buckets)} — observations would land "
+                        "in the first caller's edges")
                 return m
             m = cls(name, help_, labels, self._lock, **kw)
             self._metrics[name] = m
