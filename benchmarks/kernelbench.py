@@ -81,6 +81,81 @@ def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
     return total
 
 
+def prefill_flops(n_tokens, ctx_len, hidden, inter, n_layers, vocab):
+    """Modeled MXU FLOPs for a prefill pass computing ``n_tokens``
+    rows attending over ``ctx_len`` context (pure python, runs
+    anywhere): per-layer qkvo + gated-MLP matmuls per row, QK^T + PV
+    attention per row × context, plus the lm head. GQA's smaller kv
+    projections and causal halving are ignored — the A/B compares
+    admission SCHEMES, and both sides share the constants."""
+    lin = 2 * (4 * hidden * hidden + 3 * hidden * inter) * n_tokens
+    attn = 2 * 2 * n_tokens * ctx_len * hidden
+    head = 2 * n_tokens * hidden * vocab
+    return n_layers * (lin + attn) + head
+
+
+def prefill_admission_flops(prompt_len, prefix_len, chunk, buckets,
+                            hidden=4096, inter=11008, n_layers=32,
+                            vocab=32000, max_len=None):
+    """Modeled prefill cost of one request under the three admission
+    schemes — the shared-prefix A/B:
+
+      - ``legacy_flops``: per-bucket prefill pads the prompt up to its
+        seq bucket (a 260-token prompt pays a 512-token forward); a
+        prompt past the largest bucket pays ``max_len``, the engine's
+        ``_bucket`` fallback. When ``max_len`` is omitted the model
+        assumes the largest bucket IS max_len (the engine's normalized
+        bucket table never exceeds it);
+      - ``chunked_flops``: single-program chunked prefill computes the
+        prompt rounded up to the chunk;
+      - ``chunked_prefix_flops``: prefix-cache hit computes only the
+        SUFFIX rounded up to the chunk — cost ∝ suffix length, not
+        bucket or prompt length.
+
+    This is the MARGINAL cost of the request's own rows — what an
+    admission wave pays per request when its chunks pack with other
+    requests'. A lone request in the fixed ``[slots, chunk]`` program
+    additionally pays the idle slots' sentinel rows (same trade as the
+    engine's fixed-shape decode program), which packing amortizes away.
+    """
+    import bisect
+
+    bs = sorted(buckets)
+    i = bisect.bisect_left(bs, prompt_len)
+    bucket = bs[i] if i < len(bs) else (max_len or bs[-1])
+    dims = (hidden, inter, n_layers, vocab)
+    suffix = max(prompt_len - prefix_len, 1)
+    rows_full = -(-prompt_len // chunk) * chunk
+    rows_suffix = -(-suffix // chunk) * chunk
+    return {
+        "prompt_len": prompt_len,
+        "prefix_len": prefix_len,
+        "bucket": bucket,
+        "chunk": chunk,
+        "legacy_flops": prefill_flops(bucket, bucket, *dims),
+        "chunked_flops": prefill_flops(rows_full, prompt_len, *dims),
+        "chunked_prefix_flops": prefill_flops(rows_suffix, prompt_len,
+                                              *dims),
+    }
+
+
+def prefill_cost_ab():
+    """Print the modeled prefill-admission A/B at serve7b-class shapes
+    (pure cost model — runs on any backend): one JSON line per
+    (prompt_len, prefix_len) point, mirroring the groupnorm/decode
+    rows' format."""
+    points = [
+        # (prompt_len, prefix_len): cold, warm system prompt, few-shot
+        (260, 0), (260, 256), (1500, 0), (1500, 1280), (700, 512),
+    ]
+    for prompt_len, prefix_len in points:
+        row = prefill_admission_flops(
+            prompt_len, prefix_len, chunk=256,
+            buckets=(128, 256, 512, 1024, 2048))
+        row["kernel"] = "prefill_admission_model"
+        print(json.dumps(row), flush=True)
+
+
 def decode_bench():
     """Fused single-pass decode attention vs the unfused reference
     (rope → append → attention), both cache modes, at the serve7b-class
@@ -230,6 +305,10 @@ def _rope_one(q, k_new, positions, cos, sin):
 
 
 def main():
+    # the modeled prefill A/B is pure Python — emit it on ANY backend,
+    # before the TPU-only guards (it is the only output a CPU/GPU host
+    # gets from this CLI)
+    prefill_cost_ab()
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # fail fast WITHOUT importing jax: with the tunnel down, axon
         # plugin registration can hang the interpreter for minutes

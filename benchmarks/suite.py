@@ -590,6 +590,71 @@ def _decode_attn_roofline(mcfg, ecfg, steady_len, cache_bytes):
     }
 
 
+def _shared_prefix_scenario(model, base_ecfg, tpu):
+    """Prefix-cache A/B under shared-system-prompt load: N requests
+    share a long block-aligned prefix and differ only in a short tail.
+    Requests run SEQUENTIALLY (request k+1 can hit the blocks request k
+    published), once with ``PT_FLAGS_prefix_cache=on`` and once off;
+    reports TTFT p50/p95 and the token hit rate per arm plus the
+    modeled prefill-FLOPs row. The prefill chunk is shrunk to one page
+    for the scenario so the suffix-vs-prompt chunk-count difference is
+    visible even at the CPU smoke size."""
+    from benchmarks.kernelbench import prefill_admission_flops
+    from paddle_tpu import flags as F
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    ps = base_ecfg.page_size
+    shared_len = (4 if tpu else 2) * ps
+    tail_len = 8
+    new_tokens = 16 if tpu else 4
+    n_requests = 12 if tpu else 4
+    rng = np.random.default_rng(7)
+    vocab = model.config.vocab_size
+    shared = rng.integers(0, vocab, (shared_len,))
+    prompts = [np.concatenate([shared, rng.integers(0, vocab, (tail_len,))])
+               for _ in range(n_requests)]
+    warm = rng.integers(0, vocab, (shared_len + tail_len,))
+
+    ecfg = base_ecfg
+    saved = {k: F.flag(k) for k in ("prefix_cache", "prefill_chunk")}
+    out = {}
+    try:
+        for arm in ("on", "off"):
+            F.set_flags({"prefix_cache": arm == "on",
+                         "prefill_chunk": ps})
+            eng = ContinuousBatchingEngine(model, ecfg)
+            eng.run([warm], max_new_tokens=2)  # compile, no shared blocks
+            base = eng.prefix_snapshot()  # exclude warm-up from rates
+            ttfts = []
+            for p in prompts:
+                ttfts.append(eng.run([p], new_tokens)[0].ttft_ms)
+            snap = eng.prefix_snapshot()
+            hit_toks = snap["hit_tokens"] - base["hit_tokens"]
+            prompt_toks = snap["prompt_tokens"] - base["prompt_tokens"]
+            out[arm] = {
+                "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
+                "p95_ttft_ms": round(float(np.percentile(ttfts, 95)), 2),
+                "prefix_hits": snap["hits"] - base["hits"],
+                "prefix_hit_rate_tokens": round(
+                    hit_toks / prompt_toks if prompt_toks else 0.0, 3),
+                "cached_blocks": snap["cached_blocks"],
+            }
+            eng = None  # drop this arm's KV pool before the next builds
+    finally:
+        F.set_flags(saved)
+    out["n_requests"] = n_requests
+    out["shared_prefix_len"] = int(shared_len)
+    out["tail_len"] = tail_len
+    out["modeled_prefill"] = prefill_admission_flops(
+        shared_len + tail_len, shared_len, chunk=ps,
+        buckets=tuple(base_ecfg.seq_buckets),
+        max_len=base_ecfg.max_len,
+        hidden=model.config.hidden_size,
+        inter=model.config.intermediate_size,
+        n_layers=model.config.num_hidden_layers, vocab=vocab)
+    return out
+
+
 def bench_serve7b(tpu_diags):
     """7B-class int8 weight-only decode through the paged continuous-
     batching engine — the first production-scale silicon path (VERDICT
@@ -640,6 +705,10 @@ def bench_serve7b(tpu_diags):
         max_slots=slots, max_len=max_len, seq_buckets=(128,),
         cache_dtype=cache_dtype, paged=True,
         page_size=64 if tpu else 32)
+    # shared-prefix A/B runs BEFORE the main engine exists: the
+    # scenario builds its own engines (one per arm), and two resident
+    # KV pools would double-book HBM on the 16 GB target
+    shared_prefix = _shared_prefix_scenario(model, ecfg, tpu)
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
@@ -686,6 +755,7 @@ def bench_serve7b(tpu_diags):
 
     extra = {
         "params": n_params,
+        "shared_prefix": shared_prefix,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,
             2 if cache_dtype == jnp.bfloat16 else 4),

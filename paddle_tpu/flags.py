@@ -96,6 +96,20 @@ define_flag("fused_decode", "auto",
             "append + length-pruned streaming): auto = compiled kernel "
             "on TPU when shapes tile, lax reference elsewhere; "
             "on = force (Pallas interpret mode off-TPU); off = unfused")
+define_flag("prefix_cache", True,
+            "serving prefix KV reuse: admission looks up the longest "
+            "cached block-aligned prompt prefix and prefills only the "
+            "suffix (paged mode shares pages copy-on-write; contiguous "
+            "mode copies cached token blocks into the slot). off = "
+            "every request recomputes its full prompt")
+define_flag("prefill_chunk", 256,
+            "serving prefill chunk length: ONE compiled fixed-size-chunk "
+            "program (clamped to [2, max_len] — a 1-token chunk would "
+            "fall into the decode step's clamped append) drives prefill "
+            "in a host loop — compute ∝ suffix rounded up to the chunk, "
+            "not the seq bucket, and compile count drops from "
+            "len(seq_buckets) to 1. 0 = legacy per-bucket prefill (the "
+            "parity oracle)")
 define_flag("kv_cache_dtype", "auto",
             "serving KV-cache dtype when EngineConfig.cache_dtype is "
             "'auto': auto = bfloat16 on TPU (halves decode KV traffic), "

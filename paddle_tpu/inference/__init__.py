@@ -340,8 +340,14 @@ from .paged import (  # noqa: E402,F401
     PagedLayerCache,
     PagedState,
     PagePool,
+    append_kv_chunk,
     init_paged_pool,
     paged_attention,
+)
+from .prefix_cache import (  # noqa: E402,F401
+    ContigPrefixStore,
+    PagedPrefixStore,
+    block_hashes,
 )
 from .serving import (  # noqa: E402,F401
     ContinuousBatchingEngine,

@@ -24,7 +24,7 @@ it's O(requests), not O(tokens), and never enters the compiled program.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -78,6 +78,36 @@ def append_kv(cache: PagedLayerCache, state: PagedState, k, v
         k[:, 0].astype(cache.k_pages.dtype).transpose(1, 0, 2))
     v_pages = cache.v_pages.at[:, pages, offs].set(
         v[:, 0].astype(cache.v_pages.dtype).transpose(1, 0, 2))
+    return PagedLayerCache(k_pages, v_pages)
+
+
+def append_kv_chunk(cache: PagedLayerCache, state: PagedState, k, v,
+                    start) -> PagedLayerCache:
+    """Write a CHUNK of tokens per slot through the block table.
+
+    k, v: [slots, s, kv_heads, head_dim]; ``start``: [slots] int32 —
+    slot i's rows land at positions ``start[i] .. start[i]+s-1`` (page
+    ``block_tables[i, pos // page_size]`` offset ``pos % page_size``).
+    Positions past the block table's span (including the engine's
+    ``start = max_len`` "not prefilling this call" sentinel) scatter
+    with ``mode="drop"`` — a dropped write, never a clamped one.
+    """
+    page_size = cache.k_pages.shape[2]
+    slots, s = k.shape[0], k.shape[1]
+    max_pages = state.block_tables.shape[1]
+    n_pages = cache.k_pages.shape[1]
+    pos = start[:, None] + jnp.arange(s, dtype=start.dtype)[None, :]
+    page_idx = pos // page_size
+    offs = pos % page_size
+    valid = page_idx < max_pages
+    safe = jnp.minimum(page_idx, max_pages - 1)
+    pages = jnp.take_along_axis(state.block_tables, safe, axis=1)
+    pages = jnp.where(valid, pages, n_pages)  # OOB page id -> dropped
+    # value laid out head-major to match the pool: [kvh, slots, s, d]
+    k_pages = cache.k_pages.at[:, pages, offs].set(
+        k.astype(cache.k_pages.dtype).transpose(2, 0, 1, 3), mode="drop")
+    v_pages = cache.v_pages.at[:, pages, offs].set(
+        v.astype(cache.v_pages.dtype).transpose(2, 0, 1, 3), mode="drop")
     return PagedLayerCache(k_pages, v_pages)
 
 
@@ -168,6 +198,12 @@ class PagePool:
     The engine calls ``alloc``/``free`` as requests arrive/finish and
     pushes the updated block table to the device as plain int32 data —
     allocation never triggers recompilation.
+
+    Pages carry REFCOUNTS so a prefix cache can share them: ``ref[p]``
+    counts owners (each slot holding p in its block table, plus the
+    prefix store if it retains p). A page returns to the free list only
+    at refcount 0; a slot must never write a page with refcount > 1 —
+    the engine copies it first (``cow``).
     """
 
     def __init__(self, n_pages: int, page_size: int, slots: int,
@@ -182,6 +218,20 @@ class PagePool:
         self._free = list(range(n_pages - 1, first - 1, -1))
         self.block_tables = np.zeros((slots, max_pages_per_slot), np.int32)
         self.pages_of: dict = {i: [] for i in range(slots)}
+        self.ref: dict = {}  # page id -> owner count (absent == 0)
+        # pages with ref > 1 — lets the engine's decode-time COW guard
+        # skip its per-slot scan when NOTHING is shared (prefix-cache
+        # off, or no request published blocks yet). With the cache on
+        # and warm, published prompt blocks keep this > 0, and the
+        # guard pays its window-bounded scan (a couple of dict lookups
+        # per active slot per dispatch)
+        self.shared_pages = 0
+
+    def _bump(self, page: int):
+        n = self.ref.get(page, 0) + 1
+        self.ref[page] = n
+        if n == 2:
+            self.shared_pages += 1
 
     @property
     def free_pages(self) -> int:
@@ -201,10 +251,62 @@ class PagePool:
             p = self._free.pop()
             self.block_tables[slot, len(self.pages_of[slot])] = p
             self.pages_of[slot].append(p)
+            self.ref[p] = 1
         return True
 
+    def adopt(self, slot: int, pages) -> bool:
+        """Prefix-share: place already-populated ``pages`` at the FRONT
+        of an empty slot's block table (refcount + 1 each) — the caller
+        tops the rest up with ``alloc``. False if the list alone would
+        exceed the per-slot maximum (nothing adopted)."""
+        if self.pages_of[slot]:
+            raise ValueError(f"adopt() needs an empty slot; slot {slot} "
+                             f"holds {len(self.pages_of[slot])} pages")
+        if len(pages) > self.max_pages_per_slot:
+            return False
+        for p in pages:
+            self.block_tables[slot, len(self.pages_of[slot])] = p
+            self.pages_of[slot].append(p)
+            self._bump(p)
+        return True
+
+    def retain(self, page: int):
+        """Add an owner (the prefix store pinning a page)."""
+        self._bump(page)
+
+    def release(self, page: int):
+        """Drop an owner; the page frees at refcount 0. Releasing an
+        un-owned page is a double-free — loud, because the silent
+        version hands one page to two slots later."""
+        was = self.ref.get(page, 0)
+        if was <= 0:
+            raise ValueError(f"release() of un-owned page {page}")
+        if was == 2:
+            self.shared_pages -= 1
+        if was == 1:
+            self.ref.pop(page, None)
+            self._free.append(page)
+        else:
+            self.ref[page] = was - 1
+
+    def cow(self, slot: int, block_idx: int) -> Optional[int]:
+        """Copy-on-write bookkeeping: swap the (shared) page at
+        ``block_idx`` of this slot for a fresh private one. Returns the
+        new page id (the CALLER must device-copy old → new before any
+        write), or None when the free list is empty."""
+        if not self._free:
+            return None
+        old = self.pages_of[slot][block_idx]
+        new = self._free.pop()
+        self.pages_of[slot][block_idx] = new
+        self.block_tables[slot, block_idx] = new
+        self.ref[new] = 1
+        self.release(old)
+        return new
+
     def free(self, slot: int):
-        self._free.extend(reversed(self.pages_of[slot]))
+        for p in reversed(self.pages_of[slot]):
+            self.release(p)
         self.pages_of[slot] = []
         self.block_tables[slot] = 0
 

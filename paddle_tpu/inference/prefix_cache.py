@@ -1,0 +1,166 @@
+"""Shared-prefix KV store for the continuous-batching engine.
+
+Production prompt traffic is dominated by shared system prompts and
+few-shot templates: the cheapest prefill FLOPs and HBM bytes are the
+ones a prefix cache lets the engine skip entirely. Prefixes are keyed
+by a ROLLING HASH over fixed-size prompt-token blocks — block i's
+digest chains block i-1's, so one dict lookup per block walks the
+longest cached block-aligned prefix without storing per-prompt keys.
+
+Two stores, one per KV-cache mode:
+
+- ``PagedPrefixStore`` (paged mode) maps digest → PAGE ID. The store
+  owns a refcount on each cached page (``PagePool.retain``); admission
+  shares matched pages straight into the new slot's block table
+  (``PagePool.adopt`` — zero copies), and the engine copy-on-writes any
+  shared page before a write can touch it. Eviction is LRU over
+  entries whose page refcount is 1 (cache-only — nothing borrowed by a
+  live slot), triggered by pool pressure.
+
+- ``ContigPrefixStore`` (contiguous mode) maps digest → the block's
+  actual K/V rows, stacked over layers ``[n_layers, block, kvh, d]``
+  (device arrays in the cache dtype). Slots have private rows, so a
+  hit COPIES the cached blocks in (one small compiled insert per
+  block) — recompute is saved, memory is not shared. Eviction is LRU
+  over a block-count cap (entries are never borrowed: refcount-0 by
+  construction).
+
+Host-side bookkeeping only: O(prompt blocks) python per admission,
+never inside a compiled program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import List, Tuple
+
+import numpy as np
+
+_SEED = b"pt-prefix-v1"
+
+
+def block_hashes(prompt: np.ndarray, block: int) -> List[bytes]:
+    """Chained digests of the prompt's FULL token blocks (the rolling
+    hash): ``h_i = H(h_{i-1} || tokens[i*B:(i+1)*B])``. The partial
+    tail block is never hashed — prefixes are block-aligned."""
+    toks = np.ascontiguousarray(np.asarray(prompt).reshape(-1), np.int64)
+    out: List[bytes] = []
+    prev = _SEED
+    for i in range(toks.size // block):
+        h = hashlib.blake2b(
+            prev + toks[i * block:(i + 1) * block].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+        prev = h
+    return out
+
+
+class PagedPrefixStore:
+    """digest → page id, refcount-pinned in the engine's PagePool."""
+
+    def __init__(self):
+        # LRU order == dict order: least-recent first
+        self._blocks: "OrderedDict[bytes, int]" = OrderedDict()
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def __contains__(self, digest) -> bool:
+        return digest in self._blocks
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._blocks)
+
+    def match(self, hashes: List[bytes]) -> List[int]:
+        """Longest cached prefix: pages of the leading present blocks
+        (LRU-refreshed)."""
+        pages = []
+        for h in hashes:
+            page = self._blocks.get(h)
+            if page is None:
+                break
+            self._blocks.move_to_end(h)
+            pages.append(page)
+        return pages
+
+    def insert(self, digest: bytes, page: int, pool) -> bool:
+        """Pin ``page`` under ``digest`` (no-op if already cached —
+        the original stays authoritative)."""
+        if digest in self._blocks:
+            self._blocks.move_to_end(digest)
+            return False
+        pool.retain(page)
+        self._blocks[digest] = page
+        return True
+
+    def evictable_pages(self, pool, exclude=()) -> int:
+        """How many pages ``evict`` could free right now: entries
+        nothing but the store owns, minus ``exclude`` (pages the
+        caller is about to adopt, which would pin them)."""
+        ex = set(exclude)
+        return sum(1 for p in self._blocks.values()
+                   if p not in ex and pool.ref.get(p, 0) == 1)
+
+    def evict(self, pool, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages, LRU-first, skipping entries a
+        live slot is still borrowing (page refcount > 1). Evicting a
+        chain-interior block strands its (unreachable) children until
+        their own LRU turn — correctness is unaffected, lookups just
+        stop at the gap."""
+        freed = 0
+        for digest, page in list(self._blocks.items()):
+            if freed >= n_pages:
+                break
+            if pool.ref.get(page, 0) != 1:
+                continue  # borrowed by an active slot
+            del self._blocks[digest]
+            pool.release(page)
+            self.evictions += 1
+            freed += 1
+        return freed
+
+
+class ContigPrefixStore:
+    """digest → materialized K/V block rows (device arrays)."""
+
+    def __init__(self, max_blocks: int):
+        self.max_blocks = max(int(max_blocks), 0)
+        # digest -> (k, v); k/v: [n_layers, block, kvh, d].
+        # LRU order == dict order: least-recent first.
+        self._blocks: "OrderedDict[bytes, Tuple]" = OrderedDict()
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def __contains__(self, digest) -> bool:
+        return digest in self._blocks
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._blocks)
+
+    def match(self, hashes: List[bytes]) -> List[Tuple]:
+        out = []
+        for h in hashes:
+            ent = self._blocks.get(h)
+            if ent is None:
+                break
+            self._blocks.move_to_end(h)
+            out.append(ent)
+        return out
+
+    def insert(self, digest: bytes, k, v) -> bool:
+        if self.max_blocks == 0:
+            return False
+        if digest in self._blocks:
+            self._blocks.move_to_end(digest)
+            return False
+        while len(self._blocks) >= self.max_blocks:
+            self._blocks.popitem(last=False)
+            self.evictions += 1
+        self._blocks[digest] = (k, v)
+        return True

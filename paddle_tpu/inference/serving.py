@@ -7,10 +7,17 @@ TPU-native shape discipline: ONE compiled decode program with a static
 ``[slots, 1]`` token batch serves the whole lifetime of the engine.
 Sequences enter and leave *as data*: per-slot lengths, an active mask,
 and (paged mode) block tables are device arrays the host scheduler
-updates — no shape ever changes, so nothing recompiles. Prefill runs
-per-request on bucketed lengths (each bucket compiles once) and its KV
-is scattered into the live pool, overlapping new-request admission with
-ongoing decode — the essence of continuous batching.
+updates — no shape ever changes, so nothing recompiles. Prefill keeps
+the same discipline: ONE compiled fixed-size ``[slots, prefill_chunk]``
+program writes straight into the live cache at vector per-slot offsets,
+driven in a host loop — compute ∝ suffix rounded to the chunk (not the
+seq bucket), several queued requests' chunks pack into one call, and
+everything dispatches behind the in-flight decode chunk. Admission
+first consults the PREFIX CACHE (``prefix_cache.py``): the longest
+cached block-aligned prompt prefix is shared into the slot (paged:
+refcounted pages, copy-on-write; contiguous: copied blocks) and only
+the suffix is prefilled. ``PT_FLAGS_prefill_chunk=0`` restores the
+legacy per-bucket prefill — the parity oracle.
 """
 
 from __future__ import annotations
@@ -34,6 +41,13 @@ from ..core.functional import (
 )
 from ..core.module import Layer
 from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
+from .prefix_cache import ContigPrefixStore, PagedPrefixStore, block_hashes
+
+# trace-time compile accounting: each compiled-program body bumps its
+# counter exactly once per jit SPECIALIZATION (python runs at trace
+# time only) — the tests' compile-count guard reads deltas here to
+# assert chunked prefill never re-specializes across prompt lengths
+TRACE_COUNTS: collections.Counter = collections.Counter()
 
 
 @dataclass
@@ -42,11 +56,20 @@ class EngineConfig:
     max_len: int = 1024
     seq_buckets: Sequence[int] = (64, 128, 256, 512, 1024)
     paged: bool = False
+    # paged mode: tokens per KV page. Contiguous mode reuses it as the
+    # prefix-cache block granularity (rolling-hash block length)
     page_size: int = 64
     n_pages: Optional[int] = None  # default: slots*max_len/page_size (+sink)
     # "auto" resolves through PT_FLAGS_kv_cache_dtype: bf16 on TPU
     # (halves decode KV traffic), fp32 elsewhere; explicit dtypes win
     cache_dtype: object = "auto"
+    # contiguous-mode prefix store cap (blocks of materialized
+    # per-layer K/V — real device memory on top of the engine's own
+    # cache); None = a QUARTER engine's worth
+    # (max_slots * max_len / page_size / 4), so the default can't
+    # silently double an engine sized near HBM capacity. Paged mode
+    # needs no cap: pool pressure evicts.
+    prefix_cache_blocks: Optional[int] = None
     greedy: bool = True
     temperature: float = 1.0
     seed: int = 0
@@ -79,6 +102,23 @@ def _resolve_cache_dtype(requested):
     return lookup(val, "PT_FLAGS_kv_cache_dtype")
 
 
+def _validate_buckets(cfg: "EngineConfig") -> List[int]:
+    """seq_buckets sanity at engine init: entries must be positive
+    ints; the working table is normalized (sorted, deduped, clamped to
+    max_len) so unsorted input can't break the bisect lookup and an
+    oversized bucket can't over-allocate a one-shot prefill cache."""
+    buckets = list(cfg.seq_buckets)
+    if not buckets:
+        raise ValueError("EngineConfig.seq_buckets must be non-empty")
+    for b in buckets:
+        if isinstance(b, bool) or not isinstance(b, (int, np.integer)) \
+                or b <= 0:
+            raise ValueError(
+                f"EngineConfig.seq_buckets entries must be positive "
+                f"ints; got {b!r}")
+    return sorted({min(int(b), cfg.max_len) for b in buckets})
+
+
 @dataclass
 class Request:
     rid: int
@@ -90,6 +130,9 @@ class Request:
     slot: Optional[int] = None
     done: bool = False
     _submit_t: float = 0.0
+    # prompt block digests, computed once — a pool-blocked request is
+    # re-matched every scheduler tick and must not re-hash each time
+    _hashes: Optional[List[bytes]] = None
 
 
 class ContinuousBatchingEngine:
@@ -173,8 +216,7 @@ class ContinuousBatchingEngine:
         # sorted bucket table for bisect lookup — _admit_dispatch used
         # to rescan all slots twice and all buckets per queued request
         self._free_heap = list(range(cfg.max_slots))
-        self._buckets = sorted(
-            {min(b, cfg.max_len) for b in cfg.seq_buckets})
+        self._buckets = _validate_buckets(cfg)
         self._slot_req: Dict[int, Request] = {}
         self._queue: collections.deque = collections.deque()
         self._next_rid = 0
@@ -185,6 +227,12 @@ class ContinuousBatchingEngine:
         self._n_layers = mcfg.num_hidden_layers
         kvh = mcfg.num_key_value_heads
         hd = mcfg.head_dim
+        if cfg.page_size < 1:
+            # load-bearing in BOTH modes now: paged page granularity,
+            # and the prefix-cache hash block length in contiguous mode
+            raise ValueError(
+                f"EngineConfig.page_size must be >= 1; got "
+                f"{cfg.page_size}")
         if cfg.paged:
             if cfg.max_len % cfg.page_size:
                 raise ValueError("max_len must be divisible by page_size")
@@ -221,6 +269,39 @@ class ContinuousBatchingEngine:
         self._prefill_c = None
         self._insert_c = None
         self._scatter_c = None
+        self._prefill_chunk_c = None
+        self._insert_prefix_c = None
+        self._read_block_c = None
+        self._copy_page_c = None
+
+        # single-program chunked prefill (PT_FLAGS_prefill_chunk): one
+        # fixed [slots, C] chunk program in a host loop replaces the
+        # per-bucket jit specializations; 0 = legacy bucketed prefill
+        # floor of 2: a 1-token chunk would hit the models' s == 1
+        # decode branch, whose append CLAMPS out-of-range positions —
+        # the idle-slot start=max_len sentinel must always route
+        # through the s > 1 scatter-with-drop path
+        chunk = int(flags.flag("prefill_chunk"))
+        self._chunk_len = max(2, min(chunk, cfg.max_len)) if chunk > 0 \
+            else 0
+        # prefix KV reuse (PT_FLAGS_prefix_cache) rides the chunked
+        # path only: suffix-only prefill needs the vector-cache_index
+        # chunk program, which the legacy bucketed oracle doesn't have
+        self._prefix = None
+        self._prefix_block = cfg.page_size
+        if bool(flags.flag("prefix_cache")) and self._chunk_len:
+            if cfg.paged:
+                self._prefix = PagedPrefixStore()
+            else:
+                cap = cfg.prefix_cache_blocks
+                if cap is None:
+                    cap = max(cfg.max_slots * cfg.max_len
+                              // max(self._prefix_block, 1) // 4, 1)
+                self._prefix = ContigPrefixStore(cap)
+        self.prefix_stats = {
+            "hits": 0, "misses": 0, "hit_tokens": 0,
+            "prompt_tokens": 0, "evictions": 0, "cow_copies": 0,
+        }
 
         # telemetry (None when PT_FLAGS_telemetry=off → scheduling loop
         # pays a single identity check per hook site)
@@ -250,6 +331,10 @@ class ContinuousBatchingEngine:
     def add_request(self, prompt, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None) -> int:
         prompt = np.asarray(prompt).reshape(-1)
+        if prompt.size == 0:
+            # an empty prompt would "sample" from the last PADDED
+            # position (last_idx = -1) — garbage logits, not a request
+            raise ValueError("add_request needs a non-empty prompt")
         if prompt.size + max_new_tokens > self.cfg.max_len:
             raise ValueError(
                 f"prompt({prompt.size}) + max_new_tokens({max_new_tokens}) "
@@ -277,6 +362,7 @@ class ContinuousBatchingEngine:
         # host — never the [1, bucket, vocab] logits tensor.
         if self._prefill_c is None:
             def fn(pb, ids, caches, last_idx, key):
+                TRACE_COUNTS["prefill_bucket"] += 1
                 pos = jnp.broadcast_to(
                     jnp.arange(ids.shape[1])[None, :], ids.shape)
                 logits, filled = functional_call(
@@ -337,6 +423,117 @@ class ContinuousBatchingEngine:
                 return out
             self._scatter_c = jax.jit(fn, donate_argnums=(0,))
         return self._scatter_c
+
+    def _prefill_chunked(self):
+        """THE prefill program: one fixed-shape [slots, C] chunk,
+        writing straight into the live global cache at per-slot
+        offsets. A host loop drives chunk k over suffix tokens
+        [k·C, (k+1)·C); slots not prefilling this call carry a
+        ``start = max_len`` sentinel (their writes drop, their outputs
+        are ignored). Samples a first token per slot in-jit from the
+        per-slot ``last_idx`` row — only scalars ever cross to the
+        host; the host uses the sample from each request's final chunk.
+        One jit specialization serves EVERY prompt length (the compile
+        count the trace guard asserts), and multiple queued requests'
+        chunks pack into the same call. The shape is [slots, C] like
+        the decode program's [slots, 1]: a lone admission still
+        computes every slot's rows (sentinels included) — the win is
+        per-REQUEST marginal cost under packing, not the cost of an
+        unpacked call."""
+        if self._prefill_chunk_c is None:
+            paged = self.cfg.paged
+            C = self._chunk_len
+
+            def fn(pb, ids, caches, bt, start, last_idx, key):
+                TRACE_COUNTS["prefill_chunk"] += 1
+                pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)
+                if paged:
+                    state = PagedState(block_tables=bt, seq_lens=start)
+                    kv = [(c, state) for c in caches]
+                else:
+                    kv = caches
+                logits, new_kv = functional_call(
+                    self.model, pb["p"], ids, position_ids=pos,
+                    kv_caches=kv, cache_index=start, buffers=pb["b"])
+                rows = logits[jnp.arange(logits.shape[0]), last_idx]
+                if self.cfg.greedy:
+                    toks = jnp.argmax(rows, axis=-1)
+                else:
+                    toks = jax.random.categorical(
+                        key, rows / self.cfg.temperature, axis=-1)
+                if paged:
+                    return toks, [c for c, _ in new_kv]
+                return toks, new_kv
+            self._prefill_chunk_c = jax.jit(fn, donate_argnums=(2,))
+        return self._prefill_chunk_c
+
+    def _insert_prefix_contig(self):
+        """Write one cached prefix block (k/v stacked over layers,
+        [n_layers, B, kvh, d]) into a slot's contiguous cache rows at
+        ``start`` — the contiguous-mode prefix 'share' is a copy.
+        One dispatch per matched block (a variable-count batched write
+        would re-specialize per hit length); fine for the contiguous
+        mode's scale — production paged serving shares pages with zero
+        copies instead."""
+        if self._insert_prefix_c is None:
+            def fn(global_caches, kblk, vblk, slot, start):
+                TRACE_COUNTS["prefix_insert"] += 1
+                out = []
+                for i, (gk, gv) in enumerate(global_caches):
+                    gk = jax.lax.dynamic_update_slice(
+                        gk, kblk[i][None].astype(gk.dtype),
+                        (slot, start, 0, 0))
+                    gv = jax.lax.dynamic_update_slice(
+                        gv, vblk[i][None].astype(gv.dtype),
+                        (slot, start, 0, 0))
+                    out.append((gk, gv))
+                return out
+            self._insert_prefix_c = jax.jit(fn, donate_argnums=(0,))
+        return self._insert_prefix_c
+
+    def _read_block_contig(self):
+        """Slice one block of a slot's rows out of every layer's
+        contiguous cache, stacked [n_layers, B, kvh, d] — the store's
+        materialized copy of a fresh prefix block."""
+        if self._read_block_c is None:
+            B = self._prefix_block
+
+            def fn(global_caches, slot, start):
+                TRACE_COUNTS["prefix_read"] += 1
+                ks, vs = [], []
+                for gk, gv in global_caches:
+                    sz = (1, B) + gk.shape[2:]
+                    ks.append(jax.lax.dynamic_slice(
+                        gk, (slot, start, 0, 0), sz)[0])
+                    vs.append(jax.lax.dynamic_slice(
+                        gv, (slot, start, 0, 0), sz)[0])
+                return jnp.stack(ks), jnp.stack(vs)
+            self._read_block_c = jax.jit(fn)
+        return self._read_block_c
+
+    def _copy_page(self):
+        """Copy-on-write device copy: duplicate page ``src`` into
+        ``dst`` across every layer's pool (src/dst are traced scalars —
+        one specialization ever)."""
+        if self._copy_page_c is None:
+            def fn(layer_caches, src, dst):
+                TRACE_COUNTS["page_copy"] += 1
+                out = []
+                for c in layer_caches:
+                    kp = jax.lax.dynamic_update_slice_in_dim(
+                        c.k_pages,
+                        jax.lax.dynamic_slice_in_dim(c.k_pages, src, 1,
+                                                     axis=1),
+                        dst, axis=1)
+                    vp = jax.lax.dynamic_update_slice_in_dim(
+                        c.v_pages,
+                        jax.lax.dynamic_slice_in_dim(c.v_pages, src, 1,
+                                                     axis=1),
+                        dst, axis=1)
+                    out.append(PagedLayerCache(kp, vp))
+                return out
+            self._copy_page_c = jax.jit(fn, donate_argnums=(0,))
+        return self._copy_page_c
 
     def _decode(self):
         if self._decode_c is None:
@@ -421,15 +618,348 @@ class ContinuousBatchingEngine:
                 fn, static_argnums=(8,), donate_argnums=(2,))
         return self._decode_nc
 
+    # ---------------- prefix cache ----------------
+    def _match_prefix(self, req: Request):
+        """Longest cached block-aligned prefix for ``req.prompt``:
+        (hashes, matched entries, prefix_len, full_cover), with the
+        full-cover clamp — a fully-cached prompt still recomputes its
+        LAST token so prefill has a row to sample from (``full_cover``
+        reports that the clamp fired: the recompute row lands inside
+        the last shared page). The single site for the clamp rule:
+        both cache modes' admission arms go through here."""
+        if req._hashes is None:
+            req._hashes = block_hashes(req.prompt, self._prefix_block)
+        hashes = req._hashes
+        matched = self._prefix.match(hashes)
+        prefix_len = len(matched) * self._prefix_block
+        full_cover = prefix_len >= req.prompt.size
+        if full_cover:
+            prefix_len = req.prompt.size - 1
+        return hashes, matched, prefix_len, full_cover
+
+    def _note_prefix(self, prefix_len: int, n: int):
+        if n < self._prefix_block:
+            # no full block: block_hashes yields nothing, so the prompt
+            # can never hit — counting it as a miss would drag the
+            # hit-rate toward 0 on short-prompt traffic the cache was
+            # never meant to serve
+            return
+        st = self.prefix_stats
+        st["prompt_tokens"] += n
+        if prefix_len > 0:
+            st["hits"] += 1
+            st["hit_tokens"] += prefix_len
+        else:
+            st["misses"] += 1
+        if self._tel is not None:
+            self._tel.on_prefix(prefix_len, n, self._prefix.cached_pages)
+
+    def _evict_pages(self, n_pages: int) -> int:
+        """Reclaim pool pages from cache-only prefix entries (LRU)."""
+        if self._prefix is None or not self.cfg.paged:
+            return 0
+        freed = self._prefix.evict(self.pool, n_pages)
+        if freed:
+            self.prefix_stats["evictions"] += freed
+            if self._tel is not None:
+                self._tel.on_prefix_evict(freed,
+                                          self._prefix.cached_pages)
+        return freed
+
+    def _cow_block(self, slot: int, block_idx: int) -> bool:
+        """Copy-on-write the shared page at ``block_idx`` of ``slot``:
+        fresh page (evicting if the free list is dry), device copy,
+        block-table swap. False when no page can be found."""
+        old = int(self.pool.block_tables[slot, block_idx])
+        if self.pool.free_pages == 0 and not self._evict_pages(1):
+            return False
+        new = self.pool.cow(slot, block_idx)
+        if new is None:
+            return False
+        with self._ctx():
+            self.layer_caches = self._copy_page()(
+                self.layer_caches, old, new)
+        self.prefix_stats["cow_copies"] += 1
+        return True
+
+    def _cow_for_decode(self, k_steps: int):
+        """Before a decode dispatch: every page the next ``k_steps``
+        appends can touch must be exclusively owned — a shared page
+        (prefix store or another slot holds a ref) is copied first, so
+        a decode write can never mutate a cached prefix entry. The
+        admission path's block-aligned sharing makes this structurally
+        rare (writes land past the shared prefix), but it is the
+        invariant the prefix cache's correctness rests on — so the
+        check deliberately reads the pool's REAL refcounts for the
+        write-window pages (≤2 per slot per dispatch), not admission
+        bookkeeping: it must catch sharing from any source, as the
+        guard test's external retain() does."""
+        if self._prefix is None or not self.cfg.paged \
+                or self.pool.shared_pages == 0:
+            return
+        ps = self.cfg.page_size
+        for slot in range(self.cfg.max_slots):
+            if not self.active[slot]:
+                continue
+            lo = int(self.seq_lens[slot]) // ps
+            hi = (int(self.seq_lens[slot]) + max(k_steps, 1) - 1) // ps
+            n_have = len(self.pool.pages_of[slot])
+            for b_idx in range(lo, min(hi, n_have - 1) + 1):
+                page = int(self.pool.block_tables[slot, b_idx])
+                if self.pool.ref.get(page, 0) > 1:
+                    if not self._cow_block(slot, b_idx):
+                        raise RuntimeError(
+                            "copy-on-write needs a free page but the "
+                            "pool is exhausted — size n_pages up")
+
+    def _paged_prefix_admit(self, slot: int, req: Request, need: int):
+        """Claim pages for a request, sharing the longest cached
+        block-aligned prefix. Returns (prefix_len, hashes) or None when
+        the pool can't fit the request (slot left clean). A FULL-cover
+        hit (prompt entirely cached) adopts every matched page and
+        recomputes only the last token — the page it rewrites is
+        shared, so it is copy-on-written first."""
+        pool, store = self.pool, self._prefix
+        hashes: List[bytes] = []
+        shared: List[int] = []
+        prefix_len = 0
+        full_cover = False
+        if store is not None:
+            hashes, shared, prefix_len, full_cover = \
+                self._match_prefix(req)
+        # feasibility precheck: pages the slot still needs from the
+        # free list (adopted pages aren't on it; the full-cover COW
+        # consumes one more). A pool-blocked request retries every
+        # scheduler tick — without this gate each retry would pay the
+        # adopt/release churn, a wasted COW device copy, and worst of
+        # all drain LRU store entries via eviction that can't cover
+        # the shortfall anyway.
+        required = pool.pages_needed(need) - len(shared)
+        if full_cover and shared:
+            required += 1  # the COW's fresh private page
+        supply = pool.free_pages
+        if required > supply and store is not None:
+            supply += store.evictable_pages(pool, exclude=shared)
+            if full_cover and shared \
+                    and pool.ref.get(shared[-1], 0) == 1:
+                # the COW un-borrows the last shared page (back to
+                # store-only), so eviction can reclaim it afterwards
+                supply += 1
+        if required > supply:
+            return None  # can't fit even after eviction
+        try:
+            if shared:
+                if not pool.adopt(slot, shared):
+                    # over-long share can't happen while add_request
+                    # bounds prompt+max_new to max_len — but a silent
+                    # no-op here would mean attending over sink pages
+                    raise RuntimeError(
+                        f"prefix share of {len(shared)} pages exceeds "
+                        f"max_pages_per_slot={pool.max_pages_per_slot}")
+                if full_cover:
+                    # the clamped recompute row ALWAYS lands inside the
+                    # last shared page (for page_size 1 it IS that
+                    # page, aligned or not — the modulo is no proxy)
+                    if not self._cow_block(slot, len(shared) - 1):
+                        # can't afford the copy: fall back to
+                        # recomputing the whole last block into a fresh
+                        # page instead
+                        pool.release(pool.pages_of[slot].pop())
+                        self.pool.block_tables[slot, len(shared) - 1] = 0
+                        prefix_len = (len(shared) - 1) * \
+                            self.cfg.page_size
+            if not pool.alloc(slot, need):
+                missing = pool.pages_needed(need) \
+                    - len(pool.pages_of[slot])
+                self._evict_pages(missing - pool.free_pages)
+                if not pool.alloc(slot, need):
+                    pool.free(slot)  # releases adopted refs too
+                    return None
+            return prefix_len, hashes
+        except BaseException:
+            # an error mid-claim (e.g. the COW device dispatch) must
+            # leave the slot clean: it never joined the wave's jobs
+            # list, so the admission rollback won't free it — stale
+            # adopted pages here would wedge the next adopt() or let a
+            # later occupant write SHARED pages without copy-on-write
+            pool.free(slot)
+            raise
+
+    def _prefix_store_insert(self, slot: int, prompt: np.ndarray,
+                             hashes: List[bytes], n_matched: int):
+        """After a request's prefill is dispatched, publish its full
+        prompt blocks to the store. Paged: refcount the slot's pages
+        (zero copies — the chunk programs already queued the writes on
+        the stream, so any future reader is ordered after them).
+        Contiguous: slice the new blocks out of the slot's rows."""
+        store = self._prefix
+        if store is None or not hashes:
+            return
+        B = self._prefix_block
+        if self.cfg.paged:
+            for i, digest in enumerate(hashes):
+                store.insert(digest, int(self.pool.block_tables[slot, i]),
+                             self.pool)
+        else:
+            for i in range(n_matched, len(hashes)):
+                if hashes[i] in store:
+                    continue
+                with self._ctx():
+                    k, v = self._read_block_contig()(
+                        self.caches, slot, i * B)
+                store.insert(hashes[i], k, v)
+            evicted = store.evictions - self.prefix_stats["evictions"]
+            if evicted > 0:
+                self.prefix_stats["evictions"] = store.evictions
+                if self._tel is not None:
+                    self._tel.on_prefix_evict(evicted,
+                                              store.cached_pages)
+
     # ---------------- scheduling ----------------
     def _admit_dispatch(self):
-        """Dispatch prefill + cache-insert programs for every admissible
-        queued request WITHOUT syncing the host. JAX dispatch is async:
-        the programs queue on the device stream (after any in-flight
-        decode chunk, which donated the caches these inserts consume),
-        so admission costs the host only Python time. Returns the
-        pending (req, slot, first_token_future) list for
+        """Dispatch prefill programs for every admissible queued request
+        WITHOUT syncing the host (JAX dispatch is async: everything
+        queues on the device stream behind any in-flight decode chunk).
+        Default path: prefix-cache lookup + single-program CHUNKED
+        prefill; ``PT_FLAGS_prefill_chunk=0`` selects the legacy
+        per-bucket path (the parity oracle). Returns the pending
+        (req, slot, first_token_future) list for
         ``_admit_integrate``."""
+        if self._chunk_len:
+            return self._admit_dispatch_chunked()
+        return self._admit_dispatch_bucketed()
+
+    def _admit_dispatch_chunked(self):
+        """Chunked admission wave: claim slots + pages (prefix-aware)
+        for every admissible request, then drive ONE fixed-shape chunk
+        program over all of them together — request A's chunk 2 and
+        request B's chunk 0 ride the same call, packed behind the
+        in-flight decode chunk. All-or-nothing on error: a failure
+        mid-wave rolls every claimed request back into the queue (FIFO
+        preserved) before propagating. Within one wave a request
+        cannot hit blocks published by an earlier request of the SAME
+        wave (store inserts land at the end); across waves it does."""
+        C = self._chunk_len
+        cfg = self.cfg
+        jobs = []  # [req, slot, prefix_len, hashes, n_matched, cursor]
+        try:
+            while self._queue and self._free_heap:
+                req = self._queue[0]
+                slot = self._free_heap[0]  # peek; claimed below
+                n = req.prompt.size
+                need = n + req.max_new_tokens
+                prefix_len, hashes, n_matched = 0, [], 0
+                if cfg.paged:
+                    got = self._paged_prefix_admit(slot, req, need)
+                    if got is None:
+                        if not self.active.any() and not jobs:
+                            raise RuntimeError(
+                                f"request {req.rid} needs "
+                                f"{self.pool.pages_needed(need)} pages "
+                                f"but the pool has "
+                                f"{self.pool.free_pages} free with no "
+                                "request running — size n_pages up")
+                        break  # pool exhausted: wait for a finisher
+                    prefix_len, hashes = got
+                    n_matched = prefix_len // cfg.page_size
+                elif self._prefix is not None:
+                    hashes, matched, prefix_len, _full = \
+                        self._match_prefix(req)
+                    n_matched = len(matched)
+                    B = self._prefix_block
+                    with self._ctx():
+                        for i, (kb, vb) in enumerate(matched):
+                            self.caches = self._insert_prefix_contig()(
+                                self.caches, kb, vb, slot, i * B)
+                self._queue.popleft()
+                heapq.heappop(self._free_heap)
+                self.active[slot] = True
+                req.slot = slot
+                self._slot_req[slot] = req
+                # last element: the prefill cursor (starts at the
+                # prefix boundary; _drive_prefill_chunks advances it —
+                # prefix_len itself stays pristine for the stats
+                # commit)
+                jobs.append(
+                    [req, slot, prefix_len, hashes, n_matched,
+                     prefix_len])
+            if not jobs:
+                return []
+            return self._drive_prefill_chunks(jobs)
+        except BaseException:
+            # all-or-nothing rollback: free claimed slots/pages and
+            # requeue in submission order so a caught admission error
+            # neither shrinks the engine nor strands a request
+            for req, slot, *_ in reversed(jobs):
+                self.active[slot] = False
+                self._slot_req.pop(slot, None)
+                req.slot = None
+                heapq.heappush(self._free_heap, slot)
+                if self.pool is not None:
+                    self.pool.free(slot)
+                self._queue.appendleft(req)
+            raise
+
+    def _drive_prefill_chunks(self, jobs):
+        """Host loop over suffix chunks for a wave of claimed requests.
+        Each iteration packs every still-prefilling request's next C
+        tokens into one [slots, C] call; slots with nothing to prefill
+        (or actively decoding) carry the ``start = max_len`` sentinel —
+        their writes drop in-program and their sampled output is
+        ignored."""
+        C = self._chunk_len
+        cfg = self.cfg
+        sentinel = cfg.max_len
+        pending = []
+        remaining = list(jobs)
+        # block tables are fixed once the claim loop ends — upload once
+        # per wave, not per chunk iteration
+        bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
+              else jnp.zeros((1,), jnp.int32))
+        while remaining:
+            ids = np.zeros((cfg.max_slots, C), np.int64)
+            start = np.full((cfg.max_slots,), sentinel, np.int32)
+            last_idx = np.zeros((cfg.max_slots,), np.int32)
+            finishing = []
+            for job in remaining:
+                req, slot, p = job[0], job[1], job[5]
+                take = min(C, req.prompt.size - p)
+                ids[slot, :take] = req.prompt[p:p + take]
+                start[slot] = p
+                if p + take >= req.prompt.size:
+                    last_idx[slot] = req.prompt.size - 1 - p
+                    finishing.append(job)
+                job[5] = p + take
+            self._key, sub = jax.random.split(self._key)
+            caches = self.layer_caches if cfg.paged else self.caches
+            with self._ctx():
+                toks, caches = self._prefill_chunked()(
+                    self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
+                    jnp.asarray(start), jnp.asarray(last_idx), sub)
+            if cfg.paged:
+                self.layer_caches = caches
+            else:
+                self.caches = caches
+            for job in finishing:
+                pending.append((job[0], job[1], toks[job[1]]))
+            done_slots = {j[1] for j in finishing}  # slots are unique
+            remaining = [j for j in remaining if j[1] not in done_slots]
+        # the wave is committed: only now do the prompts' blocks
+        # publish and hit/miss stats count — the all-or-nothing
+        # rollback path can't double-count a requeued request. Insert
+        # BEFORE note so the cached-pages gauge reflects this
+        # request's own published blocks.
+        for req, slot, prefix_len, hashes, n_matched, _cursor in jobs:
+            self._prefix_store_insert(slot, req.prompt, hashes,
+                                      n_matched)
+            if self._prefix is not None:
+                self._note_prefix(prefix_len, req.prompt.size)
+        return pending
+
+    def _admit_dispatch_bucketed(self):
+        """Legacy per-bucket admission (PT_FLAGS_prefill_chunk=0): one
+        jit specialization per seq bucket, whole-prompt recompute — the
+        pre-chunking trace, kept as the parity oracle."""
         pending = []
         while self._queue and self._free_heap:
             req = self._queue[0]
@@ -533,6 +1063,7 @@ class ContinuousBatchingEngine:
         if not self.active.any():
             return bool(self._queue)
         t0 = time.perf_counter()
+        self._cow_for_decode(1)
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
@@ -599,6 +1130,7 @@ class ContinuousBatchingEngine:
         # slots must not decode mid-chunk (their lengths land at
         # integrate)
         chunk_slots = self.active.copy()
+        self._cow_for_decode(K)
         budget = self._slot_budgets()
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
@@ -734,7 +1266,20 @@ class ContinuousBatchingEngine:
             "active": int(self.active.sum()),
             "max": self.cfg.max_slots,
         }
+        snap["prefix_cache"] = self.prefix_snapshot()
         return snap
+
+    def prefix_snapshot(self) -> dict:
+        """Prefix-cache effectiveness counters (plain host counters —
+        available even with PT_FLAGS_telemetry=off, which is how the
+        bench A/B reads hit rates)."""
+        st = dict(self.prefix_stats)
+        st["enabled"] = self._prefix is not None
+        st["cached_blocks"] = (self._prefix.cached_pages
+                               if self._prefix is not None else 0)
+        tot = st["prompt_tokens"]
+        st["hit_rate_tokens"] = (st["hit_tokens"] / tot) if tot else 0.0
+        return st
 
     def metrics_window_reset(self):
         """Reset percentile windows + peak trackers (cumulative

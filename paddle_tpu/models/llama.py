@@ -101,6 +101,19 @@ class LlamaConfig:
         return cls(**kw)
 
 
+def _chunk_history_mask(cache_index, s, ctx_len):
+    """Chunked-prefill causal mask, shared by both cache modes: slot
+    b's chunk occupies absolute rows ``cache_index[b] .. +s-1``, and
+    query row r may attend every cache position ``<= r`` (its own
+    chunk's earlier rows included — they were just appended). Returns
+    ``(rows [b, s], kv_mask [b, 1, s, ctx_len])``."""
+    rows = cache_index[:, None] + jnp.arange(
+        s, dtype=cache_index.dtype)[None, :]
+    kv_idx = jnp.arange(ctx_len)
+    kv_mask = kv_idx[None, None, None, :] <= rows[:, None, :, None]
+    return rows, kv_mask
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -182,6 +195,31 @@ class LlamaAttention(Layer):
                         rope_cos, rope_sin)
                     new_cache = (ck, cv)
                 out = og.reshape(b, 1, cfg.num_attention_heads, hd)
+            elif paged_mode and per_slot and s > 1:
+                # chunked prefill (paged): scatter the chunk's rows
+                # through the block table at each slot's own offset
+                # (positions past the table drop — the engine points
+                # non-participating slots at a max_len sentinel), then
+                # attend over the gathered page view with a per-row
+                # causal-history mask. Garbage rows past a slot's real
+                # tokens sit at HIGHER positions than every real query,
+                # so the mask hides them; decode overwrites them later.
+                # KNOWN TRADE: gather_kv materializes the full dense
+                # [slots, max_ctx] view per layer per chunk — the
+                # static shape is what keeps this path at ONE compile
+                # for every prompt length. A length-pruned Pallas
+                # chunked-prefill kernel (PR-3 style) is the follow-up
+                # that removes the traffic without re-specializing.
+                from ..inference.paged import append_kv_chunk, gather_kv
+
+                cache, state = kv_cache
+                cache = append_kv_chunk(cache, state, k, v, cache_index)
+                kg, vg = gather_kv(cache, state)
+                _, kv_mask = _chunk_history_mask(
+                    cache_index, s, kg.shape[1])
+                out = F.scaled_dot_product_attention(
+                    q, kg, vg, attn_mask=kv_mask, training=False)
+                new_cache = (cache, state)
             elif paged_mode:
                 # paged decode (s == 1): write this token's kv into its
                 # slot's page, then attend over the gathered page view
@@ -193,13 +231,19 @@ class LlamaAttention(Layer):
                 ck, cv = kv_cache
                 k = k.astype(ck.dtype)
                 v = v.astype(cv.dtype)
-                if per_slot:
+                if per_slot and s > 1:
+                    # chunked prefill (contiguous): slot b's chunk lands
+                    # at rows cache_index[b]..+s-1; mode="drop" makes
+                    # rows past max_len (the engine's "not prefilling
+                    # this call" sentinel) dropped writes, not clamps
+                    rows, kv_mask = _chunk_history_mask(
+                        cache_index, s, ck.shape[1])
+                    bidx = jnp.arange(b)[:, None]
+                    ck = ck.at[bidx, rows].set(k, mode="drop")
+                    cv = cv.at[bidx, rows].set(v, mode="drop")
+                elif per_slot:
                     # continuous batching: each slot writes at its own
                     # length (s == 1) and masks to its own history
-                    if s != 1:
-                        raise ValueError(
-                            "per-slot cache_index decoding is single-"
-                            f"token (s=1); got s={s}")
                     ck = ck.at[jnp.arange(b), cache_index].set(k[:, 0])
                     cv = cv.at[jnp.arange(b), cache_index].set(v[:, 0])
                     kv_idx = jnp.arange(ck.shape[1])

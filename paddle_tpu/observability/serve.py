@@ -67,6 +67,25 @@ class ServingTelemetry:
         self._tokens = reg.counter(
             "pt_serve_tokens_generated_total", "output tokens produced",
             L)
+        self._pfx_hits = reg.counter(
+            "pt_serve_prefix_cache_hits_total",
+            "admissions that reused a cached prompt prefix", L)
+        self._pfx_misses = reg.counter(
+            "pt_serve_prefix_cache_misses_total",
+            "admissions with no cached prefix", L)
+        self._pfx_hit_tokens = reg.counter(
+            "pt_serve_prefix_cache_hit_tokens_total",
+            "prompt tokens served from the prefix cache", L)
+        self._pfx_prompt_tokens = reg.counter(
+            "pt_serve_prefix_cache_prompt_tokens_total",
+            "prompt tokens submitted through prefix lookup", L)
+        self._pfx_evict = reg.counter(
+            "pt_serve_prefix_cache_evictions_total",
+            "prefix blocks/pages evicted (LRU)", L)
+        self._pfx_cached = reg.gauge(
+            "pt_serve_prefix_cached_pages",
+            "prefix blocks/pages currently resident in the store", L)
+
     def _lab(self) -> dict:
         return {"engine": self.engine_id}
 
@@ -84,6 +103,24 @@ class ServingTelemetry:
 
     def on_finish(self):
         self._finished.inc(**self._lab())
+
+    def on_prefix(self, hit_tokens: int, prompt_tokens: int,
+                  cached_blocks: int):
+        lab = self._lab()
+        (self._pfx_hits if hit_tokens > 0 else self._pfx_misses).inc(**lab)
+        if hit_tokens > 0:
+            self._pfx_hit_tokens.inc(hit_tokens, **lab)
+        self._pfx_prompt_tokens.inc(prompt_tokens, **lab)
+        self._pfx_cached.set(cached_blocks, **lab)
+
+    def on_prefix_evict(self, n: int = 1,
+                        cached_blocks: Optional[int] = None):
+        lab = self._lab()
+        self._pfx_evict.inc(n, **lab)
+        if cached_blocks is not None:
+            # keep the residency gauge honest between admissions —
+            # evictions under pure decode pressure must show up too
+            self._pfx_cached.set(cached_blocks, **lab)
 
     def on_tokens(self, n_tokens: int, wall_ms: float):
         if n_tokens <= 0:
@@ -144,6 +181,14 @@ class ServingTelemetry:
                 "finished": self._finished.value(**lab),
             },
             "tokens_generated": self._tokens.value(**lab),
+            "prefix_cache": {
+                "hits": self._pfx_hits.value(**lab),
+                "misses": self._pfx_misses.value(**lab),
+                "hit_tokens": self._pfx_hit_tokens.value(**lab),
+                "prompt_tokens": self._pfx_prompt_tokens.value(**lab),
+                "evictions": self._pfx_evict.value(**lab),
+                "cached_blocks": self._pfx_cached.value(**lab),
+            },
         }
 
     def window_reset(self):

@@ -47,6 +47,20 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def compile_counter():
+    """Compile-count guard for engine tests: returns a callable giving
+    the number of jit SPECIALIZATIONS of a named serving program since
+    the fixture was set up (trace-time counters in
+    ``paddle_tpu.inference.serving.TRACE_COUNTS``). The regression this
+    exists to prevent: chunked prefill silently re-specializing per
+    prompt length / seq bucket."""
+    from paddle_tpu.inference import serving
+
+    base = serving.TRACE_COUNTS.copy()
+    return lambda key: serving.TRACE_COUNTS[key] - base[key]
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt

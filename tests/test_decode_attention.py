@@ -253,51 +253,67 @@ def test_engine_free_slot_heap_and_bucket_lookup():
     assert all(r.done for r in reqs)
     assert eng._free_slots() == [0, 1, 2]
     assert not eng.active.any()
-    # a claimed slot is returned to the heap when admission fails
-    # mid-dispatch (the heap no longer self-heals from the active mask)
-    eng.add_request(np.arange(1, 5), max_new_tokens=4)
-    import pytest as _pytest
 
-    def boom(*a, **k):
-        raise RuntimeError("prefill exploded")
+    # the failure-injection halves below pin the LEGACY bucketed
+    # admission path (per-request dispatch; partial-batch integration)
+    # — the chunked path's all-or-nothing rollback is pinned in
+    # tests/test_prefix_prefill.py
+    from paddle_tpu import flags as F
 
-    eng._prefill_c = boom
-    with _pytest.raises(RuntimeError, match="prefill exploded"):
-        eng._admit()
-    eng._prefill_c = None
-    assert eng._free_slots() == [0, 1, 2]
-    assert len(eng._queue) == 1  # request requeued, not dropped
-    while eng.step_chunk(4) or eng._queue or eng.active.any():
-        pass
-    assert all(r.done for r in eng._finished.values())
+    saved_chunk = F.flag("prefill_chunk")
+    F.set_flags({"prefill_chunk": 0})
+    try:
+        eng = ContinuousBatchingEngine(model, EngineConfig(
+            max_slots=3, max_len=64, seq_buckets=(8, 16, 128)))
+        # a claimed slot is returned to the heap when admission fails
+        # mid-dispatch (the heap no longer self-heals from the active
+        # mask)
+        eng.add_request(np.arange(1, 5), max_new_tokens=4)
+        import pytest as _pytest
 
-    # partial-batch failure: first request admits, second prefill blows
-    # up — the admitted one must be INTEGRATED (length + first token),
-    # the failed one requeued, and both complete after recovery
-    real = eng._prefill()
-    calls = {"n": 0}
+        def boom(*a, **k):
+            raise RuntimeError("prefill exploded")
 
-    def flaky(*a, **k):
-        calls["n"] += 1
-        if calls["n"] == 2:
-            raise RuntimeError("second prefill exploded")
-        return real(*a, **k)
+        eng._prefill_c = boom
+        with _pytest.raises(RuntimeError, match="prefill exploded"):
+            eng._admit()
+        eng._prefill_c = None
+        assert eng._free_slots() == [0, 1, 2]
+        assert len(eng._queue) == 1  # request requeued, not dropped
+        while eng.step_chunk(4) or eng._queue or eng.active.any():
+            pass
+        assert all(r.done for r in eng._finished.values())
 
-    eng._prefill_c = flaky
-    p1, p2 = np.arange(1, 5), np.arange(2, 8)
-    r1 = eng.add_request(p1, max_new_tokens=3)
-    r2 = eng.add_request(p2, max_new_tokens=3)
-    with _pytest.raises(RuntimeError, match="second prefill"):
-        eng._admit()
-    slot1 = next(s for s, r in eng._slot_req.items() if r.rid == r1)
-    assert eng.seq_lens[slot1] == p1.size  # integrated, not stranded
-    assert len(eng._slot_req[slot1].output) == 1
-    eng._prefill_c = real
-    while eng.step_chunk(4) or eng._queue or eng.active.any():
-        pass
-    assert eng._finished[r1].done and eng._finished[r2].done
-    ref = ContinuousBatchingEngine(model, EngineConfig(
-        max_slots=3, max_len=64, seq_buckets=(8, 16, 128))).run(
-        [p1, p2], max_new_tokens=3)
-    assert eng._finished[r1].output == ref[0].output
-    assert eng._finished[r2].output == ref[1].output
+        # partial-batch failure: first request admits, second prefill
+        # blows up — the admitted one must be INTEGRATED (length +
+        # first token), the failed one requeued, and both complete
+        # after recovery
+        real = eng._prefill()
+        calls = {"n": 0}
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("second prefill exploded")
+            return real(*a, **k)
+
+        eng._prefill_c = flaky
+        p1, p2 = np.arange(1, 5), np.arange(2, 8)
+        r1 = eng.add_request(p1, max_new_tokens=3)
+        r2 = eng.add_request(p2, max_new_tokens=3)
+        with _pytest.raises(RuntimeError, match="second prefill"):
+            eng._admit()
+        slot1 = next(s for s, r in eng._slot_req.items() if r.rid == r1)
+        assert eng.seq_lens[slot1] == p1.size  # integrated, not stranded
+        assert len(eng._slot_req[slot1].output) == 1
+        eng._prefill_c = real
+        while eng.step_chunk(4) or eng._queue or eng.active.any():
+            pass
+        assert eng._finished[r1].done and eng._finished[r2].done
+        ref = ContinuousBatchingEngine(model, EngineConfig(
+            max_slots=3, max_len=64, seq_buckets=(8, 16, 128))).run(
+            [p1, p2], max_new_tokens=3)
+        assert eng._finished[r1].output == ref[0].output
+        assert eng._finished[r2].output == ref[1].output
+    finally:
+        F.set_flags({"prefill_chunk": saved_chunk})

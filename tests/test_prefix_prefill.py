@@ -1,0 +1,487 @@
+"""Prefix-aware serving prefill: shared-prefix KV reuse (copy-on-write
+pages / copied token blocks) + the single-program chunked prefill.
+
+The contract under test:
+  - token-level parity: ``PT_FLAGS_prefix_cache=on`` greedy outputs are
+    IDENTICAL to the ``off`` path in both cache modes (incl. bf16
+    caches) — a cached block holds bit-identical KV to a recompute;
+  - copy-on-write: a write to a shared page never mutates the cached
+    prefix entry;
+  - compile count: mixed prompt lengths drive ≤ 2 prefill
+    specializations (one, in practice) vs one-per-bucket legacy;
+  - admission back-pressure keeps FIFO order across pool exhaustion.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import flags as F
+from paddle_tpu.inference.prefix_cache import (
+    ContigPrefixStore,
+    PagedPrefixStore,
+    block_hashes,
+)
+from paddle_tpu.inference.serving import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+pytestmark = pytest.mark.fast
+
+
+def _model(seed=0):
+    import paddle_tpu as pt
+
+    pt.seed(seed)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+@pytest.fixture
+def serving_flags():
+    """set_flags with restore for the serving admission knobs."""
+    saved = {k: F.flag(k) for k in ("prefix_cache", "prefill_chunk")}
+    yield F.set_flags
+    F.set_flags(saved)
+
+
+def _ecfg(paged, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("seq_buckets", (16,))
+    kw.setdefault("cache_dtype", jnp.float32)
+    # paged: page size; contiguous: prefix block length
+    kw.setdefault("page_size", 8)
+    return EngineConfig(paged=paged, **kw)
+
+
+# ---------------- rolling hash / stores ----------------
+
+def test_block_hashes_chain():
+    p = np.arange(1, 40)
+    h = block_hashes(p, 8)
+    assert len(h) == 4  # 39 tokens -> 4 full blocks, tail unhashed
+    # chained: same leading blocks, different later block -> shared
+    # prefix digests equal, divergence point differs
+    q = p.copy()
+    q[20] += 1
+    h2 = block_hashes(q, 8)
+    assert h[:2] == h2[:2] and h[2] != h2[2] and h[3] != h2[3]
+    # chain property: block i's digest depends on everything before it
+    r = p.copy()
+    r[0] += 1
+    h3 = block_hashes(r, 8)
+    assert all(a != b for a, b in zip(h, h3))
+
+
+def test_contig_store_lru_cap():
+    store = ContigPrefixStore(max_blocks=2)
+    store.insert(b"a", 1, 1)
+    store.insert(b"b", 2, 2)
+    store.match([b"a"])  # refresh a -> b is now LRU
+    store.insert(b"c", 3, 3)
+    assert len(store) == 2 and store.evictions == 1
+    assert b"b" not in store and b"a" in store and b"c" in store
+
+
+def test_paged_store_evicts_lru_unborrowed_only():
+    from paddle_tpu.inference.paged import PagePool
+
+    pool = PagePool(n_pages=6, page_size=4, slots=2, max_pages_per_slot=4)
+    store = PagedPrefixStore()
+    assert pool.alloc(0, 8)  # pages for 2 blocks
+    p0, p1 = pool.pages_of[0]
+    store.insert(b"h0", p0, pool)
+    store.insert(b"h1", p1, pool)
+    pool.free(0)  # slot drops its refs; store keeps both pages alive
+    assert pool.free_pages == 4
+    # borrow p0 into slot 1 (ref 2) -> only p1 is evictable
+    assert pool.adopt(1, [p0])
+    freed = store.evict(pool, 2)
+    assert freed == 1 and b"h1" not in store and b"h0" in store
+    pool.free(1)
+    assert store.evict(pool, 1) == 1
+    assert pool.free_pages == 6
+
+
+# ---------------- satellites: config/request validation ----------------
+
+def test_empty_prompt_raises():
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    with pytest.raises(ValueError, match="non-empty prompt"):
+        eng.add_request(np.zeros((0,), np.int64), max_new_tokens=4)
+
+
+def test_seq_buckets_validated_and_normalized():
+    model, cfg = _model()
+    with pytest.raises(ValueError, match="non-empty"):
+        ContinuousBatchingEngine(model, _ecfg(False, seq_buckets=()))
+    with pytest.raises(ValueError, match="positive ints"):
+        ContinuousBatchingEngine(model, _ecfg(False, seq_buckets=(8, 0)))
+    with pytest.raises(ValueError, match="positive ints"):
+        ContinuousBatchingEngine(model,
+                                 _ecfg(False, seq_buckets=(8, 16.5)))
+    # unsorted + duplicated + oversized input normalizes (sorted,
+    # unique, clamped to max_len) instead of breaking the bisect lookup
+    eng = ContinuousBatchingEngine(model, _ecfg(
+        False, seq_buckets=(128, 16, 8, 16), max_len=32))
+    assert eng._buckets == [8, 16, 32]
+    assert eng._bucket(9) == 16 and eng._bucket(20) == 32
+
+
+def test_page_size_validated_in_both_modes():
+    """page_size is load-bearing in contiguous mode too (the prefix
+    hash block length) — a zero value must fail at init, not with a
+    ZeroDivisionError at first admission."""
+    model, cfg = _model()
+    for paged in (False, True):
+        with pytest.raises(ValueError, match="page_size"):
+            ContinuousBatchingEngine(model, _ecfg(paged, page_size=0))
+
+
+# ---------------- parity: prefix on == off, both modes ----------------
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("cache_dtype", [jnp.float32, jnp.bfloat16])
+def test_prefix_cache_token_parity(paged, cache_dtype, serving_flags):
+    """Greedy outputs for requests sharing a prefix must be identical
+    with the prefix cache on and off — cached blocks hold bit-identical
+    KV to a recompute (same chunk shapes, per-row math)."""
+    model, cfg = _model(3)
+    rng = np.random.default_rng(5)
+    shared = rng.integers(1, cfg.vocab_size, 24)  # 3 blocks of 8
+    prompts = [np.concatenate([shared, rng.integers(1, cfg.vocab_size, k)])
+               for k in (5, 9, 2)]
+    prompts.append(shared.copy())  # full-cover hit (block-aligned)
+
+    outs = {}
+    for arm in (True, False):
+        serving_flags({"prefix_cache": arm})
+        eng = ContinuousBatchingEngine(
+            model, _ecfg(paged, cache_dtype=cache_dtype))
+        got = []
+        for p in prompts:  # sequential: later requests can hit
+            got.append(eng.run([p], max_new_tokens=6)[0].output)
+        outs[arm] = got
+        if arm:
+            snap = eng.prefix_snapshot()
+            assert snap["hits"] >= 3 and snap["hit_tokens"] >= 3 * 24 - 1
+        else:
+            assert eng.prefix_snapshot()["hits"] == 0
+    assert outs[True] == outs[False]
+
+
+def test_prefix_hits_across_admission_waves(serving_flags):
+    """Batched run(): the first wave misses, later waves hit the blocks
+    the first wave published; outputs still match the off arm."""
+    model, cfg = _model(7)
+    rng = np.random.default_rng(11)
+    shared = rng.integers(1, cfg.vocab_size, 16)
+    prompts = [np.concatenate([shared, rng.integers(1, cfg.vocab_size, k)])
+               for k in (4, 6, 3, 8, 5)]
+    outs = {}
+    for arm in (True, False):
+        serving_flags({"prefix_cache": arm})
+        eng = ContinuousBatchingEngine(model, _ecfg(True))
+        reqs = eng.run(prompts, max_new_tokens=5)
+        outs[arm] = [r.output for r in reqs]
+    assert outs[True] == outs[False]
+
+
+# ---------------- copy-on-write ----------------
+
+def test_cow_write_never_mutates_cached_prefix():
+    """Full-cover hit: the new slot adopts every cached page and
+    recomputes only the last token — that write lands in a SHARED page
+    and must trigger a private copy, leaving the store's pages
+    bit-identical. Subsequent decode writes stay private too."""
+    model, cfg = _model(2)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, cfg.vocab_size, 16)  # exactly 2 pages of 8
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    ref = eng.run([prompt], max_new_tokens=8)[0].output
+    store = eng._prefix
+    pages = list(store._blocks.values())
+    assert len(pages) == 2
+    before = [[np.asarray(c.k_pages[:, p]).copy() for p in pages]
+              for c in eng.layer_caches]
+
+    out2 = eng.run([prompt], max_new_tokens=8)[0].output  # full cover
+    assert eng.prefix_stats["cow_copies"] >= 1
+    after = [[np.asarray(c.k_pages[:, p]) for p in pages]
+             for c in eng.layer_caches]
+    for lb, la in zip(before, after):
+        for b, a in zip(lb, la):
+            np.testing.assert_array_equal(b, a)
+    assert out2 == ref
+    # and a third request still reuses the untouched entries correctly
+    assert eng.run([prompt], max_new_tokens=8)[0].output == ref
+
+
+def test_cow_for_decode_guard_copies_shared_page():
+    """The defensive decode-time guard: if the page the next append
+    lands in is shared (simulated here by pinning it into the store),
+    the engine copies it before dispatching the decode chunk."""
+    model, cfg = _model(4)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, cfg.vocab_size, 5)
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    rid = eng.add_request(prompt, max_new_tokens=6)
+    eng._admit()
+    slot = eng._slot_req[0].slot
+    # pin the page decode is about to write (position 5 -> block 0)
+    page = int(eng.pool.block_tables[slot, 0])
+    eng.pool.retain(page)
+    snap = np.asarray(eng.layer_caches[0].k_pages[:, page]).copy()
+    while eng.step():
+        pass
+    assert eng.prefix_stats["cow_copies"] >= 1
+    np.testing.assert_array_equal(
+        snap, np.asarray(eng.layer_caches[0].k_pages[:, page]))
+    assert eng._finished[rid].done
+    eng.pool.release(page)
+
+
+# ---------------- compile-count guard ----------------
+
+def test_chunked_prefill_compile_count(compile_counter):
+    """THE regression this PR exists to prevent: across a mixed-length
+    prompt sweep the chunked path must hold at ≤ 2 prefill
+    specializations (it is 1 by construction: the chunk shape is
+    fixed), where the legacy path compiles one per bucket."""
+    model, cfg = _model(6)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, n)
+               for n in (3, 7, 12, 19, 30, 45)]
+    eng = ContinuousBatchingEngine(model, _ecfg(
+        False, seq_buckets=(8, 16, 32, 64), max_len=64))
+    eng.run(prompts, max_new_tokens=3)
+    assert compile_counter("prefill_chunk") <= 2
+    assert compile_counter("prefill_chunk") >= 1
+    assert compile_counter("prefill_bucket") == 0
+
+
+def test_legacy_bucketed_path_compiles_per_bucket(compile_counter,
+                                                  serving_flags):
+    """PT_FLAGS_prefill_chunk=0 reproduces the per-bucket trace (the
+    parity oracle) — and its outputs match the chunked path's."""
+    model, cfg = _model(6)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, n)
+               for n in (3, 12, 30)]  # buckets 8, 16, 32
+    chunked = ContinuousBatchingEngine(model, _ecfg(
+        False, seq_buckets=(8, 16, 32, 64), max_len=64))
+    ref = [r.output for r in chunked.run(prompts, max_new_tokens=4)]
+    chunk_traces_before = compile_counter("prefill_chunk")
+
+    serving_flags({"prefill_chunk": 0})
+    eng = ContinuousBatchingEngine(model, _ecfg(
+        False, seq_buckets=(8, 16, 32, 64), max_len=64))
+    assert eng._prefix is None  # prefix reuse rides the chunked path
+    got = [r.output for r in eng.run(prompts, max_new_tokens=4)]
+    assert compile_counter("prefill_bucket") == 3  # one per bucket hit
+    assert compile_counter("prefill_chunk") == chunk_traces_before
+    assert got == ref
+
+
+def test_prefill_chunk_floor_of_two(serving_flags):
+    """prefill_chunk=1 must clamp to 2: a 1-token chunk program would
+    take the models' s == 1 decode branch, whose append CLAMPS the
+    idle-slot start=max_len sentinel into a real page (corrupting a
+    decoding slot's KV) instead of dropping it. Regression: admit a
+    request mid-decode at the degenerate chunk size and check the
+    in-flight request's output is unaffected."""
+    model, cfg = _model(5)
+    rng = np.random.default_rng(6)
+    # fully-allocated block table: prompt 8 + 8 new == 2 whole pages
+    pa = rng.integers(1, cfg.vocab_size, 8)
+    pb = rng.integers(1, cfg.vocab_size, 8)
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        [pa], max_new_tokens=8)[0].output
+
+    serving_flags({"prefill_chunk": 1})
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    assert eng._chunk_len == 2
+    ra = eng.add_request(pa, max_new_tokens=8)
+    eng.step()  # admit A, decode one token
+    eng.step()
+    rb = eng.add_request(pb, max_new_tokens=4)  # admission mid-decode
+    while eng.step() or eng._queue or eng.active.any():
+        pass
+    assert eng._finished[ra].output == ref  # A's KV never corrupted
+    assert eng._finished[rb].done
+
+
+# ---------------- admission back-pressure ----------------
+
+def test_backpressure_fifo_after_pool_exhaustion(serving_flags):
+    """When PagePool.alloc fails mid-queue the admission loop breaks;
+    requests behind the blocked head must be admitted AFTER a finisher
+    frees pages, in FIFO order (the prefix store's retained pages are
+    evicted, not deadlocked, under that pressure)."""
+    model, cfg = _model(8)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, cfg.vocab_size, 8) for _ in range(3)]
+    # pool: sink + 2 pages == exactly one request (8 prompt + 8 new)
+    eng = ContinuousBatchingEngine(model, EngineConfig(
+        max_slots=3, max_len=32, seq_buckets=(8,), paged=True,
+        page_size=8, n_pages=3, cache_dtype=jnp.float32))
+    rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    admit_wave = {}
+    wave = 0
+    while eng.step() or eng._queue or eng.active.any():
+        wave += 1
+        done_or_running = ({r.rid for r in eng._slot_req.values()}
+                          | set(eng._finished))
+        for rid in rids:
+            if rid in done_or_running and rid not in admit_wave:
+                admit_wave[rid] = wave
+    for rid in rids:
+        assert eng._finished[rid].done
+    # FIFO preserved, and back-pressure actually happened (the pool
+    # can't hold two requests at once)
+    assert admit_wave[rids[0]] <= admit_wave[rids[1]] <= \
+        admit_wave[rids[2]]
+    assert admit_wave[rids[1]] > admit_wave[rids[0]]
+    # freeing required evicting the finished requests' cached pages
+    assert eng.prefix_stats["evictions"] >= 1
+    # sequential parity unaffected by the waves
+    serving_flags({"prefix_cache": False})
+    ref_eng = ContinuousBatchingEngine(model, EngineConfig(
+        max_slots=3, max_len=32, seq_buckets=(8,), paged=True,
+        page_size=8, cache_dtype=jnp.float32))
+    refs = ref_eng.run(prompts, max_new_tokens=8)
+    for rid, ref in zip(rids, refs):
+        assert eng._finished[rid].output == ref.output
+
+
+def test_blocked_admission_does_not_churn_prefix_store(serving_flags):
+    """A pool-blocked request retries admission every scheduler tick;
+    the feasibility precheck must turn those retries into pure host
+    bookkeeping — no COW device copy, and above all no LRU eviction
+    that drains the store without admitting anyone."""
+    model, cfg = _model(8)
+    rng = np.random.default_rng(7)
+    P = rng.integers(1, cfg.vocab_size, 8)   # the shared prompt
+    Q = rng.integers(1, cfg.vocab_size, 8)   # the long-runner
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        [P], max_new_tokens=8)[0].output
+
+    eng = ContinuousBatchingEngine(model, EngineConfig(
+        max_slots=2, max_len=32, seq_buckets=(8,), paged=True,
+        page_size=8, n_pages=5, cache_dtype=jnp.float32))
+    assert eng.run([P], max_new_tokens=8)[0].output == ref  # publish P
+    assert len(eng._prefix) == 1
+    rb = eng.add_request(Q, max_new_tokens=16)  # 3 of the 4 pool pages
+    eng.step()                                  # admit the long-runner
+    rc = eng.add_request(P, max_new_tokens=8)   # full-cover hit; blocked
+    cows0 = eng.prefix_stats["cow_copies"]
+    blocked_ticks = 0
+    for _ in range(8):
+        eng.step()
+        if not eng._queue:
+            break
+        blocked_ticks += 1
+        # blocked retries must leave the store and pool untouched
+        # (2 entries: P's block + the long-runner's block, published
+        # at ITS admission commit)
+        assert len(eng._prefix) == 2
+        assert eng.prefix_stats["evictions"] == 0
+        assert eng.prefix_stats["cow_copies"] == cows0
+    assert blocked_ticks > 0  # back-pressure actually happened
+    while eng.step() or eng._queue or eng.active.any():
+        pass
+    assert eng._finished[rb].done
+    # the cached prefix SURVIVED the blocked period and served the hit
+    assert eng._finished[rc].output == ref
+    assert eng.prefix_stats["hits"] >= 1
+    assert eng.prefix_stats["cow_copies"] > cows0
+
+
+def test_prefill_rollback_on_admission_error(serving_flags):
+    """A failure mid-wave rolls every claimed request back (slot,
+    pages, queue position) — the engine must not shrink."""
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    # one full prefix block (8 tokens) + 1: stats-eligible, uncached
+    rid = eng.add_request(np.arange(1, 10), max_new_tokens=4)
+    orig = eng._drive_prefill_chunks
+
+    def boom(jobs):
+        raise RuntimeError("injected")
+
+    eng._drive_prefill_chunks = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        eng._admit()
+    assert len(eng._queue) == 1 and not eng.active.any()
+    assert len(eng._free_heap) == eng.cfg.max_slots
+    assert eng.pool.free_pages == eng.pool.n_pages - 1  # sink reserved
+    assert eng.prefix_stats["misses"] == 0  # rolled back: not counted
+    eng._drive_prefill_chunks = orig
+    out = eng.run([], max_new_tokens=4)  # drain the requeued request
+    assert eng._finished[rid].done
+    # stats count the request ONCE (commit-time, not claim-time)
+    assert eng.prefix_stats["misses"] == 1
+    assert eng.prefix_stats["prompt_tokens"] == 9
+
+
+def test_claim_failure_leaves_slot_clean():
+    """An error escaping the page-claim itself (here: the full-cover
+    COW device dispatch) happens BEFORE the request joins the wave's
+    jobs list — the claim must free its own adopted pages, or the next
+    occupant adopts onto a dirty slot (wedge) / writes shared pages
+    without copy-on-write (corruption)."""
+    model, cfg = _model(2)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, cfg.vocab_size, 16)  # exactly 2 pages of 8
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    ref = eng.run([prompt], max_new_tokens=4)[0].output  # publish blocks
+    free_before = eng.pool.free_pages
+
+    def boom(*a, **k):
+        raise RuntimeError("cow dispatch failed")
+
+    eng._copy_page_c = None
+    eng._copy_page = boom  # full-cover hit must COW its last page
+    rid = eng.add_request(prompt, max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="cow dispatch"):
+        eng._admit()
+    # slot left clean: no leaked pages, nothing active, request queued
+    assert eng.pool.free_pages == free_before
+    assert all(not pages for pages in eng.pool.pages_of.values())
+    assert not eng.active.any() and len(eng._queue) == 1
+    # recovery: restore the program and the request completes correctly
+    del eng._copy_page
+    eng._copy_page_c = None
+    out = eng.run([], max_new_tokens=4)
+    assert eng._finished[rid].output == ref
+
+
+# ---------------- modeled prefill cost (kernelbench) ----------------
+
+def test_prefill_flops_proportional_to_suffix():
+    """Modeled-cost A/B: with prefix reuse, prefill FLOPs scale with
+    the SUFFIX rounded to the chunk — not with the seq bucket."""
+    from benchmarks.kernelbench import prefill_admission_flops
+
+    dims = dict(hidden=4096, inter=11008, n_layers=32, vocab=32000,
+                chunk=64, buckets=(512, 1024, 2048))
+    # 260-token prompt pays a 512 bucket on the legacy path
+    r = prefill_admission_flops(prompt_len=260, prefix_len=0, **dims)
+    assert r["bucket"] == 512
+    assert r["legacy_flops"] > r["chunked_flops"]
+    # shared prefix: FLOPs ∝ suffix, independent of the bucket
+    hit = prefill_admission_flops(prompt_len=260, prefix_len=256, **dims)
+    assert hit["chunked_prefix_flops"] < 0.3 * hit["chunked_flops"]
+    big = prefill_admission_flops(prompt_len=1500, prefix_len=1280,
+                                  **dims)
+    small = prefill_admission_flops(prompt_len=700, prefix_len=512,
+                                    **dims)
+    # ~same suffix (220 vs 188 tokens): same chunked+prefix cost class
+    # despite wildly different buckets/prompt lengths
+    assert big["chunked_prefix_flops"] < 1.5 * \
+        small["chunked_prefix_flops"]
+    assert big["legacy_flops"] > 2 * small["legacy_flops"]
