@@ -446,7 +446,44 @@ BASELINE_PATH = os.path.join(os.path.dirname(__file__),
                              "BENCH_BASELINE.json")
 DETAILS_PATH = os.path.join(os.path.dirname(__file__),
                             "BENCH_DETAILS.json")
+CAPTURE_PATH = os.path.join(os.path.dirname(__file__),
+                            "BENCH_TPU_CAPTURE.json")
 MAX_LINE_BYTES = 2000
+
+
+def _device_capture_pointer():
+    """Identity of the freshest COMMITTED device-plane capture
+    (timestamp + commit + headline metric), or None. When the tunnel
+    probe fails and the ledger line records a CPU fallback, this
+    pointer rides along so the driver artifact still names verifiable
+    device evidence instead of a bare smoke number (VERDICT r5
+    next-#2: three consecutive rounds of ``platform: cpu`` ledgers
+    with the real capture only discoverable by reading the repo)."""
+    try:
+        with open(CAPTURE_PATH) as f:
+            cap = json.load(f)
+        head = (cap.get("configs", {}) or {}).get(
+            cap.get("headline"), {}) or {}
+        out = {"captured_at": cap.get("captured_at"),
+               "metric": head.get("metric"), "value": head.get("value"),
+               "unit": head.get("unit")}
+        if not any(out.values()):
+            return None
+    except Exception:
+        return None
+    try:
+        r = subprocess.run(
+            ["git", "log", "-1", "--format=%h %cI", "--",
+             os.path.basename(CAPTURE_PATH)],
+            cwd=os.path.dirname(os.path.abspath(CAPTURE_PATH)),
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0 and r.stdout.strip():
+            sha, _, ciso = r.stdout.strip().partition(" ")
+            out["commit"] = sha
+            out["committed_at"] = ciso
+    except Exception:
+        pass  # pointer without provenance beats no pointer
+    return out
 
 
 def _compact_line(result):
@@ -485,6 +522,14 @@ def _compact_line(result):
         keep["details_error"] = details_error
     if "tpu_probe" in extra:
         keep["tpu_probe"] = "tpu unavailable; see BENCH_DETAILS.json"
+    if extra.get("platform") == "cpu":
+        # ANY cpu-plane headline (probe failure OR an explicit
+        # JAX_PLATFORMS=cpu run) names its device evidence — the
+        # ledger must never show a bare smoke number when a committed
+        # capture exists
+        ptr = _device_capture_pointer()
+        if ptr:
+            keep["last_device_capture"] = ptr
     sec = extra.get("secondary")
     if sec:
         keep["secondary"] = {}
@@ -505,7 +550,10 @@ def _compact_line(result):
             row.pop("error", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:
-        out["extra"] = {k: keep[k] for k in ("platform", "n_chips")
+        # the capture pointer survives the final shed: a truncated CPU
+        # fallback line must still name its device evidence
+        out["extra"] = {k: keep[k] for k in
+                        ("platform", "n_chips", "last_device_capture")
                         if k in keep}
         line = json.dumps(out)
     return line

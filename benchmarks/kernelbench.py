@@ -39,7 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
                      page_size=None, max_len=None, cache_bytes=2,
-                     act_bytes=2):
+                     act_bytes=2, n_tokens=1):
     """Modeled per-layer HBM bytes for one decode step's attention
     stage (RoPE + KV-append + attention over the cached KV) — the
     denominator of the decode roofline and the fused-vs-unfused A/B.
@@ -54,16 +54,23 @@ def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
       - UNFUSED additionally materializes rotated q/k to HBM (the RoPE
         pass writes them, the append/attention programs re-read them) —
         the two activation round-trips in-kernel RoPE removes.
+
+    ``n_tokens`` widens the pass to a MULTI-token step per slot — the
+    spec-decode verify program's ``[slots, K+1]`` shape: activations,
+    appends and the rope rows scale by it, and the cache stream rounds
+    ``len + n_tokens`` up to the streaming granularity. The per-layer
+    WEIGHT stream (the number spec decode amortizes) is not counted
+    here — attention-stage traffic only, same as the n_tokens=1 rows.
     """
     from paddle_tpu.kernels.decode_attention import contiguous_chunk
 
     slots = len(seq_lens)
-    q_elems = slots * kvh * group * d
-    kv_new_elems = slots * kvh * d
+    q_elems = slots * n_tokens * kvh * group * d
+    kv_new_elems = slots * n_tokens * kvh * d
     total = (q_elems + 2 * kv_new_elems) * act_bytes   # q, k_new, v_new
     total += q_elems * act_bytes                       # out write
     total += 2 * kv_new_elems * cache_bytes            # append row write
-    total += slots * d * 4                             # cos+sin rows
+    total += slots * n_tokens * d * 4                  # cos+sin rows
     if mode == "paged":
         gran = page_size
     elif mode == "contiguous":
@@ -71,7 +78,8 @@ def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
     else:
         raise ValueError(f"unknown cache mode {mode!r}")
     if gran is not None:
-        rows = sum(-(-(int(n) + 1) // gran) * gran for n in seq_lens)
+        rows = sum(-(-(int(n) + n_tokens) // gran) * gran
+                   for n in seq_lens)
     else:
         rows = slots * max_len
     total += 2 * rows * kvh * d * cache_bytes          # K+V stream
@@ -154,6 +162,74 @@ def prefill_cost_ab():
             buckets=(128, 256, 512, 1024, 2048))
         row["kernel"] = "prefill_admission_model"
         print(json.dumps(row), flush=True)
+
+
+def spec_decode_model(accept_rate, k, kvh, heads=32, d=128, n_layers=32,
+                      weight_bytes=None, seq_len=512, slots=8,
+                      page_size=64, cache_bytes=2):
+    """Modeled tokens-per-weight-stream A/B: plain decode vs
+    speculative decoding at a given per-draft acceptance rate (pure
+    python, runs anywhere).
+
+    Decode throughput is pinned by the per-pass HBM stream: every
+    forward pass re-reads ALL model weights plus the attention-stage
+    traffic. Plain decode buys 1 token per pass. A verify pass over K
+    drafts buys ``1 + Σ_{j=1..K} a^j`` expected tokens (greedy
+    acceptance is a PREFIX rule — draft j only counts if every earlier
+    draft matched, so independent per-draft acceptance ``a`` compounds
+    geometrically) while paying the same weight stream once and a
+    modestly wider attention stage (``decode_hbm_bytes`` at
+    ``n_tokens = K+1``). The n-gram drafter itself is host-side — zero
+    device bytes. ``modeled_speedup`` is the bytes-per-token ratio;
+    GQA (kvh) moves it by shrinking the attention share of the stream.
+    """
+    group = heads // kvh
+    lens = [seq_len] * slots
+    if weight_bytes is None:
+        # serve7b-class int8 weight-only stream: qkvo (GQA-sized kv)
+        # + gated MLP per layer + the lm head, 1 byte/param
+        hidden, inter, vocab = 4096, 11008, 32000
+        weight_bytes = n_layers * (
+            2 * hidden * hidden + 2 * hidden * kvh * d
+            + 3 * hidden * inter) + hidden * vocab
+    kw = dict(page_size=page_size, cache_bytes=cache_bytes)
+    attn_plain = n_layers * decode_hbm_bytes(
+        "paged", True, lens, kvh, group, d, **kw)
+    attn_verify = n_layers * decode_hbm_bytes(
+        "paged", True, lens, kvh, group, d, n_tokens=k + 1, **kw)
+    exp_tokens = 1.0 + sum(accept_rate ** j for j in range(1, k + 1))
+    plain_bytes_per_tok = (weight_bytes + attn_plain) / slots
+    spec_bytes_per_tok = (weight_bytes + attn_verify) / slots \
+        / exp_tokens
+    return {
+        "accept_rate": accept_rate,
+        "k": k,
+        "kvh": kvh,
+        "seq_len": seq_len,
+        "slots": slots,
+        "tokens_per_weight_stream": round(exp_tokens, 3),
+        "weight_bytes": int(weight_bytes),
+        "attn_bytes_plain": int(attn_plain),
+        "attn_bytes_verify": int(attn_verify),
+        "plain_bytes_per_token": int(plain_bytes_per_tok),
+        "spec_bytes_per_token": int(spec_bytes_per_tok),
+        "modeled_speedup": round(
+            plain_bytes_per_tok / spec_bytes_per_tok, 3),
+    }
+
+
+def spec_decode_cost_ab():
+    """Print the modeled spec-decode A/B at the serve7b decode shape
+    (pure cost model — runs on any backend): one JSON line per
+    (acceptance rate, GQA ratio) point, mirroring the prefill/decode
+    rows' format. 0.3 ~ adversarial traffic, 0.6 ~ mixed, 0.9 ~
+    repetitive (code/JSON/templated) — the regime the n-gram drafter
+    targets."""
+    for kvh in (1, 4, 8):
+        for a in (0.3, 0.6, 0.9):
+            row = spec_decode_model(a, k=4, kvh=kvh)
+            row["kernel"] = "spec_decode_model"
+            print(json.dumps(row), flush=True)
 
 
 def decode_bench():
@@ -305,10 +381,11 @@ def _rope_one(q, k_new, positions, cos, sin):
 
 
 def main():
-    # the modeled prefill A/B is pure Python — emit it on ANY backend,
-    # before the TPU-only guards (it is the only output a CPU/GPU host
-    # gets from this CLI)
+    # the modeled prefill + spec-decode A/Bs are pure Python — emit
+    # them on ANY backend, before the TPU-only guards (they are the
+    # only output a CPU/GPU host gets from this CLI)
     prefill_cost_ab()
+    spec_decode_cost_ab()
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # fail fast WITHOUT importing jax: with the tunnel down, axon
         # plugin registration can hang the interpreter for minutes

@@ -655,6 +655,91 @@ def _shared_prefix_scenario(model, base_ecfg, tpu):
     return out
 
 
+def _spec_ngram_scenario(model, base_ecfg, tpu):
+    """Speculative-decoding A/B under repetitive-suffix traffic (the
+    regime n-gram self-drafting targets: code, JSON, templated
+    answers). Prompts end in repeated template blocks; requests run
+    once with ``PT_FLAGS_spec_decode=ngram`` and once ``off`` through
+    the same scheduler; reports served tok/s, the acceptance rate the
+    drafter actually achieved, and — the quality claim — whether the
+    two arms' greedy outputs were identical. A short decode chunk
+    keeps draft opportunities frequent (each chunk boundary is one
+    propose-verify chance); both arms pay the same sync cadence so the
+    ratio isolates what verification buys."""
+    from paddle_tpu import flags as F
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.inference.spec_decode import Drafter, NgramDrafter
+
+    class _ForceDrafter(Drafter):
+        """Warm-up-only: always proposes (garbage is fine — rejection
+        still exercises the verify program), so the [slots, K+1]
+        compile deterministically lands in the warm-up, not the timed
+        window. The n-gram drafter can't guarantee that: its first
+        firing depends on what the model happens to emit."""
+
+        def propose(self, history, k):
+            return np.full((k,), int(history[-1]), np.int64)
+
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(11)
+    unit = rng.integers(0, vocab, (8,))
+    n_requests = 8 if tpu else 3
+    reps = 6 if tpu else 3
+    prompts = [np.concatenate(
+        [rng.integers(0, vocab, (4,))] + [unit] * reps)
+        for _ in range(n_requests)]
+    # long enough for greedy decode to fall into its attractor loop —
+    # the repetitive regime the drafter targets (and the chunked
+    # scheduler's preemption gate needs a MAJORITY of slots drafting
+    # in the same tick before a verify pass runs)
+    new_tokens = 48 if tpu else 32
+    max_chunk = 2
+    saved = F.flag("spec_decode")
+    out = {}
+    outputs = {}
+    try:
+        for arm in ("on", "off"):
+            F.set_flags({"spec_decode": "ngram" if arm == "on"
+                         else "off"})
+            eng = ContinuousBatchingEngine(
+                model, base_ecfg,
+                drafter=_ForceDrafter() if arm == "on" else None)
+            eng.run([prompts[0]], max_new_tokens=base_ecfg.spec_k + 2,
+                    max_chunk=max_chunk)
+            if arm == "on":
+                assert eng.spec_snapshot()["verify_calls"] > 0, \
+                    "warm-up never compiled the verify program"
+                eng._drafter = NgramDrafter()  # the drafter under test
+            eng._finished.clear()
+            # reported acceptance/verify stats cover the timed window
+            # only, not the warm-up's forced drafts
+            eng.spec_stats = {k: 0 for k in eng.spec_stats}
+            t0 = time.perf_counter()
+            reqs = eng.run(prompts, max_new_tokens=new_tokens,
+                           max_chunk=max_chunk)
+            dt = time.perf_counter() - t0
+            toks = sum(len(r.output) for r in reqs)
+            snap = eng.spec_snapshot()
+            outputs[arm] = [r.output for r in reqs]
+            out[arm] = {
+                "tokens_per_sec": round(toks / dt, 1),
+                "acceptance_rate": round(snap["acceptance_rate"], 3),
+                "proposed": snap["proposed"],
+                "accepted": snap["accepted"],
+                "verify_calls": snap["verify_calls"],
+                "fallback_steps": snap["fallback_steps"],
+            }
+            eng = None  # drop this arm's KV pool before the next builds
+    finally:
+        F.set_flags({"spec_decode": saved})
+    out["outputs_match"] = outputs["on"] == outputs["off"]
+    out["n_requests"] = n_requests
+    out["new_tokens"] = new_tokens
+    out["max_chunk"] = max_chunk
+    out["spec_k"] = base_ecfg.spec_k
+    return out
+
+
 def bench_serve7b(tpu_diags):
     """7B-class int8 weight-only decode through the paged continuous-
     batching engine — the first production-scale silicon path (VERDICT
@@ -705,10 +790,11 @@ def bench_serve7b(tpu_diags):
         max_slots=slots, max_len=max_len, seq_buckets=(128,),
         cache_dtype=cache_dtype, paged=True,
         page_size=64 if tpu else 32)
-    # shared-prefix A/B runs BEFORE the main engine exists: the
-    # scenario builds its own engines (one per arm), and two resident
-    # KV pools would double-book HBM on the 16 GB target
+    # shared-prefix + spec-decode A/Bs run BEFORE the main engine
+    # exists: each scenario builds its own engines (one per arm), and
+    # two resident KV pools would double-book HBM on the 16 GB target
     shared_prefix = _shared_prefix_scenario(model, ecfg, tpu)
+    spec_ngram = _spec_ngram_scenario(model, ecfg, tpu)
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
@@ -756,6 +842,7 @@ def bench_serve7b(tpu_diags):
     extra = {
         "params": n_params,
         "shared_prefix": shared_prefix,
+        "spec_ngram": spec_ngram,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,
             2 if cache_dtype == jnp.bfloat16 else 4),

@@ -110,6 +110,17 @@ define_flag("prefill_chunk", 256,
             "not the seq bucket, and compile count drops from "
             "len(seq_buckets) to 1. 0 = legacy per-bucket prefill (the "
             "parity oracle)")
+define_flag("spec_decode", "off",
+            "speculative decoding in the serving engine: draft K "
+            "candidate tokens per slot per step (host-side n-gram "
+            "prompt-lookup — no draft model weights) and score them in "
+            "ONE fixed [slots, K+1] target-model pass with in-jit "
+            "greedy acceptance, amortizing the per-step weight stream "
+            "over accepted+1 tokens. ngram = draft whenever the slot's "
+            "history matches; auto = ngram with a per-request throttle "
+            "that stops drafting traffic that never accepts; off = "
+            "today's one-token-per-pass decode (the parity oracle — "
+            "greedy outputs are identical in every mode)")
 define_flag("kv_cache_dtype", "auto",
             "serving KV-cache dtype when EngineConfig.cache_dtype is "
             "'auto': auto = bfloat16 on TPU (halves decode KV traffic), "

@@ -91,6 +91,37 @@ def process_logits(logits, temperature=1.0, top_k=0, top_p=1.0,
     return logits
 
 
+def process_logits_batch(logits, temperature, top_k, top_p):
+    """Vectorized per-ROW processor stack (temperature → top-k → top-p)
+    for the serving engine's per-request sampling params: every param is
+    a ``[batch]`` array traced into the compiled decode/prefill/verify
+    programs, so one program serves any mix of per-slot settings.
+    Per-row disables mirror the scalar stack: ``top_k <= 0`` and
+    ``top_p >= 1`` are no-ops for that row. Two deliberate deviations
+    from the scalar functions (which take static Python ints): top-k
+    cuts by sorted RANK, so ties at the k-th logit keep exactly k
+    entries rather than all tied ones, and the top-1 token always
+    survives both filters (the scalar top-p assumes p > 0; the vector
+    form must not emit an all -inf row for a degenerate per-slot p)."""
+    logits = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    b, v = logits.shape
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    rank = jnp.arange(v)[None, :]
+    drop_k = (top_k[:, None] > 0) & (rank >= top_k[:, None])
+    # scalar composition order: top-p's nucleus mass is computed over
+    # the TOP-K SURVIVORS' renormalized distribution, not the full one
+    probs = jax.nn.softmax(
+        jnp.where(drop_k, NEG_INF, sorted_logits), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    drop_p = (cum - probs) >= top_p[:, None]
+    drop_sorted = (drop_k | drop_p) & (rank > 0)
+    drop = jnp.zeros_like(drop_sorted).at[
+        jnp.arange(b)[:, None], sort_idx
+    ].set(drop_sorted)
+    return jnp.where(drop, NEG_INF, logits)
+
+
 def sample_token(logits, rng_key, temperature=1.0, top_k=0, top_p=1.0,
                  **kw):
     """One sampled token per row after the processor stack."""

@@ -355,6 +355,10 @@ from .serving import (  # noqa: E402,F401
     Request,
     start_metrics_server,
 )
+from .spec_decode import (  # noqa: E402,F401
+    Drafter,
+    NgramDrafter,
+)
 
 
 def create_predictor(model_or_config, config: Optional[Config] = None):

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import flags, observability
+from .. import flags, generation as G, observability
 from ..core.functional import (
     extract_buffers,
     extract_params,
@@ -42,6 +42,7 @@ from ..core.functional import (
 from ..core.module import Layer
 from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
 from .prefix_cache import ContigPrefixStore, PagedPrefixStore, block_hashes
+from .spec_decode import Drafter, NgramDrafter
 
 # trace-time compile accounting: each compiled-program body bumps its
 # counter exactly once per jit SPECIALIZATION (python runs at trace
@@ -73,6 +74,11 @@ class EngineConfig:
     greedy: bool = True
     temperature: float = 1.0
     seed: int = 0
+    # speculative decoding (PT_FLAGS_spec_decode): max draft tokens per
+    # slot per verify pass — the verify program's fixed token width is
+    # spec_k + 1 (drafts + the last accepted token), so this is a
+    # compile-time shape, not a runtime knob
+    spec_k: int = 4
 
 
 def _resolve_cache_dtype(requested):
@@ -129,10 +135,21 @@ class Request:
     ttft_ms: Optional[float] = None
     slot: Optional[int] = None
     done: bool = False
+    # per-request sampling params (None = engine-global config). Any
+    # explicit temperature/top_k/top_p implies sampling for this
+    # request; ``greedy`` overrides that inference either way.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    greedy: Optional[bool] = None
     _submit_t: float = 0.0
     # prompt block digests, computed once — a pool-blocked request is
     # re-matched every scheduler tick and must not re-hash each time
     _hashes: Optional[List[bytes]] = None
+    # speculative-decoding accounting (drives the auto-mode throttle
+    # and the engine's acceptance stats)
+    _spec_proposed: int = 0
+    _spec_accepted: int = 0
 
 
 class ContinuousBatchingEngine:
@@ -144,8 +161,14 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, model: Layer, config: Optional[EngineConfig] = None,
-                 mesh=None):
-        """``mesh``: optional ``jax.sharding.Mesh`` with a ``tp`` axis —
+                 mesh=None, drafter: Optional[Drafter] = None):
+        """``drafter``: optional ``spec_decode.Drafter`` override for
+        speculative decoding (default: ``NgramDrafter`` when
+        ``PT_FLAGS_spec_decode`` is ``ngram``/``auto`` — the flag gates
+        the path either way, so a custom drafter with the flag off is
+        inert).
+
+        ``mesh``: optional ``jax.sharding.Mesh`` with a ``tp`` axis —
         tensor-parallel serving (parity: the reference's multi-GPU
         FastDeploy/fleet predictor). Params shard by their logical
         ``Parameter.spec`` (Column/RowParallelLinear carry tp specs);
@@ -266,6 +289,7 @@ class ContinuousBatchingEngine:
 
         self._decode_c = None
         self._decode_nc = None
+        self._verify_c = None
         self._prefill_c = None
         self._insert_c = None
         self._scatter_c = None
@@ -303,6 +327,28 @@ class ContinuousBatchingEngine:
             "prompt_tokens": 0, "evictions": 0, "cow_copies": 0,
         }
 
+        # speculative decoding (PT_FLAGS_spec_decode): host-side n-gram
+        # drafting + ONE compiled [slots, spec_k+1] verify program.
+        # "off" keeps this path entirely dark — today's decode trace,
+        # bit for bit (the parity oracle the spec tests compare against)
+        mode = str(flags.flag("spec_decode")).lower()
+        if mode not in ("off", "ngram", "auto"):
+            raise ValueError(
+                f"PT_FLAGS_spec_decode must be off|ngram|auto; got "
+                f"{mode!r}")
+        if cfg.spec_k < 1:
+            raise ValueError(
+                f"EngineConfig.spec_k must be >= 1; got {cfg.spec_k}")
+        self._spec_mode = mode
+        self._drafter = None
+        if mode != "off":
+            self._drafter = drafter if drafter is not None \
+                else NgramDrafter()
+        self.spec_stats = {
+            "proposed": 0, "accepted": 0, "emitted": 0,
+            "verify_calls": 0, "fallback_steps": 0,
+        }
+
         # telemetry (None when PT_FLAGS_telemetry=off → scheduling loop
         # pays a single identity check per hook site)
         self._tel = (observability.ServingTelemetry()
@@ -329,7 +375,20 @@ class ContinuousBatchingEngine:
 
     # ---------------- request lifecycle ----------------
     def add_request(self, prompt, max_new_tokens: int = 32,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    temperature: Optional[float] = None,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    greedy: Optional[bool] = None) -> int:
+        """``temperature``/``top_k``/``top_p``: per-request sampling
+        params, routed through ``generation.process_logits_batch``
+        IN-JIT as per-slot vectors — setting any of them makes this
+        request sample (``greedy=True`` overrides back to argmax;
+        leaving all four ``None`` keeps the engine-global
+        ``EngineConfig.greedy``/``temperature`` behavior and its exact
+        compiled trace). Sampling requests never draft for speculative
+        decoding — greedy acceptance needs an argmax chain to verify
+        against."""
         prompt = np.asarray(prompt).reshape(-1)
         if prompt.size == 0:
             # an empty prompt would "sample" from the last PADDED
@@ -339,13 +398,93 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt({prompt.size}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds max_len={self.cfg.max_len}")
+        if temperature is not None and temperature <= 0:
+            raise ValueError(f"temperature must be > 0; got {temperature}")
+        if top_k is not None and top_k < 0:
+            raise ValueError(f"top_k must be >= 0; got {top_k}")
+        if top_p is not None and not 0 < top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
         req = Request(self._next_rid, prompt, max_new_tokens, eos_token_id,
-                      _submit_t=time.perf_counter())
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      greedy=greedy, _submit_t=time.perf_counter())
         self._next_rid += 1
         self._queue.append(req)
         if self._tel is not None:
             self._tel.on_submit(len(self._queue))
         return req.rid
+
+    def _req_greedy(self, req: Request) -> bool:
+        if req.greedy is not None:
+            return req.greedy
+        if (req.temperature is not None or req.top_k is not None
+                or req.top_p is not None):
+            return False  # explicit sampling params imply sampling
+        return self.cfg.greedy
+
+    def _req_nondefault(self, req: Request) -> bool:
+        """True when the request's EFFECTIVE next-token selection
+        differs from the engine-global config — only then must the
+        compiled programs take the per-slot sampling arm (and pay its
+        vocab sort). Merely *passing* an override that lands on the
+        default (``greedy=True`` on a greedy engine, ``top_k=0``,
+        ``top_p=1.0``, the engine's own temperature) keeps the plain
+        arm and its exact trace."""
+        g = self._req_greedy(req)
+        if g != bool(self.cfg.greedy):
+            return True
+        if g:
+            return False  # argmax is argmax; temp/top-k/top-p unused
+        return ((req.temperature is not None
+                 and req.temperature != self.cfg.temperature)
+                or bool(req.top_k)
+                or (req.top_p is not None and req.top_p < 1.0))
+
+    def _slot_sampling(self, reqs=None):
+        """(use_samp, per-slot param vectors) for the compiled
+        programs. ``use_samp`` is False when every live request rides
+        the engine-global config — the programs' static no-sampling arm
+        then reproduces the pre-per-request-params trace exactly (and
+        never pays the vocab sort). ``reqs``: optional explicit
+        (slot, Request) pairs (a prefill wave); defaults to the active
+        slot map."""
+        cfg = self.cfg
+        items = list(self._slot_req.items()) if reqs is None else reqs
+        greedy = np.full((cfg.max_slots,), bool(cfg.greedy))
+        temp = np.full((cfg.max_slots,), max(cfg.temperature, 1e-6),
+                       np.float32)
+        tk = np.zeros((cfg.max_slots,), np.int32)
+        tp = np.ones((cfg.max_slots,), np.float32)
+        use = False
+        for slot, req in items:
+            use = use or self._req_nondefault(req)
+            greedy[slot] = self._req_greedy(req)
+            if req.temperature is not None:
+                temp[slot] = max(req.temperature, 1e-6)
+            if req.top_k is not None:
+                tk[slot] = req.top_k
+            if req.top_p is not None:
+                tp[slot] = req.top_p
+        samp = (jnp.asarray(greedy), jnp.asarray(temp),
+                jnp.asarray(tk), jnp.asarray(tp))
+        return use, samp
+
+    def _sample_rows(self, rows, key, samp, use_samp):
+        """Next-token selection over ``[slots, vocab]`` rows inside the
+        compiled programs. The static ``use_samp`` arm routes per-slot
+        params through ``generation.process_logits_batch`` (greedy
+        slots keep pure argmax — a sampling neighbor can't perturb
+        them); the other arm is the engine-global config, compiled
+        exactly as before per-request params existed."""
+        if use_samp:
+            greedy_mask, temp, tk, tp = samp
+            g = jnp.argmax(rows, axis=-1)
+            s = jax.random.categorical(
+                key, G.process_logits_batch(rows, temp, tk, tp), axis=-1)
+            return jnp.where(greedy_mask, g, s)
+        if self.cfg.greedy:
+            return jnp.argmax(rows, axis=-1)
+        return jax.random.categorical(
+            key, rows / self.cfg.temperature, axis=-1)
 
     def _free_slots(self) -> List[int]:
         return sorted(self._free_heap)
@@ -361,7 +500,7 @@ class ContinuousBatchingEngine:
         # Samples the first token IN-JIT so only a scalar crosses to the
         # host — never the [1, bucket, vocab] logits tensor.
         if self._prefill_c is None:
-            def fn(pb, ids, caches, last_idx, key):
+            def fn(pb, ids, caches, last_idx, key, samp, use_samp):
                 TRACE_COUNTS["prefill_bucket"] += 1
                 pos = jnp.broadcast_to(
                     jnp.arange(ids.shape[1])[None, :], ids.shape)
@@ -369,13 +508,17 @@ class ContinuousBatchingEngine:
                     self.model, pb["p"], ids, position_ids=pos,
                     kv_caches=caches, cache_index=0, buffers=pb["b"])
                 last = logits[0, last_idx]
-                if self.cfg.greedy:
+                if use_samp:
+                    # single-request program: samp carries [1] vectors
+                    first = self._sample_rows(last[None], key, samp,
+                                              True)[0]
+                elif self.cfg.greedy:
                     first = jnp.argmax(last)
                 else:
                     first = jax.random.categorical(
                         key, last / self.cfg.temperature)
                 return first, filled
-            self._prefill_c = jax.jit(fn)
+            self._prefill_c = jax.jit(fn, static_argnums=(6,))
         return self._prefill_c
 
     def _insert_contig(self):
@@ -444,7 +587,8 @@ class ContinuousBatchingEngine:
             paged = self.cfg.paged
             C = self._chunk_len
 
-            def fn(pb, ids, caches, bt, start, last_idx, key):
+            def fn(pb, ids, caches, bt, start, last_idx, key, samp,
+                   use_samp):
                 TRACE_COUNTS["prefill_chunk"] += 1
                 pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)
                 if paged:
@@ -456,15 +600,12 @@ class ContinuousBatchingEngine:
                     self.model, pb["p"], ids, position_ids=pos,
                     kv_caches=kv, cache_index=start, buffers=pb["b"])
                 rows = logits[jnp.arange(logits.shape[0]), last_idx]
-                if self.cfg.greedy:
-                    toks = jnp.argmax(rows, axis=-1)
-                else:
-                    toks = jax.random.categorical(
-                        key, rows / self.cfg.temperature, axis=-1)
+                toks = self._sample_rows(rows, key, samp, use_samp)
                 if paged:
                     return toks, [c for c, _ in new_kv]
                 return toks, new_kv
-            self._prefill_chunk_c = jax.jit(fn, donate_argnums=(2,))
+            self._prefill_chunk_c = jax.jit(fn, static_argnums=(8,),
+                                            donate_argnums=(2,))
         return self._prefill_chunk_c
 
     def _insert_prefix_contig(self):
@@ -539,9 +680,10 @@ class ContinuousBatchingEngine:
         if self._decode_c is None:
             paged = self.cfg.paged
 
-            def fn(pb, toks, caches, state_or_lens, key):
+            def fn(pb, toks, caches, state_or_lens, key, samp, use_samp):
                 # only `caches` (arg 2) is donated; the per-slot lengths /
                 # block tables must NOT alias it (f(donate(a), a) trap)
+                TRACE_COUNTS["decode_step"] += 1
                 if paged:
                     state = state_or_lens
                     seq_lens = state.seq_lens
@@ -554,16 +696,13 @@ class ContinuousBatchingEngine:
                     self.model, pb["p"], toks, position_ids=pos,
                     kv_caches=kv, cache_index=seq_lens, buffers=pb["b"])
                 logits = logits[:, -1, :]
-                if self.cfg.greedy:
-                    nxt = jnp.argmax(logits, axis=-1)
-                else:
-                    nxt = jax.random.categorical(
-                        key, logits / self.cfg.temperature, axis=-1)
+                nxt = self._sample_rows(logits, key, samp, use_samp)
                 if paged:
                     new_caches = [c for c, _ in new_kv]
                     return nxt, new_caches
                 return nxt, new_kv
-            self._decode_c = jax.jit(fn, donate_argnums=(2,))
+            self._decode_c = jax.jit(fn, static_argnums=(6,),
+                                     donate_argnums=(2,))
         return self._decode_c
 
     def _decode_n(self):
@@ -580,7 +719,10 @@ class ContinuousBatchingEngine:
         if self._decode_nc is None:
             paged = self.cfg.paged
 
-            def fn(pb, toks, caches, lens, active, budget, bt, key, K):
+            def fn(pb, toks, caches, lens, active, budget, bt, key, samp,
+                   K, use_samp):
+                TRACE_COUNTS["decode_chunk"] += 1
+
                 def one(carry, k):
                     toks, caches, lens = carry
                     if paged:
@@ -593,12 +735,9 @@ class ContinuousBatchingEngine:
                         position_ids=lens[:, None],
                         kv_caches=kv, cache_index=lens, buffers=pb["b"])
                     logits = logits[:, -1, :]
-                    if self.cfg.greedy:
-                        nxt = jnp.argmax(logits, axis=-1)
-                    else:
-                        nxt = jax.random.categorical(
-                            jax.random.fold_in(key, k),
-                            logits / self.cfg.temperature, axis=-1)
+                    nxt = self._sample_rows(
+                        logits, jax.random.fold_in(key, k), samp,
+                        use_samp)
                     nxt = nxt.astype(toks.dtype)
                     if paged:
                         new_caches = [c for c, _ in new_kv]
@@ -615,8 +754,77 @@ class ContinuousBatchingEngine:
                 return toks_all, caches, lens
 
             self._decode_nc = jax.jit(
-                fn, static_argnums=(8,), donate_argnums=(2,))
+                fn, static_argnums=(9, 10), donate_argnums=(2,))
         return self._decode_nc
+
+    def _verify(self):
+        """THE speculative-decoding program: one compiled fixed
+        ``[slots, spec_k+1]`` target-model pass that scores each slot's
+        last accepted token plus up to K drafted tokens, with GREEDY
+        ACCEPTANCE computed in-jit — only ``[slots]``-sized preds and
+        accepted-lengths cross to the host, never logits.
+
+        Same shape discipline as the chunked prefill program (it rides
+        the models' identical per-slot s>1 branches: vector
+        ``cache_index``, scatter-with-drop appends, per-row causal
+        history mask): slots with no draft this step carry
+        ``n_draft = 0`` and degrade to a normal one-token decode within
+        the same program — row 0's prediction IS the decode token;
+        inactive slots carry the ``start = max_len`` write-drop
+        sentinel. Every row's K/V is appended to the cache (pad rows
+        write garbage PAST the slot's live length); the host then
+        advances ``seq_lens`` by only ``accepted+1``, which is the
+        whole rollback — rows beyond the accepted length sit above
+        every later query's causal mask (append-only pages make the
+        retreat a pure length decrement; contiguous mode overwrites the
+        same rows on the next step).
+
+        Greedy acceptance: draft j is accepted iff it equals the
+        program's own argmax after consuming rows 0..j-1 AND every
+        earlier draft was accepted — so the emitted chain
+        ``draft[:a] + preds[a]`` is exactly the argmax chain plain
+        greedy decode would produce, token for token.
+
+        Per-request SAMPLING slots never draft (no argmax chain to
+        verify); under the static ``use_samp`` arm their row-0 token is
+        sampled in-jit through the same per-slot param stack the
+        decode programs use."""
+        if self._verify_c is None:
+            paged = self.cfg.paged
+            S = self.cfg.spec_k + 1
+
+            def fn(pb, ids, caches, bt, start, n_draft, key, samp,
+                   use_samp):
+                TRACE_COUNTS["spec_verify"] += 1
+                pos = start[:, None] + jnp.arange(S, dtype=jnp.int32)
+                if paged:
+                    state = PagedState(block_tables=bt, seq_lens=start)
+                    kv = [(c, state) for c in caches]
+                else:
+                    kv = caches
+                logits, new_kv = functional_call(
+                    self.model, pb["p"], ids, position_ids=pos,
+                    kv_caches=kv, cache_index=start, buffers=pb["b"])
+                preds = jnp.argmax(logits, axis=-1)  # [slots, S]
+                match = (preds[:, :-1] == ids[:, 1:]) & \
+                    (jnp.arange(S - 1, dtype=n_draft.dtype)[None, :]
+                     < n_draft[:, None])
+                # accepted = longest all-accepted prefix of the drafts
+                accepted = jnp.sum(
+                    jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+                if use_samp:
+                    greedy_mask, temp, tk, tp = samp
+                    s0 = jax.random.categorical(
+                        key, G.process_logits_batch(
+                            logits[:, 0], temp, tk, tp), axis=-1)
+                    preds = preds.at[:, 0].set(
+                        jnp.where(greedy_mask, preds[:, 0], s0))
+                if paged:
+                    return preds, accepted, [c for c, _ in new_kv]
+                return preds, accepted, new_kv
+            self._verify_c = jax.jit(fn, static_argnums=(8,),
+                                     donate_argnums=(2,))
+        return self._verify_c
 
     # ---------------- prefix cache ----------------
     def _match_prefix(self, req: Request):
@@ -916,6 +1124,11 @@ class ContinuousBatchingEngine:
         # per wave, not per chunk iteration
         bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
               else jnp.zeros((1,), jnp.int32))
+        # first-token sampling params for the wave's requests (slots
+        # not in the wave carry defaults — their sampled output is the
+        # ignored sentinel row)
+        use_samp, samp = self._slot_sampling(
+            [(job[1], job[0]) for job in jobs])
         while remaining:
             ids = np.zeros((cfg.max_slots, C), np.int64)
             start = np.full((cfg.max_slots,), sentinel, np.int32)
@@ -935,7 +1148,8 @@ class ContinuousBatchingEngine:
             with self._ctx():
                 toks, caches = self._prefill_chunked()(
                     self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
-                    jnp.asarray(start), jnp.asarray(last_idx), sub)
+                    jnp.asarray(start), jnp.asarray(last_idx), sub,
+                    samp, use_samp)
             if cfg.paged:
                 self.layer_caches = caches
             else:
@@ -987,10 +1201,19 @@ class ContinuousBatchingEngine:
                 one_caches = self.model.init_kv_caches(
                     1, bucket, dtype=self.cache_dtype)
                 self._key, sub = jax.random.split(self._key)
+                use_samp = self._req_nondefault(req)
+                samp = (
+                    jnp.asarray([self._req_greedy(req)]),
+                    jnp.asarray([max(
+                        req.temperature if req.temperature is not None
+                        else self.cfg.temperature, 1e-6)], jnp.float32),
+                    jnp.asarray([req.top_k or 0], jnp.int32),
+                    jnp.asarray([req.top_p if req.top_p is not None
+                                 else 1.0], jnp.float32))
                 with self._ctx():
                     first_dev, filled = self._prefill()(
                         self._pb, jnp.asarray(padded, jnp.int32),
-                        one_caches, n - 1, sub)
+                        one_caches, n - 1, sub, samp, use_samp)
                     if self.cfg.paged:
                         self.layer_caches = self._scatter_paged()(
                             self.layer_caches, filled,
@@ -1058,12 +1281,22 @@ class ContinuousBatchingEngine:
 
     def step(self) -> bool:
         """Admit waiting requests, run one decode step for all active
-        slots. Returns False when there is nothing left to do."""
+        slots — or, with speculative decoding enabled and at least one
+        slot holding a draft, one multi-token verify pass. Returns
+        False when there is nothing left to do."""
         self._admit()
         if not self.active.any():
             return bool(self._queue)
+        if self._spec_mode != "off":
+            drafts = self._propose_drafts()
+            if drafts:
+                return self._spec_step(drafts)
+            self.spec_stats["fallback_steps"] += 1
+            if self._tel is not None:
+                self._tel.on_spec_fallback()
         t0 = time.perf_counter()
         self._cow_for_decode(1)
+        use_samp, samp = self._slot_sampling()
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
@@ -1073,10 +1306,12 @@ class ContinuousBatchingEngine:
                     block_tables=jnp.asarray(self.pool.block_tables),
                     seq_lens=lens)
                 nxt, self.layer_caches = self._decode()(
-                    self._pb, toks, self.layer_caches, state, sub)
+                    self._pb, toks, self.layer_caches, state, sub,
+                    samp, use_samp)
             else:
                 nxt, self.caches = self._decode()(
-                    self._pb, toks, self.caches, lens, sub)
+                    self._pb, toks, self.caches, lens, sub, samp,
+                    use_samp)
         nxt = np.asarray(nxt)
         emitted = 0
         for slot in range(self.cfg.max_slots):
@@ -1091,6 +1326,145 @@ class ContinuousBatchingEngine:
         if self._tel is not None:
             self._tel.on_tokens(emitted,
                                 (time.perf_counter() - t0) * 1e3)
+            self._tel.on_state(*self._tel_state())
+        return True
+
+    # ---------------- speculative decoding ----------------
+    def _draft_budget(self, slot: int) -> int:
+        """Max draft tokens this slot may carry in a verify pass, 0 if
+        it is ineligible. O(1) host checks only — callers use it both
+        to draft and to SKIP the O(history) drafter scan when a verify
+        pass could not dispatch anyway. Eligibility: the request
+        decodes GREEDILY (acceptance verifies against the argmax
+        chain), has budget for at least one draft + the bonus token,
+        and — in ``auto`` mode — hasn't proven its traffic undraftable
+        (per-request throttle: after 16 proposed tokens at < 1/8
+        acceptance, stop paying the verify width for it)."""
+        req = self._slot_req[slot]
+        if not self._req_greedy(req):
+            return 0
+        remaining = min(
+            req.max_new_tokens - len(req.output),
+            self.cfg.max_len - 1 - int(self.seq_lens[slot]))
+        max_d = min(self.cfg.spec_k, remaining - 1)
+        if max_d <= 0:
+            return 0
+        if self._spec_mode == "auto" and req._spec_proposed >= 16 \
+                and req._spec_accepted * 8 < req._spec_proposed:
+            return 0
+        return max_d
+
+    def _propose_drafts(self) -> Dict[int, np.ndarray]:
+        """Host-side drafting for the next verify pass: slot → proposed
+        token ids (1..spec_k of them) for every eligible slot (see
+        ``_draft_budget``) whose drafter actually proposes."""
+        if self._drafter is None:
+            return {}
+        cfg = self.cfg
+        out: Dict[int, np.ndarray] = {}
+        for slot in range(cfg.max_slots):
+            if not self.active[slot]:
+                continue
+            max_d = self._draft_budget(slot)
+            if max_d <= 0:
+                continue
+            req = self._slot_req[slot]
+            hist = np.concatenate(
+                [req.prompt, np.asarray(req.output, np.int64)])
+            d = np.asarray(self._drafter.propose(hist, max_d)).reshape(-1)
+            if d.size:
+                out[slot] = d[:max_d]
+        return out
+
+    def _spec_step(self, drafts: Dict[int, np.ndarray]) -> bool:
+        """One speculative step: dispatch the fixed ``[slots, K+1]``
+        verify program over every active slot (drafted slots carry
+        their proposals, the rest degrade to a 1-token decode in the
+        same call), overlap admission dispatch behind it, then sync and
+        advance each slot by ``accepted + 1`` tokens.
+
+        ROLLBACK is the non-advance: the program appended K+1 KV rows
+        per active slot, but ``seq_lens`` moves only past the accepted
+        prefix — rejected rows sit above every later causal mask and
+        are rewritten by the next append at the same positions (paged:
+        a pure length decrement on append-only pages; contiguous: same
+        rows overwritten next step). The COW guard runs over the FULL
+        K+1 write window first: even a pad row's garbage write must
+        never land on a page the prefix store (or another slot) still
+        shares."""
+        cfg = self.cfg
+        S = cfg.spec_k + 1
+        t0 = time.perf_counter()
+        self._cow_for_decode(S)
+        sentinel = cfg.max_len
+        ids = np.zeros((cfg.max_slots, S), np.int64)
+        start = np.full((cfg.max_slots,), sentinel, np.int32)
+        n_draft = np.zeros((cfg.max_slots,), np.int32)
+        chunk_slots = self.active.copy()
+        for slot in range(cfg.max_slots):
+            if not chunk_slots[slot]:
+                continue
+            ids[slot, 0] = self.last_tok[slot]
+            d = drafts.get(slot)
+            if d is not None and d.size:
+                ids[slot, 1:1 + d.size] = d
+                n_draft[slot] = d.size
+            start[slot] = self.seq_lens[slot]
+        use_samp, samp = self._slot_sampling()
+        self._key, sub = jax.random.split(self._key)
+        bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
+              else jnp.zeros((1,), jnp.int32))
+        caches = self.layer_caches if cfg.paged else self.caches
+        with self._ctx():
+            preds, accepted, caches = self._verify()(
+                self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
+                jnp.asarray(start), jnp.asarray(n_draft), sub, samp,
+                use_samp)
+        if cfg.paged:
+            self.layer_caches = caches
+        else:
+            self.caches = caches
+        # admission dispatches behind the in-flight verify (stream
+        # order, exactly like step_chunk's decode-chunk overlap)
+        pending = self._admit_dispatch()
+        preds_np = np.asarray(preds)  # ONE sync for up to S tokens/slot
+        acc_np = np.asarray(accepted)
+        t_sync = time.perf_counter()
+        emitted = 0
+        proposed_tot = accepted_tot = 0
+        for slot in range(cfg.max_slots):
+            if not chunk_slots[slot] or not self.active[slot]:
+                continue
+            req = self._slot_req[slot]
+            n = int(n_draft[slot])
+            a = min(int(acc_np[slot]), n)
+            toks = [int(ids[slot, 1 + j]) for j in range(a)]
+            toks.append(int(preds_np[slot, a]))
+            for tok in toks:
+                if req.done:
+                    break  # EOS mid-chain: later tokens discarded
+                req.output.append(tok)
+                self.seq_lens[slot] += 1
+                self.last_tok[slot] = tok
+                emitted += 1
+                self._maybe_finish(slot, tok)
+            if n:
+                req._spec_proposed += n
+                req._spec_accepted += a
+                proposed_tot += n
+                accepted_tot += a
+                if self._tel is not None:
+                    self._tel.on_spec_slot(n, a)
+        self.spec_stats["verify_calls"] += 1
+        self.spec_stats["proposed"] += proposed_tot
+        self.spec_stats["accepted"] += accepted_tot
+        self.spec_stats["emitted"] += emitted
+        self._admit_integrate(pending)
+        if self._tel is not None:
+            self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
+            self._tel.on_spec_verify(
+                proposed_tot, accepted_tot,
+                self.spec_stats["accepted"], self.spec_stats["proposed"])
             self._tel.on_state(*self._tel_state())
         return True
 
@@ -1124,6 +1498,33 @@ class ContinuousBatchingEngine:
             self._admit()
             if not self.active.any():
                 return bool(self._queue)
+        if self._spec_mode != "off":
+            # A verify pass buys accepted+1 tokens per DRAFTING slot
+            # for one weight stream, but costs every OTHER active slot
+            # its chunk amortization: the pass is one host sync that
+            # emits exactly 1 token for a draftless slot, vs max_chunk
+            # tokens per sync from the plain chunk below. Preempting
+            # the chunk for a single drafting slot would collapse a
+            # mixed batch's throughput (7 slots × K tokens/sync → 7 ×
+            # 1), so verify only preempts when drafting slots are at
+            # least HALF the active set — the regime where the weight-
+            # stream amortization outweighs the lost sync amortization.
+            # step() keeps the unconditional preempt: there the
+            # alternative is a 1-token pass, and verify strictly
+            # dominates it. The O(1) eligibility count runs before the
+            # O(history) drafter scan: when the gate cannot pass even
+            # if every eligible slot proposed, don't pay the scan.
+            n_active = int(self.active.sum())
+            eligible = sum(
+                1 for s in range(self.cfg.max_slots)
+                if self.active[s] and self._draft_budget(s) > 0)
+            drafts = (self._propose_drafts()
+                      if 2 * eligible >= n_active else {})
+            if drafts and 2 * len(drafts) >= n_active:
+                return self._spec_step(drafts)
+            self.spec_stats["fallback_steps"] += 1
+            if self._tel is not None:
+                self._tel.on_spec_fallback()
         t0 = time.perf_counter()
         K = max_chunk
         # capture the chunk's view BEFORE admission: newly admitted
@@ -1132,6 +1533,7 @@ class ContinuousBatchingEngine:
         chunk_slots = self.active.copy()
         self._cow_for_decode(K)
         budget = self._slot_budgets()
+        use_samp, samp = self._slot_sampling()
         self._key, sub = jax.random.split(self._key)
         toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
         lens = jnp.asarray(self.seq_lens, jnp.int32)
@@ -1142,7 +1544,7 @@ class ContinuousBatchingEngine:
         with self._ctx():
             toks_all, caches, _ = self._decode_n()(
                 self._pb, toks, caches, lens, act, jnp.asarray(budget),
-                bt, sub, K)
+                bt, sub, samp, K, use_samp)
         if self.cfg.paged:
             self.layer_caches = caches
         else:
@@ -1267,6 +1669,7 @@ class ContinuousBatchingEngine:
             "max": self.cfg.max_slots,
         }
         snap["prefix_cache"] = self.prefix_snapshot()
+        snap["spec_decode"] = self.spec_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -1279,6 +1682,18 @@ class ContinuousBatchingEngine:
                                if self._prefix is not None else 0)
         tot = st["prompt_tokens"]
         st["hit_rate_tokens"] = (st["hit_tokens"] / tot) if tot else 0.0
+        return st
+
+    def spec_snapshot(self) -> dict:
+        """Speculative-decoding effectiveness counters (plain host
+        counters — available even with PT_FLAGS_telemetry=off, which is
+        how the bench A/B reads acceptance rates)."""
+        st = dict(self.spec_stats)
+        st["enabled"] = self._spec_mode != "off"
+        st["mode"] = self._spec_mode
+        st["k"] = self.cfg.spec_k
+        st["acceptance_rate"] = (st["accepted"] / st["proposed"]
+                                 if st["proposed"] else 0.0)
         return st
 
     def metrics_window_reset(self):

@@ -1,0 +1,84 @@
+"""Speculative-decoding drafters for the continuous-batching engine.
+
+Decode is pinned to the weight-bandwidth roofline: every accepted token
+costs one full forward pass that streams all model weights from HBM.
+Speculative decoding amortizes that stream — a cheap DRAFTER proposes up
+to K candidate tokens per slot, the target model scores all of them in
+ONE fixed ``[slots, K+1]`` pass (the engine's verify program — the same
+program shape as PR 4's chunked prefill), and greedy acceptance keeps
+the longest prefix of drafts that match the target's own argmax chain.
+Greedy outputs are therefore IDENTICAL to plain decode in every case;
+the only thing at stake is how many tokens each weight stream buys.
+
+The built-in drafter is N-GRAM PROMPT LOOKUP (self-drafting): it matches
+the slot's most recent token suffix against the slot's OWN
+prompt+generation history and proposes the continuation of the most
+recent earlier occurrence. No draft-model weights, no device work —
+pure host-side numpy, so the whole path runs (and is tested) on CPU.
+Repetitive traffic — code, JSON, templated answers, extractive QA — is
+exactly where the suffix recurs and acceptance is high.
+
+``Drafter`` is the protocol seam: anything with a
+``propose(history, k) -> np.ndarray`` method plugs into
+``ContinuousBatchingEngine(..., drafter=...)``. A small draft MODEL
+would implement the same method (batching its own forward over the
+histories host-side or in its own compiled program); the engine only
+ever sees proposed token ids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EMPTY = np.zeros((0,), np.int64)
+
+
+class Drafter:
+    """Protocol seam for speculative-decoding drafters.
+
+    ``propose(history, k)`` receives one slot's full token history
+    (prompt + generated, the last entry being the token the next decode
+    step will consume) and returns up to ``k`` proposed NEXT tokens as
+    a 1-D int array (empty = no proposal; the slot falls back to normal
+    one-token decode). Must be pure host-side and cheap relative to a
+    decode step — it runs per slot per scheduler tick.
+    """
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup n-gram drafter (self-drafting, no draft model).
+
+    Tries suffix lengths ``max_ngram`` down to ``min_ngram``: for the
+    first length whose suffix has an earlier occurrence in the history,
+    proposes the tokens FOLLOWING the most recent such occurrence
+    (recency wins — local repetition beats a stale prompt match).
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram; got "
+                f"min={min_ngram} max={max_ngram}")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        h = np.ascontiguousarray(
+            np.asarray(history).reshape(-1), np.int64)
+        if k <= 0 or h.size < self.min_ngram + 1:
+            return _EMPTY
+        for n in range(min(self.max_ngram, h.size - 1),
+                       self.min_ngram - 1, -1):
+            pat = h[h.size - n:]
+            windows = np.lib.stride_tricks.sliding_window_view(h, n)
+            hits = np.flatnonzero((windows == pat).all(axis=1))
+            # a hit must have a continuation: i + n < len (this also
+            # excludes the suffix matching itself at i = len - n)
+            hits = hits[hits + n < h.size]
+            if hits.size:
+                start = int(hits[-1]) + n  # most recent occurrence
+                return h[start:start + k].copy()
+        return _EMPTY

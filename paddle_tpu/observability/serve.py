@@ -85,6 +85,28 @@ class ServingTelemetry:
         self._pfx_cached = reg.gauge(
             "pt_serve_prefix_cached_pages",
             "prefix blocks/pages currently resident in the store", L)
+        self._spec_proposed = reg.counter(
+            "pt_serve_spec_proposed_tokens_total",
+            "draft tokens submitted to the multi-token verify pass", L)
+        self._spec_accepted = reg.counter(
+            "pt_serve_spec_accepted_tokens_total",
+            "draft tokens accepted by greedy verification", L)
+        self._spec_verify = reg.counter(
+            "pt_serve_spec_verify_calls_total",
+            "batched [slots, K+1] verify dispatches", L)
+        self._spec_fallback = reg.counter(
+            "pt_serve_spec_fallback_steps_total",
+            "spec-enabled steps where no verify pass dispatched (no "
+            "slot drafted, or the chunk scheduler's drafting-share "
+            "gate kept the plain chunk) — plain decode ran", L)
+        self._spec_accept_hist = reg.histogram(
+            "pt_serve_spec_acceptance_rate",
+            "per-slot per-verify accepted/proposed fraction",
+            labels=L,
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        self._spec_rate = reg.gauge(
+            "pt_serve_spec_acceptance_rate_cum",
+            "cumulative accepted/proposed draft-token ratio", L)
 
     def _lab(self) -> dict:
         return {"engine": self.engine_id}
@@ -121,6 +143,29 @@ class ServingTelemetry:
             # keep the residency gauge honest between admissions —
             # evictions under pure decode pressure must show up too
             self._pfx_cached.set(cached_blocks, **lab)
+
+    def on_spec_slot(self, proposed: int, accepted: int):
+        """One slot's outcome in one verify pass — feeds the
+        acceptance-rate histogram (per-slot granularity: a 100%-accept
+        slot and a 0%-accept slot must not average into one bland
+        mid-bucket observation)."""
+        if proposed > 0:
+            self._spec_accept_hist.observe(accepted / proposed,
+                                           **self._lab())
+
+    def on_spec_verify(self, proposed: int, accepted: int,
+                       cum_accepted: int, cum_proposed: int):
+        lab = self._lab()
+        self._spec_verify.inc(**lab)
+        if proposed > 0:
+            self._spec_proposed.inc(proposed, **lab)
+        if accepted > 0:
+            self._spec_accepted.inc(accepted, **lab)
+        if cum_proposed > 0:
+            self._spec_rate.set(cum_accepted / cum_proposed, **lab)
+
+    def on_spec_fallback(self):
+        self._spec_fallback.inc(**self._lab())
 
     def on_tokens(self, n_tokens: int, wall_ms: float):
         if n_tokens <= 0:
@@ -189,6 +234,13 @@ class ServingTelemetry:
                 "evictions": self._pfx_evict.value(**lab),
                 "cached_blocks": self._pfx_cached.value(**lab),
             },
+            "spec_decode": {
+                "proposed_tokens": self._spec_proposed.value(**lab),
+                "accepted_tokens": self._spec_accepted.value(**lab),
+                "verify_calls": self._spec_verify.value(**lab),
+                "fallback_steps": self._spec_fallback.value(**lab),
+                "acceptance_rate": self._spec_rate.value(**lab),
+            },
         }
 
     def window_reset(self):
@@ -197,6 +249,7 @@ class ServingTelemetry:
         lab = self._lab()
         self._ttft.reset_window(**lab)
         self._tpot.reset_window(**lab)
+        self._spec_accept_hist.reset_window(**lab)
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)
         self._kv_peak.set(0.0, **lab)

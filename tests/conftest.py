@@ -52,13 +52,25 @@ def compile_counter():
     """Compile-count guard for engine tests: returns a callable giving
     the number of jit SPECIALIZATIONS of a named serving program since
     the fixture was set up (trace-time counters in
-    ``paddle_tpu.inference.serving.TRACE_COUNTS``). The regression this
-    exists to prevent: chunked prefill silently re-specializing per
-    prompt length / seq bucket."""
+    ``paddle_tpu.inference.serving.TRACE_COUNTS``). Called with NO
+    argument it returns the full {program: delta} dict (zero deltas
+    omitted) so a test can pin the EXACT compiled-program set of a
+    workload — e.g. spec-decode-off must compile precisely the PR-4
+    set, spec-on at most verify + fallback on top. The regression this
+    exists to prevent: a serving program silently re-specializing per
+    prompt length / seq bucket / scheduler mode."""
     from paddle_tpu.inference import serving
 
     base = serving.TRACE_COUNTS.copy()
-    return lambda key: serving.TRACE_COUNTS[key] - base[key]
+
+    def counter(key=None):
+        if key is None:
+            return {k: v - base[k]
+                    for k, v in serving.TRACE_COUNTS.items()
+                    if v - base[k]}
+        return serving.TRACE_COUNTS[key] - base[key]
+
+    return counter
 
 
 @pytest.fixture(autouse=True)

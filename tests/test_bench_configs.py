@@ -92,6 +92,58 @@ def test_compact_line_contract(tmp_path, monkeypatch):
         "T" * 1500
 
 
+def test_compact_line_cpu_fallback_carries_capture_pointer(
+        tmp_path, monkeypatch):
+    """Any cpu-plane headline (probe failure OR explicit
+    JAX_PLATFORMS=cpu) must name the freshest COMMITTED device capture
+    (timestamp + commit + headline metric) so the driver ledger always
+    points at verifiable evidence — and the pointer must survive the
+    final over-2KB shed. A tpu-plane result must NOT carry one."""
+    import bench
+
+    monkeypatch.setattr(bench, "DETAILS_PATH",
+                        str(tmp_path / "BENCH_DETAILS.json"))
+    cap = tmp_path / "BENCH_TPU_CAPTURE.json"
+    cap.write_text(json.dumps({
+        "captured_at": "2026-07-31T07:16:14Z", "headline": "llama_b4",
+        "configs": {"llama_b4": {
+            "metric": "llama876m_train_tokens_per_sec_per_chip",
+            "value": 25933.2, "unit": "tokens/s/chip"}}}))
+    monkeypatch.setattr(bench, "CAPTURE_PATH", str(cap))
+
+    fat = _fat_result()
+    parsed = json.loads(bench._compact_line(fat))
+    ptr = parsed["extra"]["last_device_capture"]
+    assert ptr["captured_at"] == "2026-07-31T07:16:14Z"
+    assert ptr["metric"] == "llama876m_train_tokens_per_sec_per_chip"
+    assert ptr["value"] == 25933.2
+    # uncommitted tmp file: identity rides without git provenance
+    assert "commit" not in ptr
+
+    # explicit-cpu line (no tpu_probe at all) still carries it
+    slim = {"metric": "llama_train_cpu_smoke_tokens_per_sec",
+            "value": 90.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+            "extra": {"platform": "cpu", "n_chips": 1}}
+    parsed = json.loads(bench._compact_line(slim))
+    assert parsed["extra"]["last_device_capture"]["value"] == 25933.2
+
+    # the final shed keeps it: with the byte budget squeezed below the
+    # compacted fat line, extra collapses to its survival set and the
+    # pointer must be in it
+    monkeypatch.setattr(bench, "MAX_LINE_BYTES", 500)
+    shed = json.loads(bench._compact_line(fat))
+    assert set(shed["extra"]) <= {"platform", "n_chips",
+                                  "last_device_capture"}
+    assert shed["extra"]["last_device_capture"]["value"] == 25933.2
+    monkeypatch.setattr(bench, "MAX_LINE_BYTES", 2000)
+
+    # a tpu-plane result never points at itself
+    tpu = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+           "extra": {"platform": "tpu", "n_chips": 1}}
+    assert "last_device_capture" not in \
+        json.loads(bench._compact_line(tpu))["extra"]
+
+
 def test_compact_line_headline_error(tmp_path, monkeypatch):
     """A failed headline must carry its own truncated diagnostics on the
     printed line (round-3 regression: only secondaries kept errors)."""

@@ -35,6 +35,62 @@ class TestProcessors:
         out = np.asarray(G.repetition_penalty_(logits, gen, 2.0))
         np.testing.assert_allclose(out[0], [1.0, -4.0, 1.0])
 
+    def test_process_logits_batch_matches_scalar_rows(self):
+        """The vectorized per-row stack (serving's per-request
+        sampling) must agree with the scalar processors row by row for
+        distinct-logit rows (rank-cut vs value-cut top-k only differ on
+        exact ties)."""
+        rng = np.random.default_rng(1)
+        # distinct values per row -> no top-k tie ambiguity
+        logits = jnp.asarray(
+            rng.permutation(np.arange(32, dtype=np.float32))
+            .reshape(1, -1))
+        logits = jnp.concatenate(
+            [logits, logits[:, ::-1] * 0.37 + 1.0], axis=0)
+        params = [(1.0, 5, 1.0), (2.5, 0, 0.6), (0.7, 4, 0.8)]
+        for temp, k, p in params:
+            batch = np.asarray(G.process_logits_batch(
+                logits,
+                jnp.full((2,), temp), jnp.full((2,), k, jnp.int32),
+                jnp.full((2,), p)))
+            for row in range(2):
+                ref = np.asarray(G.process_logits(
+                    logits[row:row + 1], temperature=temp, top_k=k,
+                    top_p=p))[0]
+                kept_b = batch[row] > G.NEG_INF / 2
+                kept_r = ref > G.NEG_INF / 2
+                np.testing.assert_array_equal(kept_b, kept_r)
+                np.testing.assert_allclose(
+                    batch[row][kept_b], ref[kept_r], rtol=1e-6)
+
+    def test_process_logits_batch_per_row_params(self):
+        """Different params per row in ONE call: row 0 disabled (pass
+        through), row 1 top-k=1, row 2 tight top-p — and the top token
+        always survives even degenerate per-row settings."""
+        logits = jnp.asarray(np.log(np.array(
+            [[0.5, 0.3, 0.15, 0.05]] * 3, np.float32)))
+        out = np.asarray(G.process_logits_batch(
+            logits,
+            jnp.asarray([1.0, 1.0, 1.0]),
+            jnp.asarray([0, 1, 0], jnp.int32),
+            jnp.asarray([1.0, 1.0, 1e-9])))
+        kept = out > G.NEG_INF / 2
+        assert kept[0].all()                 # all filters off
+        assert kept[1].tolist() == [True, False, False, False]
+        assert kept[2].tolist() == [True, False, False, False]
+        np.testing.assert_allclose(out[0], np.asarray(logits[0]))
+
+    def test_process_logits_batch_jits(self):
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(4, 64)).astype(np.float32))
+        f = jax.jit(G.process_logits_batch)
+        out = f(logits, jnp.full((4,), 1.3),
+                jnp.asarray([0, 3, 8, 1], jnp.int32),
+                jnp.asarray([1.0, 0.9, 0.5, 1.0]))
+        kept = np.asarray(out) > G.NEG_INF / 2
+        assert kept[3].sum() == 1  # top-k=1 row
+        assert kept[0].sum() == 64  # disabled row
+
     def test_sampling_topk1_is_greedy(self):
         rng = np.random.default_rng(0)
         logits = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
