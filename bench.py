@@ -540,6 +540,18 @@ def _compact_line(result):
                 row["vs_baseline"] = r["vs_baseline"]
             if r.get("unit") in ("error", "skipped"):
                 row["error"] = _err_msg(r.get("extra"))
+            # goodput-under-SLO headline (serve7b): the mid-QPS row's
+            # scalars ride the ledger line — the engine's metrics_
+            # snapshot() is one document now, no stitching here
+            gp = (r.get("extra") or {}).get("goodput_under_slo") or {}
+            sweep = gp.get("sweep") or []
+            if sweep:
+                # (n-1)//2: the true middle row — n//2 would pick the
+                # LAST (worst-goodput) row of an even-length sweep
+                mid = sweep[(len(sweep) - 1) // 2]
+                row["goodput"] = {
+                    k: mid.get(k) for k in
+                    ("qps", "goodput", "p99_ttft_ms", "p99_tpot_ms")}
             keep["secondary"][name] = row
     out["extra"] = keep
 
@@ -548,6 +560,7 @@ def _compact_line(result):
     if len(line) > MAX_LINE_BYTES and "secondary" in keep:
         for row in keep["secondary"].values():
             row.pop("error", None)
+            row.pop("goodput", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:
         # the capture pointer survives the final shed: a truncated CPU

@@ -87,6 +87,17 @@ define_flag("telemetry_dump_dir", "flight_records",
 define_flag("telemetry_grad_spike_factor", 10.0,
             "anomaly watchdog trips when grad norm exceeds this factor "
             "times the running median")
+define_flag("trace_sample", 1.0,
+            "serving lifecycle tracer sample rate in (0, 1]: the "
+            "fraction of requests and engine steps recorded "
+            "(deterministic — every round(1/rate)-th request id / step "
+            "sequence number, so a sampled request's events are "
+            "complete, never a torn subset). 0 disables the tracer "
+            "entirely; PT_FLAGS_telemetry=off disables it regardless")
+define_flag("trace_buffer", 8192,
+            "ring capacity (events) of each serving tracer — old events "
+            "fall off; bounds host memory no matter how long the engine "
+            "runs")
 define_flag("rng_use_global_seed", True,
             "derive eager rng stream from the global seed")
 define_flag("fused_group_norm", True,

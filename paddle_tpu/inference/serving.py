@@ -125,6 +125,17 @@ def _validate_buckets(cfg: "EngineConfig") -> List[int]:
     return sorted({min(int(b), cfg.max_len) for b in buckets})
 
 
+# per-request SLO classes (ROADMAP item 5): default TTFT / per-request
+# TPOT targets per class; explicit add_request targets override. The
+# engine only ACCOUNTS attainment here (pt_serve_slo_* counters,
+# slo_snapshot, goodput) — the SLO-aware scheduler that acts on these
+# classes is the next PR, and it reads exactly this bookkeeping.
+SLO_CLASSES: Dict[str, Dict[str, float]] = {
+    "interactive": {"ttft_target_ms": 250.0, "tpot_target_ms": 100.0},
+    "batch": {"ttft_target_ms": 5000.0, "tpot_target_ms": 1000.0},
+}
+
+
 @dataclass
 class Request:
     rid: int
@@ -135,6 +146,17 @@ class Request:
     ttft_ms: Optional[float] = None
     slot: Optional[int] = None
     done: bool = False
+    cancelled: bool = False
+    # why the request left its slot: eos | max_new_tokens | max_len |
+    # cancel (None while in flight)
+    finish_reason: Optional[str] = None
+    # SLO class + targets (None = untracked); tpot_ms is the
+    # per-request mean decode latency, computed once at finish
+    slo: Optional[str] = None
+    ttft_target_ms: Optional[float] = None
+    tpot_target_ms: Optional[float] = None
+    tpot_ms: Optional[float] = None
+    slo_met: Optional[bool] = None
     # per-request sampling params (None = engine-global config). Any
     # explicit temperature/top_k/top_p implies sampling for this
     # request; ``greedy`` overrides that inference either way.
@@ -143,6 +165,7 @@ class Request:
     top_p: Optional[float] = None
     greedy: Optional[bool] = None
     _submit_t: float = 0.0
+    _admit_t: float = 0.0
     # prompt block digests, computed once — a pool-blocked request is
     # re-matched every scheduler tick and must not re-hash each time
     _hashes: Optional[List[bytes]] = None
@@ -349,10 +372,29 @@ class ContinuousBatchingEngine:
             "verify_calls": 0, "fallback_steps": 0,
         }
 
+        # SLO attainment bookkeeping (host counters — available even
+        # with telemetry off, like prefix_stats/spec_stats): class ->
+        # met/violated/target-miss/token counts, written at finish
+        self.slo_stats: Dict[str, Dict[str, int]] = {}
+        # set by the admission paths when the head request is blocked
+        # on KV-pool pages (slots free, pool exhausted) — the PAGED
+        # engine's dominant saturation mode, which a free-slot count
+        # alone cannot see; read by backpressure()/healthz
+        self._pool_blocked = False
+
         # telemetry (None when PT_FLAGS_telemetry=off → scheduling loop
         # pays a single identity check per hook site)
         self._tel = (observability.ServingTelemetry()
                      if observability.enabled() else None)
+        # lifecycle tracer (observability/tracing.py): same off-switch
+        # as telemetry, thinned by PT_FLAGS_trace_sample; records
+        # request spans + per-step composition into a bounded ring.
+        # Pure host bookkeeping — adds zero compiled programs (pinned
+        # by test_tracing's compile-count guard).
+        self._tracer = None
+        if self._tel is not None and float(flags.flag("trace_sample")) > 0:
+            self._tracer = observability.Tracer(
+                engine_id=self._tel.engine_id)
 
     def _shard_kv(self, arr, axis=-2):
         """Shard the kv-head axis over tp (requires kv_heads % tp == 0):
@@ -379,7 +421,10 @@ class ContinuousBatchingEngine:
                     temperature: Optional[float] = None,
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
-                    greedy: Optional[bool] = None) -> int:
+                    greedy: Optional[bool] = None,
+                    slo: Optional[str] = None,
+                    ttft_target_ms: Optional[float] = None,
+                    tpot_target_ms: Optional[float] = None) -> int:
         """``temperature``/``top_k``/``top_p``: per-request sampling
         params, routed through ``generation.process_logits_batch``
         IN-JIT as per-slot vectors — setting any of them makes this
@@ -388,7 +433,15 @@ class ContinuousBatchingEngine:
         ``EngineConfig.greedy``/``temperature`` behavior and its exact
         compiled trace). Sampling requests never draft for speculative
         decoding — greedy acceptance needs an argmax chain to verify
-        against."""
+        against.
+
+        ``slo``: latency class (``"interactive"`` | ``"batch"``) whose
+        TTFT / per-request-TPOT targets (``SLO_CLASSES``, overridable
+        via ``ttft_target_ms``/``tpot_target_ms``; explicit targets
+        alone imply class ``"custom"``) are checked at finish —
+        attainment lands in ``pt_serve_slo_{met,violated}_total``, the
+        goodput gauge and ``engine.slo_snapshot()``. ``None`` leaves
+        the request SLO-untracked."""
         prompt = np.asarray(prompt).reshape(-1)
         if prompt.size == 0:
             # an empty prompt would "sample" from the last PADDED
@@ -404,13 +457,46 @@ class ContinuousBatchingEngine:
             raise ValueError(f"top_k must be >= 0; got {top_k}")
         if top_p is not None and not 0 < top_p <= 1:
             raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+        if slo is None and (ttft_target_ms is not None
+                            or tpot_target_ms is not None):
+            slo = "custom"  # explicit targets are an SLO by themselves
+        if slo is not None and slo != "custom" and slo not in SLO_CLASSES:
+            raise ValueError(
+                f"slo must be one of {sorted(SLO_CLASSES)} (or custom "
+                f"targets); got {slo!r}")
+        if slo == "custom" and ttft_target_ms is None \
+                and tpot_target_ms is None:
+            # a targetless "custom" request would trivially count as
+            # met every time — goodput inflation, not accounting
+            raise ValueError(
+                'slo="custom" needs ttft_target_ms and/or '
+                "tpot_target_ms")
+        for tname, t in (("ttft_target_ms", ttft_target_ms),
+                         ("tpot_target_ms", tpot_target_ms)):
+            if t is not None and t <= 0:
+                raise ValueError(f"{tname} must be > 0; got {t}")
+        defaults = SLO_CLASSES.get(slo, {})
+        if slo is not None:
+            if ttft_target_ms is None:
+                ttft_target_ms = defaults.get("ttft_target_ms")
+            if tpot_target_ms is None:
+                tpot_target_ms = defaults.get("tpot_target_ms")
         req = Request(self._next_rid, prompt, max_new_tokens, eos_token_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      greedy=greedy, _submit_t=time.perf_counter())
+                      greedy=greedy, slo=slo,
+                      ttft_target_ms=ttft_target_ms,
+                      tpot_target_ms=tpot_target_ms,
+                      _submit_t=time.perf_counter())
         self._next_rid += 1
         self._queue.append(req)
         if self._tel is not None:
             self._tel.on_submit(len(self._queue))
+        tr = self._tracer
+        if tr is not None and tr.want_request(req.rid):
+            tr.request(req.rid, "queued", t0=req._submit_t,
+                       prompt_tokens=int(prompt.size),
+                       max_new_tokens=int(max_new_tokens),
+                       slo=slo or "")
         return req.rid
 
     def _req_greedy(self, req: Request) -> bool:
@@ -845,7 +931,12 @@ class ContinuousBatchingEngine:
             prefix_len = req.prompt.size - 1
         return hashes, matched, prefix_len, full_cover
 
-    def _note_prefix(self, prefix_len: int, n: int):
+    def _note_prefix(self, prefix_len: int, n: int,
+                     rid: Optional[int] = None):
+        tr = self._tracer
+        if tr is not None and rid is not None and tr.want_request(rid):
+            tr.request(rid, "prefix_lookup", hit_tokens=int(prefix_len),
+                       prompt_tokens=int(n))
         if n < self._prefix_block:
             # no full block: block_hashes yields nothing, so the prompt
             # can never hit — counting it as a miss would drag the
@@ -872,6 +963,10 @@ class ContinuousBatchingEngine:
             if self._tel is not None:
                 self._tel.on_prefix_evict(freed,
                                           self._prefix.cached_pages)
+            if self._tracer is not None:
+                self._tracer.engine_event(
+                    "prefix_evict", freed_pages=int(freed),
+                    cached_pages=int(self._prefix.cached_pages))
         return freed
 
     def _cow_block(self, slot: int, block_idx: int) -> bool:
@@ -888,6 +983,18 @@ class ContinuousBatchingEngine:
             self.layer_caches = self._copy_page()(
                 self.layer_caches, old, new)
         self.prefix_stats["cow_copies"] += 1
+        tr = self._tracer
+        if tr is not None:
+            # rid is unknown during admission claim (the slot joins
+            # _slot_req only after the whole wave claims cleanly)
+            req = self._slot_req.get(slot)
+            if req is not None and tr.want_request(req.rid):
+                tr.request(req.rid, "cow", slot=slot,
+                           block=int(block_idx), src_page=old,
+                           dst_page=int(new))
+            elif req is None:
+                tr.engine_event("cow", slot=slot, block=int(block_idx),
+                                src_page=old, dst_page=int(new))
         return True
 
     def _cow_for_decode(self, k_steps: int):
@@ -1033,6 +1140,9 @@ class ContinuousBatchingEngine:
         per-bucket path (the parity oracle). Returns the pending
         (req, slot, first_token_future) list for
         ``_admit_integrate``."""
+        # fresh verdict each attempt: the flag self-heals the moment an
+        # admission pass no longer blocks on the pool
+        self._pool_blocked = False
         if self._chunk_len:
             return self._admit_dispatch_chunked()
         return self._admit_dispatch_bucketed()
@@ -1067,6 +1177,7 @@ class ContinuousBatchingEngine:
                                 f"but the pool has "
                                 f"{self.pool.free_pages} free with no "
                                 "request running — size n_pages up")
+                        self._pool_blocked = True
                         break  # pool exhausted: wait for a finisher
                     prefix_len, hashes = got
                     n_matched = prefix_len // cfg.page_size
@@ -1129,11 +1240,14 @@ class ContinuousBatchingEngine:
         # ignored sentinel row)
         use_samp, samp = self._slot_sampling(
             [(job[1], job[0]) for job in jobs])
+        tr = self._tracer
         while remaining:
+            t0 = time.perf_counter()
             ids = np.zeros((cfg.max_slots, C), np.int64)
             start = np.full((cfg.max_slots,), sentinel, np.int32)
             last_idx = np.zeros((cfg.max_slots,), np.int32)
             finishing = []
+            packed = 0
             for job in remaining:
                 req, slot, p = job[0], job[1], job[5]
                 take = min(C, req.prompt.size - p)
@@ -1143,6 +1257,10 @@ class ContinuousBatchingEngine:
                     last_idx[slot] = req.prompt.size - 1 - p
                     finishing.append(job)
                 job[5] = p + take
+                packed += take
+                if tr is not None and tr.want_request(req.rid):
+                    tr.request(req.rid, "prefill_chunk", start=int(p),
+                               tokens=int(take), slot=slot)
             self._key, sub = jax.random.split(self._key)
             caches = self.layer_caches if cfg.paged else self.caches
             with self._ctx():
@@ -1154,6 +1272,20 @@ class ContinuousBatchingEngine:
                 self.layer_caches = caches
             else:
                 self.caches = caches
+            if tr is not None:
+                # dispatch-only span: the chunk program is async — its
+                # device time surfaces in the NEXT decode/verify step's
+                # sync window, so only host dispatch wall is honest here
+                seq = tr.next_step()
+                if tr.want_step(seq):
+                    tr.step(seq, "prefill_chunk", t0,
+                            time.perf_counter(),
+                            prefilling=len(remaining),
+                            tokens_packed=packed, chunk=C,
+                            chunk_budget_spent=packed,
+                            occupancy=float(self.active.sum())
+                            / cfg.max_slots,
+                            rids=[int(j[0].rid) for j in remaining])
             for job in finishing:
                 pending.append((job[0], job[1], toks[job[1]]))
             done_slots = {j[1] for j in finishing}  # slots are unique
@@ -1167,7 +1299,7 @@ class ContinuousBatchingEngine:
             self._prefix_store_insert(slot, req.prompt, hashes,
                                       n_matched)
             if self._prefix is not None:
-                self._note_prefix(prefix_len, req.prompt.size)
+                self._note_prefix(prefix_len, req.prompt.size, req.rid)
         return pending
 
     def _admit_dispatch_bucketed(self):
@@ -1191,9 +1323,11 @@ class ContinuousBatchingEngine:
                         f"{self.pool.pages_needed(need)} pages but the "
                         f"pool has {self.pool.free_pages} free with no "
                         "request running — size n_pages up")
+                self._pool_blocked = True
                 break  # pool exhausted: wait for a finisher
             self._queue.popleft()
             heapq.heappop(self._free_heap)
+            t0 = time.perf_counter()
             try:
                 bucket = self._bucket(n)
                 padded = np.zeros((1, bucket), np.int64)
@@ -1242,6 +1376,15 @@ class ContinuousBatchingEngine:
             req.slot = slot
             self._slot_req[slot] = req
             pending.append((req, slot, first_dev))
+            tr = self._tracer
+            if tr is not None:
+                seq = tr.next_step()
+                if tr.want_step(seq):
+                    tr.step(seq, "prefill_bucket", t0,
+                            time.perf_counter(), rid=int(req.rid),
+                            bucket=int(bucket), prompt_tokens=int(n),
+                            occupancy=float(self.active.sum())
+                            / self.cfg.max_slots)
         return pending
 
     def _admit_integrate(self, pending):
@@ -1250,34 +1393,152 @@ class ContinuousBatchingEngine:
         chunk."""
         for req, slot, first_dev in pending:
             first = int(first_dev)  # scalar, not [1, bucket, vocab]
-            req.ttft_ms = (time.perf_counter() - req._submit_t) * 1e3
+            req._admit_t = time.perf_counter()
+            req.ttft_ms = (req._admit_t - req._submit_t) * 1e3
             req.output.append(first)
             self.seq_lens[slot] = req.prompt.size
             self.last_tok[slot] = first
             if self._tel is not None:
                 self._tel.on_admit(req.ttft_ms)
+            tr = self._tracer
+            if tr is not None and tr.want_request(req.rid):
+                # the span covers queue wait + prefill: exactly TTFT
+                tr.request(req.rid, "admitted", t0=req._submit_t,
+                           t1=req._admit_t, slot=slot,
+                           ttft_ms=req.ttft_ms, first_tokens=1,
+                           prompt_tokens=int(req.prompt.size))
             self._maybe_finish(slot, first)
 
     def _admit(self):
         self._admit_integrate(self._admit_dispatch())
+
+    def _slo_bucket(self, slo: str) -> Dict[str, int]:
+        st = self.slo_stats.get(slo)
+        if st is None:
+            st = self.slo_stats[slo] = {
+                "met": 0, "violated": 0, "cancelled": 0,
+                "ttft_violations": 0, "tpot_violations": 0,
+                "met_tokens": 0, "total_tokens": 0,
+            }
+        return st
+
+    def _finish_accounting(self, req: Request, reason: str):
+        """Shared finish/cancel bookkeeping: per-request TPOT, SLO
+        attainment (host ``slo_stats`` + telemetry counters + goodput
+        gauge), and the tracer's closing ``active`` span. Pure host
+        arithmetic over values the scheduler already holds."""
+        now = time.perf_counter()
+        req.finish_reason = reason
+        n_decode = len(req.output) - 1  # first token priced into TTFT
+        if req._admit_t and n_decode > 0:
+            req.tpot_ms = (now - req._admit_t) * 1e3 / n_decode
+        if req.slo is not None and reason != "cancel":
+            st = self._slo_bucket(req.slo)
+            ttft_ok = (req.ttft_target_ms is None
+                       or (req.ttft_ms is not None
+                           and req.ttft_ms <= req.ttft_target_ms))
+            tpot_ok = (req.tpot_target_ms is None or req.tpot_ms is None
+                       or req.tpot_ms <= req.tpot_target_ms)
+            req.slo_met = ttft_ok and tpot_ok
+            st["met" if req.slo_met else "violated"] += 1
+            if not ttft_ok:
+                st["ttft_violations"] += 1
+            if not tpot_ok:
+                st["tpot_violations"] += 1
+            st["total_tokens"] += len(req.output)
+            if req.slo_met:
+                st["met_tokens"] += len(req.output)
+            if self._tel is not None:
+                tracked = st["met"] + st["violated"]
+                self._tel.on_slo(req.slo, req.slo_met,
+                                 st["met"] / tracked)
+        elif req.slo is not None:
+            self._slo_bucket(req.slo)["cancelled"] += 1
+        tr = self._tracer
+        if tr is not None and tr.want_request(req.rid):
+            t0 = req._admit_t or now
+            if reason == "cancel":
+                tr.request(req.rid, "cancel",
+                           stage="active" if req._admit_t else "queued",
+                           tokens=len(req.output))
+            else:
+                tr.request(req.rid, "active", t0=t0, t1=now,
+                           tokens=len(req.output), reason=reason,
+                           tpot_ms=req.tpot_ms, slo=req.slo or "",
+                           slo_met=req.slo_met)
+
+    def _release_slot(self, slot: int):
+        """Return a slot to the scheduler: active flag, length, free
+        heap, request map, and (paged) every page ref — the ONE
+        teardown path finish and cancel both use."""
+        self.active[slot] = False
+        self.seq_lens[slot] = 0
+        heapq.heappush(self._free_heap, slot)
+        del self._slot_req[slot]
+        if self.pool is not None:
+            self.pool.free(slot)  # releases adopted prefix refs too
 
     def _maybe_finish(self, slot: int, tok: int):
         req = self._slot_req.get(slot)
         if req is None:
             return
         hit_eos = (req.eos_token_id is not None and tok == req.eos_token_id)
-        if hit_eos or len(req.output) >= req.max_new_tokens or \
-                self.seq_lens[slot] + 1 >= self.cfg.max_len:
-            req.done = True
-            self._finished[req.rid] = req
-            self.active[slot] = False
-            self.seq_lens[slot] = 0
-            heapq.heappush(self._free_heap, slot)
-            del self._slot_req[slot]
-            if self.pool is not None:
-                self.pool.free(slot)
-            if self._tel is not None:
-                self._tel.on_finish()
+        if hit_eos:
+            reason = "eos"
+        elif len(req.output) >= req.max_new_tokens:
+            reason = "max_new_tokens"
+        elif self.seq_lens[slot] + 1 >= self.cfg.max_len:
+            reason = "max_len"
+        else:
+            return
+        req.done = True
+        self._finished[req.rid] = req
+        self._release_slot(slot)
+        self._finish_accounting(req, reason)
+        if self._tel is not None:
+            self._tel.on_finish(req.tpot_ms)
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request mid-flight, leak-free: a QUEUED request is
+        removed from the queue; an ACTIVE one frees its slot and
+        releases every paged KV page AND prefix-cache ref it holds
+        (``pool.free`` decrements per-page refcounts, so shared prefix
+        pages survive in the store — only this request's ownership is
+        dropped). Returns False for unknown / already-finished ids.
+
+        Call from the scheduler thread (the same contract as ``step``):
+        an in-flight decode chunk's later writes to the freed pages are
+        stream-ordered BEFORE any re-allocation's prefill writes, so
+        cancellation never corrupts a successor — the host loop skips
+        the cancelled slot's remaining chunk tokens via the ``active``
+        mask. The canonical drain primitive ROADMAP item 5's
+        timeout/priority scheduler builds on."""
+        # queued: remove without ever granting a slot. Snapshot-then-
+        # remove-by-identity: add_request may append from a producer
+        # thread, and deque iteration raises on concurrent mutation
+        # while remove() is a single atomic op.
+        req = next((r for r in list(self._queue)
+                    if r.rid == request_id), None)
+        if req is not None:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                req = None  # raced out of the queue
+        if req is None:
+            # active: free the slot + pages
+            slot = next((s for s, r in self._slot_req.items()
+                         if r.rid == request_id), None)
+            if slot is None:
+                return False
+            req = self._slot_req[slot]
+            self._release_slot(slot)
+        req.done = True
+        req.cancelled = True
+        self._finished[request_id] = req
+        self._finish_accounting(req, "cancel")
+        if self._tel is not None:
+            self._tel.on_cancel()
+        return True
 
     def step(self) -> bool:
         """Admit waiting requests, run one decode step for all active
@@ -1295,6 +1556,10 @@ class ContinuousBatchingEngine:
             if self._tel is not None:
                 self._tel.on_spec_fallback()
         t0 = time.perf_counter()
+        tr = self._tracer
+        seq = tr.next_step() if tr is not None else 0
+        adv = {} if tr is not None and tr.want_step(seq) else None
+        occ = float(self.active.sum()) / self.cfg.max_slots
         self._cow_for_decode(1)
         use_samp, samp = self._slot_sampling()
         self._key, sub = jax.random.split(self._key)
@@ -1312,17 +1577,28 @@ class ContinuousBatchingEngine:
                 nxt, self.caches = self._decode()(
                     self._pb, toks, self.caches, lens, sub, samp,
                     use_samp)
+        t_disp = time.perf_counter()
         nxt = np.asarray(nxt)
+        t_sync = time.perf_counter()
         emitted = 0
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
                 continue
             tok = int(nxt[slot])
-            self._slot_req[slot].output.append(tok)
+            req = self._slot_req[slot]
+            req.output.append(tok)
             self.seq_lens[slot] += 1
             self.last_tok[slot] = tok
             emitted += 1
+            if adv is not None:
+                adv[req.rid] = 1
             self._maybe_finish(slot, tok)
+        if adv is not None:
+            tr.step(seq, "decode", t0, time.perf_counter(),
+                    occupancy=occ, tokens_advanced=emitted,
+                    chunk_budget_spent=1, advanced=adv,
+                    dispatch_ms=(t_disp - t0) * 1e3,
+                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
         if self._tel is not None:
             self._tel.on_tokens(emitted,
                                 (time.perf_counter() - t0) * 1e3)
@@ -1395,6 +1671,11 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         S = cfg.spec_k + 1
         t0 = time.perf_counter()
+        tr = self._tracer
+        seq = tr.next_step() if tr is not None else 0
+        adv = {} if tr is not None and tr.want_step(seq) else None
+        spec_by_rid = {} if adv is not None else None
+        occ = float(self.active.sum()) / cfg.max_slots
         self._cow_for_decode(S)
         sentinel = cfg.max_len
         ids = np.zeros((cfg.max_slots, S), np.int64)
@@ -1424,9 +1705,11 @@ class ContinuousBatchingEngine:
             self.layer_caches = caches
         else:
             self.caches = caches
+        t_disp = time.perf_counter()
         # admission dispatches behind the in-flight verify (stream
         # order, exactly like step_chunk's decode-chunk overlap)
         pending = self._admit_dispatch()
+        t_admit = time.perf_counter()
         preds_np = np.asarray(preds)  # ONE sync for up to S tokens/slot
         acc_np = np.asarray(accepted)
         t_sync = time.perf_counter()
@@ -1447,7 +1730,11 @@ class ContinuousBatchingEngine:
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
                 emitted += 1
+                if adv is not None:
+                    adv[req.rid] = adv.get(req.rid, 0) + 1
                 self._maybe_finish(slot, tok)
+            if spec_by_rid is not None and n:
+                spec_by_rid[req.rid] = [n, a]
             if n:
                 req._spec_proposed += n
                 req._spec_accepted += a
@@ -1459,6 +1746,19 @@ class ContinuousBatchingEngine:
         self.spec_stats["proposed"] += proposed_tot
         self.spec_stats["accepted"] += accepted_tot
         self.spec_stats["emitted"] += emitted
+        if adv is not None:
+            # device_wall_ms_est spans dispatch-done -> token sync; the
+            # overlapped admission host work inside that window is
+            # reported separately so a reader can subtract it when a
+            # first-time prefill compile (host side) dominates
+            tr.step(seq, "verify", t0, time.perf_counter(),
+                    occupancy=occ, tokens_advanced=emitted,
+                    chunk_budget_spent=S, advanced=adv,
+                    proposed=proposed_tot, accepted=accepted_tot,
+                    spec=spec_by_rid,
+                    dispatch_ms=(t_disp - t0) * 1e3,
+                    admit_dispatch_ms=(t_admit - t_disp) * 1e3,
+                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
         self._admit_integrate(pending)
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
@@ -1526,6 +1826,10 @@ class ContinuousBatchingEngine:
             if self._tel is not None:
                 self._tel.on_spec_fallback()
         t0 = time.perf_counter()
+        tr = self._tracer
+        seq = tr.next_step() if tr is not None else 0
+        adv = {} if tr is not None and tr.want_step(seq) else None
+        occ = float(self.active.sum()) / self.cfg.max_slots
         K = max_chunk
         # capture the chunk's view BEFORE admission: newly admitted
         # slots must not decode mid-chunk (their lengths land at
@@ -1549,9 +1853,11 @@ class ContinuousBatchingEngine:
             self.layer_caches = caches
         else:
             self.caches = caches
+        t_disp = time.perf_counter()
         # admission dispatches behind the in-flight chunk (stream order:
         # chunk → prefills → inserts into the chunk's output caches)
         pending = self._admit_dispatch()
+        t_admit = time.perf_counter()
         toks_np = np.asarray(toks_all)  # ONE sync for K tokens
         # TPOT window closes at the chunk's token sync — before the
         # admitted requests' first-token syncs in _admit_integrate, so
@@ -1567,11 +1873,24 @@ class ContinuousBatchingEngine:
                         or k >= budget[slot]):
                     continue
                 tok = int(toks_np[k, slot])
-                self._slot_req[slot].output.append(tok)
+                req = self._slot_req[slot]
+                req.output.append(tok)
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
                 emitted += 1
+                if adv is not None:
+                    adv[req.rid] = adv.get(req.rid, 0) + 1
                 self._maybe_finish(slot, tok)
+        if adv is not None:
+            # admit_dispatch_ms: host admission work OVERLAPPING the
+            # dispatch->sync window — subtract it from the device-wall
+            # estimate when a first-time compile lands in admission
+            tr.step(seq, "decode_chunk", t0, time.perf_counter(),
+                    occupancy=occ, tokens_advanced=emitted,
+                    chunk_budget_spent=K, advanced=adv,
+                    dispatch_ms=(t_disp - t0) * 1e3,
+                    admit_dispatch_ms=(t_admit - t_disp) * 1e3,
+                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
         self._admit_integrate(pending)
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
@@ -1654,22 +1973,27 @@ class ContinuousBatchingEngine:
         return len(self._queue), occ, used, total
 
     def metrics_snapshot(self) -> dict:
-        """Aggregated serving metrics: TTFT/TPOT percentiles, queue
-        depth (current/peak), batch occupancy, KV-pool utilization and
-        request/token counters. ``{"telemetry": "off"}`` when the
-        telemetry flag is disabled."""
+        """ONE unified serving document: registry aggregates (TTFT/TPOT
+        percentiles, queue depth, occupancy, KV utilization, counters —
+        when telemetry is on) plus the host-side prefix-cache, spec-
+        decode and SLO sub-snapshots, which are ALWAYS present (plain
+        host counters survive ``PT_FLAGS_telemetry=off``). Bench ledger
+        lines and the dump CLI read this one call instead of stitching
+        ``prefix_snapshot`` + ``spec_snapshot`` + ``slo_snapshot``."""
         if self._tel is None:
-            return {"telemetry": "off"}
-        # refresh point-in-time gauges so an idle engine still reports
-        # its current state
-        self._tel.on_state(*self._tel_state())
-        snap = self._tel.snapshot()
+            snap = {"telemetry": "off"}
+        else:
+            # refresh point-in-time gauges so an idle engine still
+            # reports its current state
+            self._tel.on_state(*self._tel_state())
+            snap = self._tel.snapshot()
         snap["slots"] = {
             "active": int(self.active.sum()),
             "max": self.cfg.max_slots,
         }
         snap["prefix_cache"] = self.prefix_snapshot()
         snap["spec_decode"] = self.spec_snapshot()
+        snap["slo"] = self.slo_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -1696,6 +2020,60 @@ class ContinuousBatchingEngine:
                                  if st["proposed"] else 0.0)
         return st
 
+    def slo_snapshot(self) -> dict:
+        """SLO attainment per class + overall goodput (plain host
+        counters — available even with PT_FLAGS_telemetry=off, which is
+        how the bench goodput sweep reads them). ``goodput`` is
+        met / (met + violated) over SLO-tracked finishes; cancelled
+        requests are counted separately, never as violations."""
+        classes = {}
+        met = violated = 0
+        # list(): slo_stats grows a key on a class's FIRST finish, and
+        # this runs on the /healthz scrape thread too — iterating the
+        # live dict would race the scheduler with RuntimeError
+        for cls, st in list(self.slo_stats.items()):
+            d = dict(st)
+            tracked = st["met"] + st["violated"]
+            d["goodput"] = st["met"] / tracked if tracked else None
+            classes[cls] = d
+            met += st["met"]
+            violated += st["violated"]
+        tracked = met + violated
+        return {
+            "classes": classes,
+            "met": met,
+            "violated": violated,
+            "goodput": met / tracked if tracked else None,
+        }
+
+    def slo_window_reset(self):
+        """Zero the host-side SLO counters — one measurement window per
+        load step in a goodput sweep (registry counters keep their
+        cumulative totals, same contract as metrics_window_reset)."""
+        self.slo_stats = {}
+
+    def backpressure(self) -> dict:
+        """Honest admission readiness for ``/healthz``: queue depth,
+        free slots/pages and whether admission is SATURATED (requests
+        waiting with zero free slots) — the state a router drains a
+        replica on. Host scheduler state only; safe from the scrape
+        thread (same staleness contract as ``_tel_state``)."""
+        qd = len(self._queue)
+        free = len(self._free_heap)
+        out = {
+            "queue_depth": qd,
+            "free_slots": free,
+            "occupancy": float(self.active.sum()) / self.cfg.max_slots,
+            # two saturation modes: no free slot, or — the PAGED
+            # engine's dominant stall — slots free but the last
+            # admission pass blocked on KV-pool pages
+            "saturated": qd > 0 and (free == 0 or self._pool_blocked),
+        }
+        if self.cfg.paged:
+            out["free_pages"] = self.pool.free_pages
+            out["pool_blocked"] = self._pool_blocked
+        return out
+
     def metrics_window_reset(self):
         """Reset percentile windows + peak trackers (cumulative
         counters keep running) — one measurement window per benchmark
@@ -1711,10 +2089,14 @@ class ContinuousBatchingEngine:
 def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                          host: str = "127.0.0.1", port: int = 0):
     """Serve ``/metrics`` (Prometheus text exposition of the process
-    registry) and ``/healthz`` (JSON liveness + engine snapshot) on a
-    daemon thread. Returns the ``ThreadingHTTPServer``; read
-    ``server.server_address`` for the bound port (``port=0`` picks a
-    free one), call ``server.shutdown()`` to stop."""
+    registry), ``/healthz`` (JSON readiness: liveness + engine snapshot
+    + back-pressure state — **503** while admission is saturated, so a
+    router can drain the replica) and ``/trace`` (the engine's
+    lifecycle tracer as Chrome trace-event JSON, Perfetto-loadable;
+    404 when tracing is off) on a daemon thread. Returns the
+    ``ThreadingHTTPServer``; read ``server.server_address`` for the
+    bound port (``port=0`` picks a free one), call
+    ``server.shutdown()`` to stop."""
     import json
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -1740,11 +2122,31 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                 elif path == "/healthz":
                     payload = {"status": "ok",
                                "telemetry": observability.enabled()}
+                    code = 200
                     if engine is not None:
+                        bp = engine.backpressure()
+                        payload["backpressure"] = bp
                         payload["engine"] = engine.metrics_snapshot()
+                        if bp["saturated"]:
+                            # honest readiness: requests are waiting
+                            # and no slot can take them — tell the
+                            # router to drain, don't smile through it
+                            payload["status"] = "saturated"
+                            code = 503
                     self._send(
-                        200, json.dumps(payload, default=str).encode(),
+                        code, json.dumps(payload, default=str).encode(),
                         "application/json")
+                elif path == "/trace":
+                    tracer = getattr(engine, "_tracer", None)
+                    if tracer is None:
+                        self._send(404, b"tracing disabled (telemetry "
+                                   b"off or trace_sample=0)",
+                                   "text/plain")
+                    else:
+                        body = json.dumps(
+                            observability.tracing.chrome_trace([tracer]),
+                            default=str).encode()
+                        self._send(200, body, "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")
             except BrokenPipeError:

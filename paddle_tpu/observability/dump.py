@@ -10,7 +10,12 @@ process's registry).
 Options:
   --prometheus   emit Prometheus text format instead of JSON
   --no-device    skip device queries (safe on a wedged accelerator)
-  --url URL      fetch a live /metrics endpoint and print it
+  --url URL      fetch a live endpoint and print it (point it at
+                 /metrics for exposition text, or at /trace for a
+                 server's Chrome trace JSON)
+  --trace        emit the in-process lifecycle tracers as Chrome
+                 trace-event JSON (load in Perfetto / chrome://tracing)
+  --trace-jsonl  emit the raw tracer events as JSON-lines instead
 """
 
 from __future__ import annotations
@@ -47,7 +52,13 @@ def main(argv=None) -> int:
     ap.add_argument("--no-device", action="store_true",
                     help="skip jax device queries")
     ap.add_argument("--url", default=None,
-                    help="scrape a live /metrics endpoint instead")
+                    help="scrape a live /metrics (or /trace) endpoint "
+                         "instead")
+    ap.add_argument("--trace", action="store_true",
+                    help="Chrome trace-event JSON of the in-process "
+                         "lifecycle tracers (Perfetto-loadable)")
+    ap.add_argument("--trace-jsonl", action="store_true",
+                    help="raw tracer events as JSON-lines")
     args = ap.parse_args(argv)
 
     if args.url:
@@ -57,7 +68,16 @@ def main(argv=None) -> int:
             sys.stdout.write(resp.read().decode("utf-8", "replace"))
         return 0
 
-    from . import comm, registry
+    from . import comm, registry, tracing
+
+    if args.trace:
+        json.dump(tracing.chrome_trace(), sys.stdout, default=str)
+        sys.stdout.write("\n")
+        return 0
+    if args.trace_jsonl:
+        out = tracing.jsonl()
+        sys.stdout.write(out + ("\n" if out else ""))
+        return 0
 
     if args.prometheus:
         sys.stdout.write(registry.global_registry().prometheus_text())

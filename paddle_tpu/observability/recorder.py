@@ -4,7 +4,11 @@ A ring buffer holds the last K step records (step index, wall time,
 loss / grad-norm / memory when sampled). When the watchdog sees a
 NaN/Inf loss or a grad-norm spike it dumps the whole window to a JSON
 file, so a blown-up run leaves evidence of the steps that led into the
-anomaly instead of just a stack trace.
+anomaly instead of just a stack trace. Dumps also attach the tail of
+every live lifecycle tracer (``tracing.recent_events``) — when a
+serving engine shares the process, the dump shows what the engine was
+DOING around the anomaly (which programs ran, which requests moved),
+not just metric values.
 """
 
 from __future__ import annotations
@@ -22,12 +26,15 @@ from .registry import get_registry
 class FlightRecorder:
     """Ring buffer of the last ``capacity`` step records, dumpable to
     JSON. Records are plain dicts of JSON-serializable host values —
-    recording never touches device state."""
+    recording never touches device state. ``trace_tail`` bounds how
+    many lifecycle-tracer events a dump attaches (0 disables)."""
 
     def __init__(self, capacity: int = 64,
-                 dump_dir: str = "flight_records"):
+                 dump_dir: str = "flight_records",
+                 trace_tail: int = 64):
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
+        self.trace_tail = int(trace_tail)
         self._buf: deque = deque(maxlen=self.capacity)
         self._n_dumps = 0
 
@@ -58,6 +65,16 @@ class FlightRecorder:
         }
         if extra:
             payload["extra"] = extra
+        if self.trace_tail > 0:
+            # last N request spans / step events across every live
+            # tracer: the anomaly dump shows what the engine was doing,
+            # not just metric values (empty when no tracer exists —
+            # training-only processes pay nothing)
+            from .tracing import recent_events
+
+            tail = recent_events(self.trace_tail)
+            if tail:
+                payload["trace_tail"] = tail
         try:
             with open(path, "w") as f:
                 json.dump(payload, f, indent=1, default=str)

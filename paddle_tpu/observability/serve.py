@@ -107,6 +107,30 @@ class ServingTelemetry:
         self._spec_rate = reg.gauge(
             "pt_serve_spec_acceptance_rate_cum",
             "cumulative accepted/proposed draft-token ratio", L)
+        self._req_tpot = reg.histogram(
+            "pt_serve_request_tpot_ms",
+            "per-request mean time per output token, computed at "
+            "finish over the request's whole decode (admit -> last "
+            "token) — the per-REQUEST latency SLOs are written "
+            "against, vs pt_serve_tpot_ms's per-dispatch view",
+            labels=L, buckets=exp_buckets(0.25, 2.0, 18))
+        self._cancelled = reg.counter(
+            "pt_serve_requests_cancelled_total",
+            "requests cancelled (queued or mid-flight) — their slots "
+            "and KV pages were released without finishing", L)
+        LS = ("engine", "slo")
+        self._slo_met = reg.counter(
+            "pt_serve_slo_met_total",
+            "finished requests that met every SLO target of their "
+            "class (TTFT and per-request TPOT)", LS)
+        self._slo_violated = reg.counter(
+            "pt_serve_slo_violated_total",
+            "finished requests that missed an SLO target", LS)
+        self._slo_goodput = reg.gauge(
+            "pt_serve_slo_goodput",
+            "met / (met + violated) for SLO-tracked finishes — the "
+            "fraction of traffic the engine is serving within target",
+            LS)
 
     def _lab(self) -> dict:
         return {"engine": self.engine_id}
@@ -123,8 +147,21 @@ class ServingTelemetry:
         if ttft_ms is not None:
             self._ttft.observe(ttft_ms, **lab)
 
-    def on_finish(self):
-        self._finished.inc(**self._lab())
+    def on_finish(self, tpot_ms: Optional[float] = None):
+        lab = self._lab()
+        self._finished.inc(**lab)
+        if tpot_ms is not None:
+            self._req_tpot.observe(tpot_ms, **lab)
+
+    def on_cancel(self):
+        self._cancelled.inc(**self._lab())
+
+    def on_slo(self, slo: str, met: bool, goodput: float):
+        """One SLO-tracked request finished: ``met`` is its attainment,
+        ``goodput`` the class's running met fraction."""
+        lab = dict(self._lab(), slo=slo)
+        (self._slo_met if met else self._slo_violated).inc(**lab)
+        self._slo_goodput.set(goodput, **lab)
 
     def on_prefix(self, hit_tokens: int, prompt_tokens: int,
                   cached_blocks: int):
@@ -206,6 +243,11 @@ class ServingTelemetry:
                 "p50": self._tpot.percentile(50, **lab),
                 "p90": self._tpot.percentile(90, **lab),
             },
+            "request_tpot_ms": {
+                "p50": self._req_tpot.percentile(50, **lab),
+                "p99": self._req_tpot.percentile(99, **lab),
+                "count": self._req_tpot.window_len(**lab),
+            },
             "queue_depth": {
                 "current": self._queue.value(**lab),
                 "peak": self._queue_peak.value(**lab),
@@ -224,6 +266,7 @@ class ServingTelemetry:
                 "submitted": self._submitted.value(**lab),
                 "admitted": self._admitted.value(**lab),
                 "finished": self._finished.value(**lab),
+                "cancelled": self._cancelled.value(**lab),
             },
             "tokens_generated": self._tokens.value(**lab),
             "prefix_cache": {
@@ -249,6 +292,7 @@ class ServingTelemetry:
         lab = self._lab()
         self._ttft.reset_window(**lab)
         self._tpot.reset_window(**lab)
+        self._req_tpot.reset_window(**lab)
         self._spec_accept_hist.reset_window(**lab)
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)

@@ -270,7 +270,7 @@ def test_serving_metrics_smoke():
     assert snap["kv_pool"]["total"] > 0
     assert snap["kv_pool"]["peak_utilization"] > 0
     assert snap["requests"] == {"submitted": 4, "admitted": 4,
-                                "finished": 4}
+                                "finished": 4, "cancelled": 0}
     assert snap["tokens_generated"] >= 4 * 5
     assert snap["tpot_ms"]["p50"] > 0
     # window reset clears percentiles/peaks, keeps counters
