@@ -864,6 +864,60 @@ def _goodput_scenario(model, base_ecfg, tpu):
     }
 
 
+def _fault_recovery_scenario(model, base_ecfg, tpu):
+    """Chaos A/B (recovery-overhead capture): the same greedy workload
+    runs clean and under a seeded fault storm (step-dispatch faults +
+    NaN-logits storms + latency spikes at the engine's dispatch
+    seams). The chaos arm quarantines each faulted step and replays
+    the affected requests through the existing chunked-prefill
+    program; reported are tokens/s per arm, the recovery/retry
+    counts, the wall overhead, and — the quality claim — whether the
+    two arms' greedy outputs were bit-identical (deterministic
+    replay). The injector is attached AFTER warm-up so a fault never
+    lands inside a first-time compile and bills it as recovery
+    time."""
+    from paddle_tpu.inference.resilience import FaultInjector
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    n_requests = 8 if tpu else 4
+    new_tokens = 24 if tpu else 6
+    max_chunk = 8 if tpu else 4
+    rng = np.random.default_rng(17)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab, (int(rng.integers(8, 24)),))
+               for _ in range(n_requests)]
+    spec = "step:0.08,nan:0.04,latency:0.05,seed:11,latency_ms:5"
+    out = {"fault_spec": spec, "n_requests": n_requests,
+           "new_tokens": new_tokens}
+    outputs = {}
+    for arm in ("clean", "chaos"):
+        eng = ContinuousBatchingEngine(model, base_ecfg)
+        eng.run([prompts[0]], max_new_tokens=2, max_chunk=max_chunk)
+        if arm == "chaos":
+            eng._injector = FaultInjector(spec)
+        t0 = time.perf_counter()
+        reqs = eng.run(prompts, new_tokens, max_chunk=max_chunk)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        rs = eng.resilience_stats
+        outputs[arm] = [r.output for r in reqs]
+        out[arm] = {
+            "tokens_per_sec": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "recoveries": rs["recoveries"],
+            "retries": rs["retries"],
+            "nan_steps": rs["nan_steps"],
+            "timeouts": rs["timeouts"],
+            "failed": rs["failed"],
+        }
+        eng = None  # drop this arm's KV pool before the next builds
+    out["outputs_match"] = outputs["clean"] == outputs["chaos"]
+    clean_w, chaos_w = out["clean"]["wall_s"], out["chaos"]["wall_s"]
+    out["recovery_overhead_pct"] = round(
+        (chaos_w / clean_w - 1.0) * 100.0, 1) if clean_w else None
+    return out
+
+
 def bench_serve7b(tpu_diags):
     """7B-class int8 weight-only decode through the paged continuous-
     batching engine — the first production-scale silicon path (VERDICT
@@ -921,6 +975,7 @@ def bench_serve7b(tpu_diags):
     shared_prefix = _shared_prefix_scenario(model, ecfg, tpu)
     spec_ngram = _spec_ngram_scenario(model, ecfg, tpu)
     goodput = _goodput_scenario(model, ecfg, tpu)
+    fault_recovery = _fault_recovery_scenario(model, ecfg, tpu)
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
@@ -970,6 +1025,7 @@ def bench_serve7b(tpu_diags):
         "shared_prefix": shared_prefix,
         "spec_ngram": spec_ngram,
         "goodput_under_slo": goodput,
+        "fault_recovery": fault_recovery,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,
             2 if cache_dtype == jnp.bfloat16 else 4),

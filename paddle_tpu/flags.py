@@ -132,6 +132,35 @@ define_flag("spec_decode", "off",
             "that stops drafting traffic that never accepts; off = "
             "today's one-token-per-pass decode (the parity oracle — "
             "greedy outputs are identical in every mode)")
+define_flag("fault_inject", "",
+            "serving fault injector (chaos testing): comma-separated "
+            "site:rate entries over the engine's dispatch seams — "
+            "step (dispatch exception), nan (NaN-logits storm), "
+            "latency (stall before dispatch), pool (simulated KV-pool "
+            "exhaustion at admission) — plus seed:<int> and "
+            "latency_ms:<float>, e.g. 'step:0.1,nan:0.05,seed:7'. "
+            "Each site draws from its own seeded RNG stream, so chaos "
+            "runs are deterministic and CPU-runnable. Empty = off "
+            "(zero overhead)")
+define_flag("serve_recovery", "auto",
+            "step-level crash recovery in the serving engine: catch a "
+            "failed decode/verify/prefill dispatch, quarantine the "
+            "step and re-queue its in-flight requests for "
+            "deterministic replay (prompt+history re-prefilled "
+            "through the existing chunked-prefill program; greedy "
+            "outputs stay bit-identical), with bounded per-request "
+            "retries (EngineConfig.max_retries). auto = recover "
+            "injected faults and XLA runtime errors, propagate host "
+            "logic errors; all = recover any Exception; off = every "
+            "fault propagates")
+define_flag("degradation", True,
+            "graceful-degradation ladder in the serving engine: "
+            "sustained admission saturation sheds batch-class "
+            "admissions then throttles admission; repeated step "
+            "faults additionally disable speculative decoding and "
+            "prefix-cache adoption (min_service). Surfaced through "
+            "backpressure()/healthz/the tracer; never changes greedy "
+            "outputs. off = the controller is not constructed")
 define_flag("kv_cache_dtype", "auto",
             "serving KV-cache dtype when EngineConfig.cache_dtype is "
             "'auto': auto = bfloat16 on TPU (halves decode KV traffic), "

@@ -349,9 +349,15 @@ from .prefix_cache import (  # noqa: E402,F401
     PagedPrefixStore,
     block_hashes,
 )
+from .resilience import (  # noqa: E402,F401
+    DegradationController,
+    FaultInjector,
+    InjectedFault,
+)
 from .serving import (  # noqa: E402,F401
     ContinuousBatchingEngine,
     EngineConfig,
+    MetricsServer,
     Request,
     start_metrics_server,
 )

@@ -1,0 +1,313 @@
+"""Resilience layer for the serving engine: deterministic fault
+injection + the graceful-degradation ladder.
+
+Parity intent: upstream Paddle's production story leans on Fleet's
+elastic fault tolerance (``paddle.distributed.launch`` restarts whole
+workers). A TPU serving engine can do much better than a process
+restart: every compiled program is functionally pure and every token
+the engine has emitted lives host-side, so a failed step can be
+QUARANTINED (its device effects discarded) and the affected requests
+replayed bit-identically through the existing chunked-prefill program.
+This module holds the two host-side pieces the engine composes:
+
+* ``FaultInjector`` — a seeded, per-site RNG that makes chaos testing
+  deterministic and CPU-runnable. Sites are the engine's dispatch
+  seams (``step`` = dispatch exception, ``nan`` = NaN-logits storm,
+  ``latency`` = stall before dispatch, ``pool`` = simulated KV-pool
+  exhaustion at admission). Each site draws from its OWN
+  ``numpy`` Generator stream, so enabling one site never perturbs
+  another's schedule — two runs with the same spec and seed fire at
+  exactly the same points.
+
+* ``DegradationController`` — a small ladder state machine. Sustained
+  admission saturation walks the level up to ``shed_batch`` →
+  ``throttle`` (capacity causes get capacity remedies); repeated step
+  faults in a sliding window jump straight to ``min_service``, which
+  additionally disables speculative decoding and prefix-cache adoption
+  (machinery failures get machinery remedies — and neither switch can
+  change greedy outputs, only throughput). Good ticks walk the level
+  back down one rung at a time.
+
+Everything here is plain host bookkeeping: no device traffic, no
+compiled programs, importable and testable without jax.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+# injection sites, in the order the engine consults them at a seam
+SITES = ("step", "nan", "latency", "pool")
+
+# exception classes "auto" recovery treats as device/runtime failures
+# (recoverable by quarantine + replay) as opposed to host logic bugs
+# (which must propagate). XlaRuntimeError subclasses RuntimeError, so
+# the isinstance check stays strict: a plain RuntimeError raised by
+# scheduler code is NOT swallowed.
+RUNTIME_ERRORS: tuple = ()
+try:  # pragma: no cover - presence depends on the jaxlib build
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaErr
+
+    RUNTIME_ERRORS += (_XlaErr,)
+except Exception:  # noqa: BLE001
+    pass
+try:  # pragma: no cover
+    from jax.errors import JaxRuntimeError as _JaxErr
+
+    if _JaxErr not in RUNTIME_ERRORS:
+        RUNTIME_ERRORS += (_JaxErr,)
+except Exception:  # noqa: BLE001
+    pass
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the :class:`FaultInjector` at a dispatch seam.
+
+    Raised BEFORE the compiled call dispatches, so the device cache
+    state is untouched — recovery can requeue the step's requests
+    without rebuilding the pools. ``site`` is the injection site
+    (``"step"`` | ``"nan"``); ``program`` names the seam it fired at.
+    """
+
+    def __init__(self, site: str, program: str = ""):
+        self.site = site
+        self.program = program
+        super().__init__(
+            f"injected {site!r} fault at {program or 'dispatch'}")
+
+
+class FaultInjector:
+    """Seeded per-site fault schedule for chaos tests.
+
+    ``spec`` is the ``PT_FLAGS_fault_inject`` string: comma-separated
+    ``site:rate`` entries plus optional ``seed:<int>`` /
+    ``latency_ms:<float>``, e.g. ``"step:0.1,nan:0.05,seed:7"``.
+    Rates are per-consultation probabilities in ``[0, 1]``; a site
+    with rate 0 never draws, so adding a site to the spec never shifts
+    another site's stream.
+    """
+
+    def __init__(self, spec: str = "", seed: int = 0,
+                 latency_ms: float = 25.0,
+                 rates: Optional[Dict[str, float]] = None):
+        self.rates = {s: 0.0 for s in SITES}
+        self.seed = int(seed)
+        self.latency_ms = float(latency_ms)
+        if rates:
+            for site, rate in rates.items():
+                self._set_rate(site, rate)
+        for part in filter(None,
+                           (p.strip() for p in str(spec).split(","))):
+            key, sep, val = part.partition(":")
+            key = key.strip().lower()
+            if not sep:
+                raise ValueError(
+                    f"fault_inject entry {part!r} is not 'key:value'")
+            if key == "seed":
+                self.seed = int(val)
+            elif key == "latency_ms":
+                self.latency_ms = float(val)
+                if self.latency_ms <= 0:
+                    raise ValueError(
+                        f"latency_ms must be > 0; got {val}")
+            else:
+                self._set_rate(key, val)
+        # independent, seed-derived stream per site: deterministic and
+        # mutually isolated (numpy seeds on the whole tuple)
+        self._rngs = {
+            s: np.random.default_rng((0x5EED, self.seed, i))
+            for i, s in enumerate(SITES)
+        }
+        self.draws = {s: 0 for s in SITES}
+        self.fires = {s: 0 for s in SITES}
+
+    def _set_rate(self, site: str, rate):
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; valid sites: {SITES} "
+                f"(plus seed:<int>, latency_ms:<float>)")
+        r = float(rate)
+        if not 0.0 <= r <= 1.0:
+            raise ValueError(
+                f"fault rate for {site!r} must be in [0, 1]; got {r}")
+        self.rates[site] = r
+
+    @classmethod
+    def from_flag(cls) -> Optional["FaultInjector"]:
+        """Build from ``PT_FLAGS_fault_inject``; None when the flag is
+        empty (the production default — zero overhead)."""
+        from .. import flags
+
+        spec = str(flags.flag("fault_inject")).strip()
+        return cls(spec) if spec else None
+
+    @property
+    def enabled(self) -> bool:
+        return any(r > 0 for r in self.rates.values())
+
+    def fire(self, site: str) -> bool:
+        """One consultation of ``site``'s schedule. Deterministic:
+        the k-th call for a given (seed, site) always returns the same
+        verdict, regardless of what other sites are configured."""
+        rate = self.rates[site]
+        if rate <= 0.0:
+            return False
+        self.draws[site] += 1
+        hit = bool(self._rngs[site].random() < rate)
+        if hit:
+            self.fires[site] += 1
+        return hit
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "seed": self.seed,
+            "latency_ms": self.latency_ms,
+            "rates": dict(self.rates),
+            "draws": dict(self.draws),
+            "fires": dict(self.fires),
+        }
+
+
+# degradation ladder levels, mildest first
+LEVEL_NAMES = ("normal", "shed_batch", "throttle", "min_service")
+
+
+class DegradationController:
+    """Ladder state machine for graceful degradation.
+
+    Called once per scheduler tick with the tick's health verdict.
+    Escalation is cause-split:
+
+    * **saturation** (requests waiting, no slot/pages to admit them)
+      is a CAPACITY problem: ``trip_after`` consecutive saturated
+      ticks climb one rung, capped at ``sat_max_level`` (default 2 =
+      ``throttle``). Shedding batch-class admissions and throttling
+      admission keeps interactive traffic alive; disabling correct
+      machinery would not add capacity.
+    * **faults** (quarantined steps, NaN storms) are a MACHINERY
+      problem: ``fault_trip`` faults inside a sliding
+      ``fault_window``-tick window jump straight to ``max_level``
+      (``min_service``), which additionally switches off speculative
+      decoding and prefix-cache adoption — the two subsystems whose
+      failure modes ("repeated spec-verify failures", poisoned shared
+      pages) the jump exists for. Neither switch changes greedy
+      outputs, only throughput.
+
+    ``recover_after`` consecutive healthy ticks walk one rung back
+    down (never past a still-hot fault window), so recovery is
+    deliberately slower than escalation.
+    """
+
+    def __init__(self, trip_after: int = 4, recover_after: int = 6,
+                 fault_window: int = 32, fault_trip: int = 3,
+                 sat_max_level: int = 2, max_level: int = 3):
+        for name, v, lo in (("trip_after", trip_after, 1),
+                            ("recover_after", recover_after, 1),
+                            ("fault_window", fault_window, 1),
+                            ("fault_trip", fault_trip, 1),
+                            ("sat_max_level", sat_max_level, 0),
+                            ("max_level", max_level, 0)):
+            if int(v) < lo:
+                raise ValueError(f"{name} must be >= {lo}; got {v}")
+        if sat_max_level > max_level:
+            raise ValueError("sat_max_level cannot exceed max_level")
+        self.trip_after = int(trip_after)
+        self.recover_after = int(recover_after)
+        self.fault_window = int(fault_window)
+        self.fault_trip = int(fault_trip)
+        self.sat_max_level = int(sat_max_level)
+        self.max_level = int(max_level)
+        self.level = 0
+        self._tick = 0
+        self._sat_streak = 0
+        self._good_streak = 0
+        self._fault_log: deque = deque()  # (tick, count)
+        self.transitions: deque = deque(maxlen=64)
+
+    # ---------------- per-tick update ----------------
+    def _window_faults(self) -> int:
+        horizon = self._tick - self.fault_window
+        while self._fault_log and self._fault_log[0][0] <= horizon:
+            self._fault_log.popleft()
+        return sum(c for _, c in self._fault_log)
+
+    def observe(self, *, saturated: bool, faults: int = 0) -> int:
+        """One scheduler tick's health report; returns the new level."""
+        self._tick += 1
+        if faults > 0:
+            self._fault_log.append((self._tick, int(faults)))
+        wf = self._window_faults()
+        if saturated:
+            self._sat_streak += 1
+        else:
+            self._sat_streak = 0
+        if not saturated and faults == 0:
+            self._good_streak += 1
+        else:
+            self._good_streak = 0
+        new = self.level
+        if self._sat_streak >= self.trip_after and new < self.sat_max_level:
+            new += 1
+            self._sat_streak = 0
+        if wf >= self.fault_trip and new < self.max_level:
+            new = self.max_level
+        if self._good_streak >= self.recover_after and new > 0 \
+                and wf < self.fault_trip:
+            new -= 1
+            self._good_streak = 0
+        if new != self.level:
+            self.transitions.append({
+                "tick": self._tick, "from": self.level, "to": new,
+                "saturated": bool(saturated), "window_faults": wf,
+            })
+            self.level = new
+        return new
+
+    # ---------------- action bits ----------------
+    @property
+    def degraded(self) -> bool:
+        return self.level > 0
+
+    @property
+    def shed_batch(self) -> bool:
+        """Defer (never drop) batch-class admissions."""
+        return self.level >= 1
+
+    @property
+    def throttle(self) -> bool:
+        """Cap admission to one request per wave; ``step_adaptive``
+        drops to its probe chunk (an already-compiled program — the
+        ladder never triggers a new jit specialization)."""
+        return self.level >= 2
+
+    @property
+    def disable_spec(self) -> bool:
+        return self.level >= 3
+
+    @property
+    def disable_prefix(self) -> bool:
+        return self.level >= 3
+
+    @property
+    def name(self) -> str:
+        return LEVEL_NAMES[min(self.level, len(LEVEL_NAMES) - 1)]
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": True,
+            "level": self.level,
+            "name": self.name,
+            "degraded": self.degraded,
+            "shed_batch": self.shed_batch,
+            "throttle": self.throttle,
+            "disable_spec": self.disable_spec,
+            "disable_prefix": self.disable_prefix,
+            "sat_streak": self._sat_streak,
+            "good_streak": self._good_streak,
+            "window_faults": self._window_faults(),
+            "transitions": list(self.transitions),
+        }

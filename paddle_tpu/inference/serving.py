@@ -42,6 +42,12 @@ from ..core.functional import (
 from ..core.module import Layer
 from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
 from .prefix_cache import ContigPrefixStore, PagedPrefixStore, block_hashes
+from .resilience import (
+    RUNTIME_ERRORS,
+    DegradationController,
+    FaultInjector,
+    InjectedFault,
+)
 from .spec_decode import Drafter, NgramDrafter
 
 # trace-time compile accounting: each compiled-program body bumps its
@@ -79,6 +85,11 @@ class EngineConfig:
     # spec_k + 1 (drafts + the last accepted token), so this is a
     # compile-time shape, not a runtime knob
     spec_k: int = 4
+    # crash recovery (PT_FLAGS_serve_recovery): how many times a
+    # request may be re-queued for deterministic replay after a
+    # quarantined step before it finishes with reason "failed";
+    # add_request(max_retries=) overrides per request
+    max_retries: int = 2
 
 
 def _resolve_cache_dtype(requested):
@@ -131,8 +142,15 @@ def _validate_buckets(cfg: "EngineConfig") -> List[int]:
 # slo_snapshot, goodput) — the SLO-aware scheduler that acts on these
 # classes is the next PR, and it reads exactly this bookkeeping.
 SLO_CLASSES: Dict[str, Dict[str, float]] = {
-    "interactive": {"ttft_target_ms": 250.0, "tpot_target_ms": 100.0},
-    "batch": {"ttft_target_ms": 5000.0, "tpot_target_ms": 1000.0},
+    # deadline_ms is the class's default HARD deadline (enforced by
+    # the scheduler: the request finishes with reason "timeout" and
+    # its slot/pages/prefix refs are released), distinct from the
+    # soft attainment targets above; add_request(deadline_ms=)
+    # overrides, untracked requests default to no deadline
+    "interactive": {"ttft_target_ms": 250.0, "tpot_target_ms": 100.0,
+                    "deadline_ms": 30_000.0},
+    "batch": {"ttft_target_ms": 5000.0, "tpot_target_ms": 1000.0,
+              "deadline_ms": 300_000.0},
 }
 
 
@@ -148,8 +166,14 @@ class Request:
     done: bool = False
     cancelled: bool = False
     # why the request left its slot: eos | max_new_tokens | max_len |
-    # cancel (None while in flight)
+    # cancel | timeout | failed (None while in flight)
     finish_reason: Optional[str] = None
+    # hard deadline: wall-clock budget from submission; the scheduler
+    # expires the request (queued OR mid-decode) once it passes,
+    # freeing slot/pages/prefix refs through the one teardown path
+    deadline_ms: Optional[float] = None
+    # per-request replay-retry bound (None = EngineConfig.max_retries)
+    max_retries: Optional[int] = None
     # SLO class + targets (None = untracked); tpot_ms is the
     # per-request mean decode latency, computed once at finish
     slo: Optional[str] = None
@@ -166,6 +190,10 @@ class Request:
     greedy: Optional[bool] = None
     _submit_t: float = 0.0
     _admit_t: float = 0.0
+    # absolute deadline instant (perf_counter seconds; 0 = none)
+    _deadline_t: float = 0.0
+    # replay re-queues consumed so far (crash recovery)
+    _retries: int = 0
     # prompt block digests, computed once — a pool-blocked request is
     # re-matched every scheduler tick and must not re-hash each time
     _hashes: Optional[List[bytes]] = None
@@ -184,12 +212,17 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, model: Layer, config: Optional[EngineConfig] = None,
-                 mesh=None, drafter: Optional[Drafter] = None):
+                 mesh=None, drafter: Optional[Drafter] = None,
+                 fault_injector: Optional[FaultInjector] = None):
         """``drafter``: optional ``spec_decode.Drafter`` override for
         speculative decoding (default: ``NgramDrafter`` when
         ``PT_FLAGS_spec_decode`` is ``ngram``/``auto`` — the flag gates
         the path either way, so a custom drafter with the flag off is
         inert).
+
+        ``fault_injector``: optional ``resilience.FaultInjector``
+        override for chaos testing (default: built from
+        ``PT_FLAGS_fault_inject``; None when the flag is empty).
 
         ``mesh``: optional ``jax.sharding.Mesh`` with a ``tp`` axis —
         tensor-parallel serving (parity: the reference's multi-GPU
@@ -271,8 +304,8 @@ class ContinuousBatchingEngine:
 
         mcfg = model.config
         self._n_layers = mcfg.num_hidden_layers
-        kvh = mcfg.num_key_value_heads
-        hd = mcfg.head_dim
+        self._kvh = mcfg.num_key_value_heads
+        self._hd = mcfg.head_dim
         if cfg.page_size < 1:
             # load-bearing in BOTH modes now: paged page granularity,
             # and the prefix-cache hash block length in contiguous mode
@@ -287,28 +320,7 @@ class ContinuousBatchingEngine:
                     raise ValueError(
                         f"seq bucket {bkt} not divisible by page_size="
                         f"{cfg.page_size} — prefill scatters whole pages")
-            max_pages_per_slot = cfg.max_len // cfg.page_size
-            # +1: page 0 is the inactive-slot write sink, never allocated
-            n_pages = cfg.n_pages or \
-                cfg.max_slots * max_pages_per_slot + 1
-            self.pool = PagePool(n_pages, cfg.page_size, cfg.max_slots,
-                                 max_pages_per_slot, reserve_sink=True)
-            self.layer_caches = init_paged_pool(
-                self._n_layers, n_pages, cfg.page_size, kvh, hd,
-                dtype=self.cache_dtype)
-            if mesh is not None:
-                self.layer_caches = [
-                    PagedLayerCache(self._shard_kv(c.k_pages, axis=0),
-                                    self._shard_kv(c.v_pages, axis=0))
-                    for c in self.layer_caches]
-        else:
-            self.pool = None
-            self.caches = model.init_kv_caches(
-                cfg.max_slots, cfg.max_len, dtype=self.cache_dtype)
-            if mesh is not None:
-                self.caches = [
-                    (self._shard_kv(k), self._shard_kv(v))
-                    for k, v in self.caches]
+        self._init_cache_state()
 
         self._decode_c = None
         self._decode_nc = None
@@ -396,6 +408,64 @@ class ContinuousBatchingEngine:
             self._tracer = observability.Tracer(
                 engine_id=self._tel.engine_id)
 
+        # ---------------- resilience layer ----------------
+        # seeded fault injector (PT_FLAGS_fault_inject; ctor override
+        # for tests/benches) — None in production, zero overhead
+        self._injector = (fault_injector if fault_injector is not None
+                          else FaultInjector.from_flag())
+        rec = str(flags.flag("serve_recovery")).lower()
+        if rec not in ("auto", "all", "off"):
+            raise ValueError(
+                f"PT_FLAGS_serve_recovery must be auto|all|off; got "
+                f"{rec!r}")
+        self._recovery_mode = rec
+        # graceful-degradation ladder (PT_FLAGS_degradation)
+        self._degctl = (DegradationController()
+                        if bool(flags.flag("degradation")) else None)
+        # drain(): admission stopped, in-flight runs to completion
+        self._draining = False
+        # faults observed since the last health tick (feeds the ladder)
+        self._faults_tick = 0
+        # host counters (available with telemetry off, like spec_stats)
+        self.resilience_stats = {
+            "recoveries": 0, "retries": 0, "failed": 0, "timeouts": 0,
+            "rebuilds": 0, "nan_steps": 0, "faults": {},
+        }
+        # lazy flight recorder for NaN-storm postmortem dumps (rides
+        # PR 2's recorder: the dump attaches the tracer tail)
+        self._recorder = None
+
+    def _init_cache_state(self):
+        """(Re)build the KV-cache device arrays and the page-pool
+        bookkeeping — called at init and by hard crash recovery
+        (``_rebuild_caches``). Shapes are identical across rebuilds,
+        so the jitted programs never re-specialize (pinned by the
+        recovery compile-count guard)."""
+        cfg = self.cfg
+        if cfg.paged:
+            max_pages_per_slot = cfg.max_len // cfg.page_size
+            # +1: page 0 is the inactive-slot write sink, never allocated
+            n_pages = cfg.n_pages or \
+                cfg.max_slots * max_pages_per_slot + 1
+            self.pool = PagePool(n_pages, cfg.page_size, cfg.max_slots,
+                                 max_pages_per_slot, reserve_sink=True)
+            self.layer_caches = init_paged_pool(
+                self._n_layers, n_pages, cfg.page_size, self._kvh,
+                self._hd, dtype=self.cache_dtype)
+            if self.mesh is not None:
+                self.layer_caches = [
+                    PagedLayerCache(self._shard_kv(c.k_pages, axis=0),
+                                    self._shard_kv(c.v_pages, axis=0))
+                    for c in self.layer_caches]
+        else:
+            self.pool = None
+            self.caches = self.model.init_kv_caches(
+                cfg.max_slots, cfg.max_len, dtype=self.cache_dtype)
+            if self.mesh is not None:
+                self.caches = [
+                    (self._shard_kv(k), self._shard_kv(v))
+                    for k, v in self.caches]
+
     def _shard_kv(self, arr, axis=-2):
         """Shard the kv-head axis over tp (requires kv_heads % tp == 0):
         axis -2 for contiguous [..., kv_heads, head_dim] caches, axis 0
@@ -424,7 +494,9 @@ class ContinuousBatchingEngine:
                     greedy: Optional[bool] = None,
                     slo: Optional[str] = None,
                     ttft_target_ms: Optional[float] = None,
-                    tpot_target_ms: Optional[float] = None) -> int:
+                    tpot_target_ms: Optional[float] = None,
+                    deadline_ms: Optional[float] = None,
+                    max_retries: Optional[int] = None) -> int:
         """``temperature``/``top_k``/``top_p``: per-request sampling
         params, routed through ``generation.process_logits_batch``
         IN-JIT as per-slot vectors — setting any of them makes this
@@ -441,7 +513,20 @@ class ContinuousBatchingEngine:
         alone imply class ``"custom"``) are checked at finish —
         attainment lands in ``pt_serve_slo_{met,violated}_total``, the
         goodput gauge and ``engine.slo_snapshot()``. ``None`` leaves
-        the request SLO-untracked."""
+        the request SLO-untracked.
+
+        ``deadline_ms``: hard wall-clock budget from submission — the
+        scheduler expires the request (queued or mid-decode) once it
+        passes, finishing it with ``finish_reason="timeout"`` and
+        provably freeing its slot, KV pages and prefix refs. Defaults
+        to the SLO class's ``deadline_ms`` when ``slo`` is set, else
+        no deadline. Must be >= 1 ms: the scheduler checks deadlines
+        once per step, so a sub-millisecond deadline is shorter than a
+        single step can honor and would expire unconditionally.
+
+        ``max_retries``: per-request bound on crash-recovery replay
+        re-queues (default ``EngineConfig.max_retries``); past it the
+        request finishes with ``finish_reason="failed"``."""
         prompt = np.asarray(prompt).reshape(-1)
         if prompt.size == 0:
             # an empty prompt would "sample" from the last PADDED
@@ -481,12 +566,33 @@ class ContinuousBatchingEngine:
                 ttft_target_ms = defaults.get("ttft_target_ms")
             if tpot_target_ms is None:
                 tpot_target_ms = defaults.get("tpot_target_ms")
+            if deadline_ms is None:
+                deadline_ms = defaults.get("deadline_ms")
+        if deadline_ms is not None:
+            if deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0; got {deadline_ms}")
+            if deadline_ms < 1.0:
+                raise ValueError(
+                    f"deadline_ms={deadline_ms} is shorter than a "
+                    f"single scheduler step can honor (deadlines are "
+                    f"checked once per step; minimum 1 ms)")
+        if max_retries is not None and (
+                isinstance(max_retries, bool)
+                or not isinstance(max_retries, (int, np.integer))
+                or max_retries < 0):
+            raise ValueError(
+                f"max_retries must be a non-negative int; got "
+                f"{max_retries!r}")
         req = Request(self._next_rid, prompt, max_new_tokens, eos_token_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       greedy=greedy, slo=slo,
                       ttft_target_ms=ttft_target_ms,
                       tpot_target_ms=tpot_target_ms,
+                      deadline_ms=deadline_ms, max_retries=max_retries,
                       _submit_t=time.perf_counter())
+        if deadline_ms is not None:
+            req._deadline_t = req._submit_t + deadline_ms / 1e3
         self._next_rid += 1
         self._queue.append(req)
         if self._tel is not None:
@@ -913,22 +1019,42 @@ class ContinuousBatchingEngine:
         return self._verify_c
 
     # ---------------- prefix cache ----------------
-    def _match_prefix(self, req: Request):
-        """Longest cached block-aligned prefix for ``req.prompt``:
+    def _prefill_ids(self, req: Request) -> np.ndarray:
+        """The token sequence admission must prefill for ``req``: its
+        prompt — plus, for a request re-queued by crash recovery,
+        every token it had already generated (host-side truth the
+        quarantined step cannot lose). Replaying prompt+history
+        through the SAME chunked-prefill program recomputes the KV the
+        quarantine discarded and samples the NEXT token of the greedy
+        chain, so greedy outputs stay bit-identical to a fault-free
+        run."""
+        if req.output:
+            return np.concatenate(
+                [req.prompt, np.asarray(req.output, np.int64)])
+        return req.prompt
+
+    def _match_prefix(self, req: Request, ids=None):
+        """Longest cached block-aligned prefix for the request's
+        prefill ids (prompt, or prompt+history on replay; ``ids``
+        passes the caller's already-built array — a pool-blocked head
+        request retries every tick and must not re-concatenate):
         (hashes, matched entries, prefix_len, full_cover), with the
-        full-cover clamp — a fully-cached prompt still recomputes its
-        LAST token so prefill has a row to sample from (``full_cover``
-        reports that the clamp fired: the recompute row lands inside
-        the last shared page). The single site for the clamp rule:
-        both cache modes' admission arms go through here."""
+        full-cover clamp — a fully-cached sequence still recomputes
+        its LAST token so prefill has a row to sample from
+        (``full_cover`` reports that the clamp fired: the recompute
+        row lands inside the last shared page). The single site for
+        the clamp rule: both cache modes' admission arms go through
+        here."""
+        if ids is None:
+            ids = self._prefill_ids(req)
         if req._hashes is None:
-            req._hashes = block_hashes(req.prompt, self._prefix_block)
+            req._hashes = block_hashes(ids, self._prefix_block)
         hashes = req._hashes
         matched = self._prefix.match(hashes)
         prefix_len = len(matched) * self._prefix_block
-        full_cover = prefix_len >= req.prompt.size
+        full_cover = prefix_len >= ids.size
         if full_cover:
-            prefix_len = req.prompt.size - 1
+            prefix_len = ids.size - 1
         return hashes, matched, prefix_len, full_cover
 
     def _note_prefix(self, prefix_len: int, n: int,
@@ -1027,21 +1153,23 @@ class ContinuousBatchingEngine:
                             "copy-on-write needs a free page but the "
                             "pool is exhausted — size n_pages up")
 
-    def _paged_prefix_admit(self, slot: int, req: Request, need: int):
+    def _paged_prefix_admit(self, slot: int, req: Request, need: int,
+                            ids=None):
         """Claim pages for a request, sharing the longest cached
         block-aligned prefix. Returns (prefix_len, hashes) or None when
         the pool can't fit the request (slot left clean). A FULL-cover
         hit (prompt entirely cached) adopts every matched page and
         recomputes only the last token — the page it rewrites is
         shared, so it is copy-on-written first."""
-        pool, store = self.pool, self._prefix
+        pool = self.pool
+        store = None if self._prefix_disabled() else self._prefix
         hashes: List[bytes] = []
         shared: List[int] = []
         prefix_len = 0
         full_cover = False
         if store is not None:
             hashes, shared, prefix_len, full_cover = \
-                self._match_prefix(req)
+                self._match_prefix(req, ids)
         # feasibility precheck: pages the slot still needs from the
         # free list (adopted pages aren't on it; the full-cover COW
         # consumes one more). A pool-blocked request retries every
@@ -1053,8 +1181,14 @@ class ContinuousBatchingEngine:
         if full_cover and shared:
             required += 1  # the COW's fresh private page
         supply = pool.free_pages
-        if required > supply and store is not None:
-            supply += store.evictable_pages(pool, exclude=shared)
+        # eviction supply reads the REAL store, not the degradation-
+        # gated one: min_service only disables ADOPTION — pages the
+        # store retains stay evictable, and hiding them here would
+        # turn a reclaimable pool into a spurious "size n_pages up"
+        # crash (or a permanent pool-block that pins the ladder)
+        evict_src = self._prefix
+        if required > supply and evict_src is not None:
+            supply += evict_src.evictable_pages(pool, exclude=shared)
             if full_cover and shared \
                     and pool.ref.get(shared[-1], 0) == 1:
                 # the COW un-borrows the last shared page (back to
@@ -1107,7 +1241,7 @@ class ContinuousBatchingEngine:
         (zero copies — the chunk programs already queued the writes on
         the stream, so any future reader is ordered after them).
         Contiguous: slice the new blocks out of the slot's rows."""
-        store = self._prefix
+        store = None if self._prefix_disabled() else self._prefix
         if store is None or not hashes:
             return
         B = self._prefix_block
@@ -1143,6 +1277,27 @@ class ContinuousBatchingEngine:
         # fresh verdict each attempt: the flag self-heals the moment an
         # admission pass no longer blocks on the pool
         self._pool_blocked = False
+        if not self._queue:
+            return []
+        if self._draining and (not self._chunk_len
+                               or not self._drain_pending()):
+            # drain(): stop admitting FRESH requests — they stay
+            # queued for a resume() or the router to re-dispatch. The
+            # exception: crash-recovery replays (requests that were
+            # already in flight once) stay admissible on the chunked
+            # path, or a quarantine mid-drain would silently strand
+            # its victims behind a closed admission gate
+            return []
+        inj = self._injector
+        if inj is not None and inj.fire("pool"):
+            # simulated KV-pool exhaustion: admission blocks this tick
+            # exactly like a real pool-blocked head request would —
+            # backpressure()/healthz report saturated, the ladder sees
+            # a capacity signal (never a fault), and the next clean
+            # tick self-heals
+            self._note_fault("pool", "admission")
+            self._pool_blocked = True
+            return []
         if self._chunk_len:
             return self._admit_dispatch_chunked()
         return self._admit_dispatch_bucketed()
@@ -1159,16 +1314,40 @@ class ContinuousBatchingEngine:
         wave (store inserts land at the end); across waves it does."""
         C = self._chunk_len
         cfg = self.cfg
-        jobs = []  # [req, slot, prefix_len, hashes, n_matched, cursor]
+        ctl = self._degctl
+        shed = ctl is not None and ctl.shed_batch
+        throttle = ctl is not None and ctl.throttle
+        jobs = []  # [req, slot, prefix_len, hashes, n_matched, cursor,
+        #            ids] — ids: the prefill token sequence (prompt, or
+        #            prompt+history for a crash-recovery replay)
+        deferred: List[Request] = []  # shed batch-class requests
         try:
             while self._queue and self._free_heap:
+                if throttle and jobs:
+                    break  # degraded: at most one admission per wave
                 req = self._queue[0]
+                if shed and req.slo == "batch":
+                    # degradation L1+: defer (never drop) batch-class
+                    # admissions; restored to the queue front below
+                    self._queue.popleft()
+                    deferred.append(req)
+                    continue
+                if self._draining and not (req._retries or req.output):
+                    # draining: only in-flight-once replays admit;
+                    # fresh requests defer (restored below)
+                    self._queue.popleft()
+                    deferred.append(req)
+                    continue
                 slot = self._free_heap[0]  # peek; claimed below
-                n = req.prompt.size
-                need = n + req.max_new_tokens
+                ids = self._prefill_ids(req)
+                n = ids.size
+                # replay: the history is part of ids, so the new-token
+                # budget shrinks by what was already generated — the
+                # page need is identical to the original admission's
+                need = n + req.max_new_tokens - len(req.output)
                 prefix_len, hashes, n_matched = 0, [], 0
                 if cfg.paged:
-                    got = self._paged_prefix_admit(slot, req, need)
+                    got = self._paged_prefix_admit(slot, req, need, ids)
                     if got is None:
                         if not self.active.any() and not jobs:
                             raise RuntimeError(
@@ -1181,9 +1360,10 @@ class ContinuousBatchingEngine:
                         break  # pool exhausted: wait for a finisher
                     prefix_len, hashes = got
                     n_matched = prefix_len // cfg.page_size
-                elif self._prefix is not None:
+                elif not self._prefix_disabled() \
+                        and self._prefix is not None:
                     hashes, matched, prefix_len, _full = \
-                        self._match_prefix(req)
+                        self._match_prefix(req, ids)
                     n_matched = len(matched)
                     B = self._prefix_block
                     with self._ctx():
@@ -1195,17 +1375,17 @@ class ContinuousBatchingEngine:
                 self.active[slot] = True
                 req.slot = slot
                 self._slot_req[slot] = req
-                # last element: the prefill cursor (starts at the
+                # 6th element: the prefill cursor (starts at the
                 # prefix boundary; _drive_prefill_chunks advances it —
                 # prefix_len itself stays pristine for the stats
                 # commit)
                 jobs.append(
                     [req, slot, prefix_len, hashes, n_matched,
-                     prefix_len])
+                     prefix_len, ids])
             if not jobs:
                 return []
             return self._drive_prefill_chunks(jobs)
-        except BaseException:
+        except BaseException as e:
             # all-or-nothing rollback: free claimed slots/pages and
             # requeue in submission order so a caught admission error
             # neither shrinks the engine nor strands a request
@@ -1217,7 +1397,21 @@ class ContinuousBatchingEngine:
                 if self.pool is not None:
                     self.pool.free(slot)
                 self._queue.appendleft(req)
+            if isinstance(e, InjectedFault) \
+                    and self._recovery_mode != "off":
+                # injected prefill-seam fault: the rollback above IS
+                # the quarantine (requests back in the queue, slots
+                # and pages clean) — count the recovery, charge each
+                # wave member one retry, and admit again next tick
+                self._after_admission_fault(e, [j[0] for j in jobs])
+                return []
             raise
+        finally:
+            if deferred:
+                # deferred batch requests return to the queue FRONT in
+                # their original relative order, ahead of the rest —
+                # shed is a deferral, never a reorder within the class
+                self._queue.extendleft(reversed(deferred))
 
     def _drive_prefill_chunks(self, jobs):
         """Host loop over suffix chunks for a wave of claimed requests.
@@ -1243,18 +1437,22 @@ class ContinuousBatchingEngine:
         tr = self._tracer
         while remaining:
             t0 = time.perf_counter()
+            # fault seam: an injected fault here quarantines the WHOLE
+            # wave through the admission rollback (slots/pages freed,
+            # requests requeued, one retry charged each)
+            self._fault_point("prefill_chunk")
             ids = np.zeros((cfg.max_slots, C), np.int64)
             start = np.full((cfg.max_slots,), sentinel, np.int32)
             last_idx = np.zeros((cfg.max_slots,), np.int32)
             finishing = []
             packed = 0
             for job in remaining:
-                req, slot, p = job[0], job[1], job[5]
-                take = min(C, req.prompt.size - p)
-                ids[slot, :take] = req.prompt[p:p + take]
+                req, slot, p, job_ids = job[0], job[1], job[5], job[6]
+                take = min(C, job_ids.size - p)
+                ids[slot, :take] = job_ids[p:p + take]
                 start[slot] = p
-                if p + take >= req.prompt.size:
-                    last_idx[slot] = req.prompt.size - 1 - p
+                if p + take >= job_ids.size:
+                    last_idx[slot] = job_ids.size - 1 - p
                     finishing.append(job)
                 job[5] = p + take
                 packed += take
@@ -1287,7 +1485,8 @@ class ContinuousBatchingEngine:
                             / cfg.max_slots,
                             rids=[int(j[0].rid) for j in remaining])
             for job in finishing:
-                pending.append((job[0], job[1], toks[job[1]]))
+                pending.append((job[0], job[1], job[6].size,
+                                toks[job[1]]))
             done_slots = {j[1] for j in finishing}  # slots are unique
             remaining = [j for j in remaining if j[1] not in done_slots]
         # the wave is committed: only now do the prompts' blocks
@@ -1295,11 +1494,11 @@ class ContinuousBatchingEngine:
         # rollback path can't double-count a requeued request. Insert
         # BEFORE note so the cached-pages gauge reflects this
         # request's own published blocks.
-        for req, slot, prefix_len, hashes, n_matched, _cursor in jobs:
-            self._prefix_store_insert(slot, req.prompt, hashes,
-                                      n_matched)
-            if self._prefix is not None:
-                self._note_prefix(prefix_len, req.prompt.size, req.rid)
+        for req, slot, prefix_len, hashes, n_matched, _cursor, ids_arr \
+                in jobs:
+            self._prefix_store_insert(slot, ids_arr, hashes, n_matched)
+            if self._prefix is not None and not self._prefix_disabled():
+                self._note_prefix(prefix_len, ids_arr.size, req.rid)
         return pending
 
     def _admit_dispatch_bucketed(self):
@@ -1310,12 +1509,14 @@ class ContinuousBatchingEngine:
         while self._queue and self._free_heap:
             req = self._queue[0]
             slot = self._free_heap[0]  # peek; claimed only on success
-            n = req.prompt.size
+            ids_arr = self._prefill_ids(req)
+            n = ids_arr.size
             # paged: allocate for the full prefill bucket too — the
             # prefill scatter writes bucket//page_size whole pages, and
             # a bucket coarser than prompt+max_new must not spill into
             # the sink page or pages owned by other slots
-            need = max(n + req.max_new_tokens, self._bucket(n))
+            need = max(n + req.max_new_tokens - len(req.output),
+                       self._bucket(n))
             if self.cfg.paged and not self.pool.alloc(slot, need):
                 if not self.active.any() and not pending:
                     raise RuntimeError(
@@ -1331,7 +1532,7 @@ class ContinuousBatchingEngine:
             try:
                 bucket = self._bucket(n)
                 padded = np.zeros((1, bucket), np.int64)
-                padded[0, :n] = req.prompt
+                padded[0, :n] = ids_arr
                 one_caches = self.model.init_kv_caches(
                     1, bucket, dtype=self.cache_dtype)
                 self._key, sub = jax.random.split(self._key)
@@ -1375,7 +1576,7 @@ class ContinuousBatchingEngine:
             self.active[slot] = True
             req.slot = slot
             self._slot_req[slot] = req
-            pending.append((req, slot, first_dev))
+            pending.append((req, slot, n, first_dev))
             tr = self._tracer
             if tr is not None:
                 seq = tr.next_step()
@@ -1390,27 +1591,64 @@ class ContinuousBatchingEngine:
     def _admit_integrate(self, pending):
         """Sync each admitted request's first token (a scalar transfer)
         and finish its bookkeeping; the sequence joins the NEXT decode
-        chunk."""
-        for req, slot, first_dev in pending:
+        chunk. ``n_ctx`` is the prefilled context length — the prompt,
+        or prompt+history for a crash-recovery replay, whose original
+        TTFT and admit instant are preserved (per-request TPOT stays
+        the honest wall from FIRST admission to last token, fault
+        stalls included)."""
+        for req, slot, n_ctx, first_dev in pending:
             first = int(first_dev)  # scalar, not [1, bucket, vocab]
-            req._admit_t = time.perf_counter()
-            req.ttft_ms = (req._admit_t - req._submit_t) * 1e3
+            now = time.perf_counter()
+            fresh = req.ttft_ms is None
+            if fresh:
+                req._admit_t = now
+                req.ttft_ms = (now - req._submit_t) * 1e3
             req.output.append(first)
-            self.seq_lens[slot] = req.prompt.size
+            self.seq_lens[slot] = n_ctx
             self.last_tok[slot] = first
             if self._tel is not None:
-                self._tel.on_admit(req.ttft_ms)
+                if fresh:
+                    self._tel.on_admit(req.ttft_ms)
+                else:
+                    self._tel.on_readmit()
             tr = self._tracer
             if tr is not None and tr.want_request(req.rid):
-                # the span covers queue wait + prefill: exactly TTFT
-                tr.request(req.rid, "admitted", t0=req._submit_t,
-                           t1=req._admit_t, slot=slot,
-                           ttft_ms=req.ttft_ms, first_tokens=1,
-                           prompt_tokens=int(req.prompt.size))
+                if fresh:
+                    # the span covers queue wait + prefill: exactly TTFT
+                    tr.request(req.rid, "admitted", t0=req._submit_t,
+                               t1=now, slot=slot,
+                               ttft_ms=req.ttft_ms, first_tokens=1,
+                               prompt_tokens=int(req.prompt.size))
+                else:
+                    tr.request(req.rid, "readmitted", slot=slot,
+                               retries=int(req._retries),
+                               replayed_tokens=int(n_ctx
+                                                   - req.prompt.size))
             self._maybe_finish(slot, first)
 
     def _admit(self):
-        self._admit_integrate(self._admit_dispatch())
+        """Blocking admission (dispatch + integrate) with the same
+        crash-recovery coverage as the step paths: JAX dispatch is
+        async, so a prefill program's runtime failure surfaces at its
+        first-token SYNC in ``_admit_integrate`` — without this guard
+        the exact fault class ``serve_recovery`` promises to survive
+        would crash the idle-engine admission path."""
+        try:
+            self._admit_integrate(self._admit_dispatch())
+        except BaseException as e:
+            if not self._recoverable(e):
+                raise
+            self._recover_step(e, self.active.copy(), "admit")
+
+    def _integrate_guarded(self, pending, program: str):
+        """``_admit_integrate`` as a recovery point: the first-token
+        sync is where an async prefill failure actually lands."""
+        try:
+            self._admit_integrate(pending)
+        except BaseException as e:
+            if not self._recoverable(e):
+                raise
+            self._recover_step(e, self.active.copy(), program)
 
     def _slo_bucket(self, slo: str) -> Dict[str, int]:
         st = self.slo_stats.get(slo)
@@ -1418,7 +1656,7 @@ class ContinuousBatchingEngine:
             st = self.slo_stats[slo] = {
                 "met": 0, "violated": 0, "cancelled": 0,
                 "ttft_violations": 0, "tpot_violations": 0,
-                "met_tokens": 0, "total_tokens": 0,
+                "timeouts": 0, "met_tokens": 0, "total_tokens": 0,
             }
         return st
 
@@ -1432,7 +1670,22 @@ class ContinuousBatchingEngine:
         n_decode = len(req.output) - 1  # first token priced into TTFT
         if req._admit_t and n_decode > 0:
             req.tpot_ms = (now - req._admit_t) * 1e3 / n_decode
-        if req.slo is not None and reason != "cancel":
+        if req.slo is not None and reason == "cancel":
+            self._slo_bucket(req.slo)["cancelled"] += 1
+        elif req.slo is not None and reason in ("timeout", "failed"):
+            # an expired or retries-exhausted request never delivered:
+            # forced SLO violation — a timed-out request that happened
+            # to meet its TTFT must not inflate goodput
+            st = self._slo_bucket(req.slo)
+            req.slo_met = False
+            st["violated"] += 1
+            if reason == "timeout":
+                st["timeouts"] += 1
+            st["total_tokens"] += len(req.output)
+            if self._tel is not None:
+                tracked = st["met"] + st["violated"]
+                self._tel.on_slo(req.slo, False, st["met"] / tracked)
+        elif req.slo is not None:
             st = self._slo_bucket(req.slo)
             ttft_ok = (req.ttft_target_ms is None
                        or (req.ttft_ms is not None
@@ -1452,8 +1705,6 @@ class ContinuousBatchingEngine:
                 tracked = st["met"] + st["violated"]
                 self._tel.on_slo(req.slo, req.slo_met,
                                  st["met"] / tracked)
-        elif req.slo is not None:
-            self._slo_bucket(req.slo)["cancelled"] += 1
         tr = self._tracer
         if tr is not None and tr.want_request(req.rid):
             t0 = req._admit_t or now
@@ -1540,15 +1791,344 @@ class ContinuousBatchingEngine:
             self._tel.on_cancel()
         return True
 
+    # ---------------- resilience ----------------
+    def _prefix_disabled(self) -> bool:
+        """True while the degradation ladder has switched prefix-cache
+        adoption off (min_service) — admission neither matches nor
+        publishes; outputs are unchanged, only prefill work grows."""
+        return self._degctl is not None and self._degctl.disable_prefix
+
+    def _finish_request(self, req: Request, reason: str):
+        """Terminal bookkeeping for a request that leaves the engine
+        WITHOUT a normal finish: deadline expiry (``timeout``) or
+        retry exhaustion (``failed``). The caller has already removed
+        it from the queue or released its slot."""
+        req.done = True
+        self._finished[req.rid] = req
+        self._finish_accounting(req, reason)
+        if self._tel is not None:
+            if reason == "timeout":
+                self._tel.on_timeout()
+            elif reason == "failed":
+                self._tel.on_failed()
+
+    def _expire_deadlines(self):
+        """Enforce per-request deadlines: queued requests leave the
+        queue, active ones release their slot/pages/prefix refs
+        through the one teardown path (``_release_slot``), and both
+        finish with reason ``"timeout"``. Checked once per scheduler
+        tick — the granularity ``add_request`` validates deadlines
+        against."""
+        now = time.perf_counter()
+        # queued: snapshot-then-remove-by-identity (same concurrency
+        # contract as cancel(): add_request may append from a producer
+        # thread; deque.remove is a single atomic op)
+        for req in list(self._queue):
+            if req._deadline_t and now >= req._deadline_t:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    continue  # raced out of the queue
+                self.resilience_stats["timeouts"] += 1
+                self._finish_request(req, "timeout")
+        for slot in range(self.cfg.max_slots):
+            if not self.active[slot]:
+                continue
+            req = self._slot_req[slot]
+            if req._deadline_t and now >= req._deadline_t:
+                self._release_slot(slot)
+                self.resilience_stats["timeouts"] += 1
+                self._finish_request(req, "timeout")
+
+    def _bump_retry(self, req: Request) -> bool:
+        """Charge one replay retry. Returns True while the request may
+        be re-queued; past its bound it finishes with reason
+        ``"failed"`` (and is pulled from the queue if it sits there)."""
+        req._retries += 1
+        req._hashes = None  # replay ids differ: stale digests invalid
+        limit = (req.max_retries if req.max_retries is not None
+                 else self.cfg.max_retries)
+        if req._retries > limit:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+            self.resilience_stats["failed"] += 1
+            self._finish_request(req, "failed")
+            return False
+        self.resilience_stats["retries"] += 1
+        if self._tel is not None:
+            self._tel.on_retry()
+        return True
+
+    def _note_fault(self, site: str, program: str):
+        st = self.resilience_stats
+        st["faults"][site] = st["faults"].get(site, 0) + 1
+        if self._tel is not None:
+            self._tel.on_fault(site)
+        if self._tracer is not None:
+            self._tracer.engine_event("fault", site=site,
+                                      program=program)
+
+    def _fault_point(self, program: str):
+        """One dispatch seam: consult the injector's latency schedule
+        (stall in place), then the raising sites — an
+        ``InjectedFault`` raised HERE precedes the compiled call, so
+        the device cache state is untouched and recovery can requeue
+        without rebuilding."""
+        inj = self._injector
+        if inj is None:
+            return
+        if inj.fire("latency"):
+            self._note_fault("latency", program)
+            time.sleep(inj.latency_ms / 1e3)
+        for site in ("step", "nan"):
+            if inj.fire(site):
+                raise InjectedFault(site, program)
+
+    def _recoverable(self, exc: BaseException) -> bool:
+        """PT_FLAGS_serve_recovery policy: injected faults always
+        recover (unless off); ``auto`` additionally recovers XLA
+        runtime errors (device failures) but NEVER host logic errors —
+        a plain RuntimeError from scheduler code must propagate;
+        ``all`` recovers any Exception."""
+        mode = self._recovery_mode
+        if mode == "off":
+            return False
+        if isinstance(exc, InjectedFault):
+            return True
+        if mode == "all":
+            return isinstance(exc, Exception)
+        return bool(RUNTIME_ERRORS) and isinstance(exc, RUNTIME_ERRORS)
+
+    def _after_admission_fault(self, exc: InjectedFault,
+                               reqs: List[Request]):
+        """An injected prefill-seam fault after the wave rollback:
+        the quarantine already happened (slots/pages freed, requests
+        requeued in order) — account it and charge retries."""
+        st = self.resilience_stats
+        st["recoveries"] += 1
+        site = exc.site
+        st["faults"][site] = st["faults"].get(site, 0) + 1
+        if site == "nan":
+            st["nan_steps"] += 1
+            self._nan_dump(exc.program, len(reqs))
+        self._faults_tick += 1
+        for req in reqs:
+            self._bump_retry(req)
+        if self._tel is not None:
+            self._tel.on_fault(site)
+            self._tel.on_recovery(len(reqs))
+        if self._tracer is not None:
+            self._tracer.engine_event(
+                "recovery", site=site, program=exc.program,
+                requeued=len(reqs), hard=False)
+
+    def _recover_step(self, exc: BaseException, participants,
+                      program: str):
+        """Quarantine a failed step: discard its device effects and
+        re-queue the affected in-flight requests for deterministic
+        replay. Generated tokens live host-side, so replay re-prefills
+        prompt+history through the existing chunked-prefill program —
+        greedy outputs stay bit-identical to a fault-free run, and the
+        replayed admission re-uses the SAME compiled programs (zero
+        new specializations, pinned by test).
+
+        Severity: an ``InjectedFault`` fires BEFORE dispatch, so the
+        caches are intact — only the step's participants requeue and
+        the prefix store survives. Any other (real) runtime failure
+        means donated buffers may be gone: every active request
+        requeues, the prefix store is dropped and the cache pools are
+        rebuilt (same shapes — nothing recompiles)."""
+        hard = not isinstance(exc, InjectedFault)
+        site = getattr(exc, "site", "error")
+        st = self.resilience_stats
+        st["recoveries"] += 1
+        st["faults"][site] = st["faults"].get(site, 0) + 1
+        if site == "nan":
+            st["nan_steps"] += 1
+        self._faults_tick += 1
+        victims = [s for s in range(self.cfg.max_slots)
+                   if self.active[s] and (hard or participants[s])]
+        requeued = 0
+        # reversed + appendleft: victims land at the queue front in
+        # ascending slot order, ahead of younger arrivals
+        for slot in reversed(victims):
+            req = self._slot_req[slot]
+            self._release_slot(slot)
+            req.slot = None
+            if self._bump_retry(req):
+                self._queue.appendleft(req)
+                requeued += 1
+        if hard:
+            st["rebuilds"] += 1
+            self._rebuild_caches()
+        if site == "nan":
+            self._nan_dump(program, requeued)
+        if self._tel is not None:
+            self._tel.on_fault(site)
+            self._tel.on_recovery(requeued)
+        if self._tracer is not None:
+            self._tracer.engine_event(
+                "recovery", site=site, program=program,
+                requeued=requeued, failed=len(victims) - requeued,
+                hard=hard, error=type(exc).__name__)
+
+    def _rebuild_caches(self):
+        """Hard crash recovery: after a non-injected runtime failure
+        the device cache state is untrusted (the failed call may have
+        consumed its donated buffers), so rebuild the pools from
+        scratch and DROP the prefix store — paged entries reference
+        pages of the discarded pool; contiguous blocks are content-
+        addressed but a corrupted write can't be ruled out. Every slot
+        was already released by the caller. Same shapes → the jitted
+        programs never re-specialize."""
+        if self._prefix is not None:
+            if self.cfg.paged:
+                # all slots freed → every entry is un-borrowed: this
+                # empties the store and returns its refs to the pool
+                # being discarded (keeps the refcount audit clean)
+                self._evict_pages(10 ** 9)
+            else:
+                self._prefix = ContigPrefixStore(self._prefix.max_blocks)
+        self._init_cache_state()
+
+    def _nan_dump(self, program: str, requeued: int):
+        """NaN-logits storm postmortem: ride PR 2's flight recorder —
+        the dump attaches the lifecycle tracer's tail, so the artifact
+        shows WHAT the engine was doing, not just that logits went
+        non-finite. Telemetry off → no artifact (host counters still
+        count)."""
+        if self._tel is None:
+            return
+        if self._recorder is None:
+            self._recorder = observability.FlightRecorder(
+                capacity=int(flags.flag("telemetry_flight_window")),
+                dump_dir=str(flags.flag("telemetry_dump_dir")))
+        self._recorder.record(
+            kind="serve_nan", program=program, requeued=requeued,
+            engine=self._tel.engine_id, wall=time.time())
+        self._recorder.dump(
+            f"serving NaN-logits storm in {program} "
+            f"(engine {self._tel.engine_id})")
+
+    def _observe_health(self):
+        """One degradation-ladder tick: saturation from the live
+        admission state, faults accumulated since the last tick."""
+        if self._degctl is None:
+            self._faults_tick = 0
+            return
+        qd = len(self._queue)
+        sat = qd > 0 and (len(self._free_heap) == 0
+                          or self._pool_blocked)
+        before = self._degctl.level
+        level = self._degctl.observe(saturated=bool(sat),
+                                     faults=self._faults_tick)
+        self._faults_tick = 0
+        if level != before:
+            if self._tel is not None:
+                self._tel.on_degradation(level)
+            if self._tracer is not None:
+                self._tracer.engine_event(
+                    "degrade", level=level, previous=before,
+                    level_name=self._degctl.name)
+
+    def _drain_pending(self) -> List[Request]:
+        """Queued requests that were already in flight once (crash-
+        recovery replays): drain() owes these completion — they are
+        'in-flight' work even while they sit in the queue."""
+        return [r for r in self._queue if r._retries or r.output]
+
+    def drain(self, deadline_ms: Optional[float] = None,
+              max_chunk: int = 8) -> dict:
+        """Graceful shutdown primitive: stop admitting fresh requests
+        (they stay queued for the router to re-dispatch), run every
+        in-flight request to completion — INCLUDING requests a
+        mid-drain quarantine re-queued for replay — or to
+        ``deadline_ms``, past which the stragglers finish with reason
+        ``"timeout"`` and their slots/pages/prefix refs are provably
+        freed. ``/healthz`` reports ``draining`` (503) for the
+        duration and after, until ``resume()``. Returns a summary
+        dict."""
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0; got {deadline_ms}")
+        self._draining = True
+        if self._tel is not None:
+            self._tel.on_drain(True)
+        if self._tracer is not None:
+            self._tracer.engine_event(
+                "drain_begin", active=int(self.active.sum()),
+                queued=len(self._queue))
+        t_end = (None if deadline_ms is None
+                 else time.perf_counter() + deadline_ms / 1e3)
+        expired = 0
+        while self.active.any() or self._drain_pending():
+            if t_end is not None and time.perf_counter() >= t_end:
+                for slot in range(self.cfg.max_slots):
+                    if not self.active[slot]:
+                        continue
+                    req = self._slot_req[slot]
+                    self._release_slot(slot)
+                    self.resilience_stats["timeouts"] += 1
+                    self._finish_request(req, "timeout")
+                    expired += 1
+                for req in self._drain_pending():
+                    # replay victims still waiting on a slot expire
+                    # too — a drain deadline leaves NOTHING in limbo
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                    self.resilience_stats["timeouts"] += 1
+                    self._finish_request(req, "timeout")
+                    expired += 1
+                break
+            self.step_chunk(max_chunk)
+        if self._tracer is not None:
+            self._tracer.engine_event(
+                "drain_end", expired=expired, queued=len(self._queue))
+        return {"drained": True, "expired": expired,
+                "active": int(self.active.sum()),
+                "queued": len(self._queue)}
+
+    def resume(self):
+        """Leave the draining state: admission restarts on the next
+        scheduler tick."""
+        self._draining = False
+        if self._tel is not None:
+            self._tel.on_drain(False)
+
+    def resilience_snapshot(self) -> dict:
+        """Fault/recovery/degradation counters (plain host counters —
+        available even with PT_FLAGS_telemetry=off, like
+        prefix/spec/slo snapshots)."""
+        st = dict(self.resilience_stats)
+        st["faults"] = dict(st["faults"])
+        st["recovery_mode"] = self._recovery_mode
+        st["max_retries"] = self.cfg.max_retries
+        st["draining"] = self._draining
+        st["degradation"] = (self._degctl.snapshot()
+                             if self._degctl is not None
+                             else {"enabled": False, "level": 0,
+                                   "degraded": False})
+        st["injector"] = (self._injector.snapshot()
+                          if self._injector is not None
+                          else {"enabled": False})
+        return st
+
     def step(self) -> bool:
         """Admit waiting requests, run one decode step for all active
         slots — or, with speculative decoding enabled and at least one
         slot holding a draft, one multi-token verify pass. Returns
         False when there is nothing left to do."""
+        self._expire_deadlines()
+        self._observe_health()
         self._admit()
         if not self.active.any():
             return bool(self._queue)
-        if self._spec_mode != "off":
+        if self._spec_mode != "off" and not (
+                self._degctl is not None and self._degctl.disable_spec):
             drafts = self._propose_drafts()
             if drafts:
                 return self._spec_step(drafts)
@@ -1560,25 +2140,33 @@ class ContinuousBatchingEngine:
         seq = tr.next_step() if tr is not None else 0
         adv = {} if tr is not None and tr.want_step(seq) else None
         occ = float(self.active.sum()) / self.cfg.max_slots
-        self._cow_for_decode(1)
-        use_samp, samp = self._slot_sampling()
-        self._key, sub = jax.random.split(self._key)
-        toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
-        lens = jnp.asarray(self.seq_lens, jnp.int32)
-        with self._ctx():
-            if self.cfg.paged:
-                state = PagedState(
-                    block_tables=jnp.asarray(self.pool.block_tables),
-                    seq_lens=lens)
-                nxt, self.layer_caches = self._decode()(
-                    self._pb, toks, self.layer_caches, state, sub,
-                    samp, use_samp)
-            else:
-                nxt, self.caches = self._decode()(
-                    self._pb, toks, self.caches, lens, sub, samp,
-                    use_samp)
-        t_disp = time.perf_counter()
-        nxt = np.asarray(nxt)
+        participants = self.active.copy()
+        try:
+            self._fault_point("decode")
+            self._cow_for_decode(1)
+            use_samp, samp = self._slot_sampling()
+            self._key, sub = jax.random.split(self._key)
+            toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
+            lens = jnp.asarray(self.seq_lens, jnp.int32)
+            with self._ctx():
+                if self.cfg.paged:
+                    state = PagedState(
+                        block_tables=jnp.asarray(self.pool.block_tables),
+                        seq_lens=lens)
+                    nxt, self.layer_caches = self._decode()(
+                        self._pb, toks, self.layer_caches, state, sub,
+                        samp, use_samp)
+                else:
+                    nxt, self.caches = self._decode()(
+                        self._pb, toks, self.caches, lens, sub, samp,
+                        use_samp)
+            t_disp = time.perf_counter()
+            nxt = np.asarray(nxt)
+        except BaseException as e:
+            if not self._recoverable(e):
+                raise
+            self._recover_step(e, participants, "decode")
+            return True
         t_sync = time.perf_counter()
         emitted = 0
         for slot in range(self.cfg.max_slots):
@@ -1676,42 +2264,49 @@ class ContinuousBatchingEngine:
         adv = {} if tr is not None and tr.want_step(seq) else None
         spec_by_rid = {} if adv is not None else None
         occ = float(self.active.sum()) / cfg.max_slots
-        self._cow_for_decode(S)
-        sentinel = cfg.max_len
-        ids = np.zeros((cfg.max_slots, S), np.int64)
-        start = np.full((cfg.max_slots,), sentinel, np.int32)
-        n_draft = np.zeros((cfg.max_slots,), np.int32)
         chunk_slots = self.active.copy()
-        for slot in range(cfg.max_slots):
-            if not chunk_slots[slot]:
-                continue
-            ids[slot, 0] = self.last_tok[slot]
-            d = drafts.get(slot)
-            if d is not None and d.size:
-                ids[slot, 1:1 + d.size] = d
-                n_draft[slot] = d.size
-            start[slot] = self.seq_lens[slot]
-        use_samp, samp = self._slot_sampling()
-        self._key, sub = jax.random.split(self._key)
-        bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
-              else jnp.zeros((1,), jnp.int32))
-        caches = self.layer_caches if cfg.paged else self.caches
-        with self._ctx():
-            preds, accepted, caches = self._verify()(
-                self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
-                jnp.asarray(start), jnp.asarray(n_draft), sub, samp,
-                use_samp)
-        if cfg.paged:
-            self.layer_caches = caches
-        else:
-            self.caches = caches
-        t_disp = time.perf_counter()
-        # admission dispatches behind the in-flight verify (stream
-        # order, exactly like step_chunk's decode-chunk overlap)
-        pending = self._admit_dispatch()
-        t_admit = time.perf_counter()
-        preds_np = np.asarray(preds)  # ONE sync for up to S tokens/slot
-        acc_np = np.asarray(accepted)
+        try:
+            self._fault_point("verify")
+            self._cow_for_decode(S)
+            sentinel = cfg.max_len
+            ids = np.zeros((cfg.max_slots, S), np.int64)
+            start = np.full((cfg.max_slots,), sentinel, np.int32)
+            n_draft = np.zeros((cfg.max_slots,), np.int32)
+            for slot in range(cfg.max_slots):
+                if not chunk_slots[slot]:
+                    continue
+                ids[slot, 0] = self.last_tok[slot]
+                d = drafts.get(slot)
+                if d is not None and d.size:
+                    ids[slot, 1:1 + d.size] = d
+                    n_draft[slot] = d.size
+                start[slot] = self.seq_lens[slot]
+            use_samp, samp = self._slot_sampling()
+            self._key, sub = jax.random.split(self._key)
+            bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
+                  else jnp.zeros((1,), jnp.int32))
+            caches = self.layer_caches if cfg.paged else self.caches
+            with self._ctx():
+                preds, accepted, caches = self._verify()(
+                    self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
+                    jnp.asarray(start), jnp.asarray(n_draft), sub, samp,
+                    use_samp)
+            if cfg.paged:
+                self.layer_caches = caches
+            else:
+                self.caches = caches
+            t_disp = time.perf_counter()
+            # admission dispatches behind the in-flight verify (stream
+            # order, exactly like step_chunk's decode-chunk overlap)
+            pending = self._admit_dispatch()
+            t_admit = time.perf_counter()
+            preds_np = np.asarray(preds)  # ONE sync for S tokens/slot
+            acc_np = np.asarray(accepted)
+        except BaseException as e:
+            if not self._recoverable(e):
+                raise
+            self._recover_step(e, chunk_slots, "verify")
+            return True
         t_sync = time.perf_counter()
         emitted = 0
         proposed_tot = accepted_tot = 0
@@ -1759,7 +2354,7 @@ class ContinuousBatchingEngine:
                     dispatch_ms=(t_disp - t0) * 1e3,
                     admit_dispatch_ms=(t_admit - t_disp) * 1e3,
                     device_wall_ms_est=(t_sync - t_disp) * 1e3)
-        self._admit_integrate(pending)
+        self._integrate_guarded(pending, "verify_integrate")
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
             self._tel.on_spec_verify(
@@ -1793,12 +2388,15 @@ class ContinuousBatchingEngine:
         K is fixed, so exactly one decode program compiles for the
         engine's lifetime; per-slot budgets freeze finished slots
         device-side and the host discards EOS/budget overshoot."""
+        self._expire_deadlines()
+        self._observe_health()
         if not self.active.any():
             # nothing decoding: plain blocking admission
             self._admit()
             if not self.active.any():
                 return bool(self._queue)
-        if self._spec_mode != "off":
+        if self._spec_mode != "off" and not (
+                self._degctl is not None and self._degctl.disable_spec):
             # A verify pass buys accepted+1 tokens per DRAFTING slot
             # for one weight stream, but costs every OTHER active slot
             # its chunk amortization: the pass is one host sync that
@@ -1835,30 +2433,42 @@ class ContinuousBatchingEngine:
         # slots must not decode mid-chunk (their lengths land at
         # integrate)
         chunk_slots = self.active.copy()
-        self._cow_for_decode(K)
-        budget = self._slot_budgets()
-        use_samp, samp = self._slot_sampling()
-        self._key, sub = jax.random.split(self._key)
-        toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
-        lens = jnp.asarray(self.seq_lens, jnp.int32)
-        act = jnp.asarray(chunk_slots)
-        bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
-              else jnp.zeros((1,), jnp.int32))
-        caches = self.layer_caches if self.cfg.paged else self.caches
-        with self._ctx():
-            toks_all, caches, _ = self._decode_n()(
-                self._pb, toks, caches, lens, act, jnp.asarray(budget),
-                bt, sub, samp, K, use_samp)
-        if self.cfg.paged:
-            self.layer_caches = caches
-        else:
-            self.caches = caches
-        t_disp = time.perf_counter()
-        # admission dispatches behind the in-flight chunk (stream order:
-        # chunk → prefills → inserts into the chunk's output caches)
-        pending = self._admit_dispatch()
-        t_admit = time.perf_counter()
-        toks_np = np.asarray(toks_all)  # ONE sync for K tokens
+        try:
+            self._fault_point("decode_chunk")
+            self._cow_for_decode(K)
+            budget = self._slot_budgets()
+            use_samp, samp = self._slot_sampling()
+            self._key, sub = jax.random.split(self._key)
+            toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
+            lens = jnp.asarray(self.seq_lens, jnp.int32)
+            act = jnp.asarray(chunk_slots)
+            bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
+                  else jnp.zeros((1,), jnp.int32))
+            caches = self.layer_caches if self.cfg.paged else self.caches
+            with self._ctx():
+                toks_all, caches, _ = self._decode_n()(
+                    self._pb, toks, caches, lens, act,
+                    jnp.asarray(budget), bt, sub, samp, K, use_samp)
+            if self.cfg.paged:
+                self.layer_caches = caches
+            else:
+                self.caches = caches
+            t_disp = time.perf_counter()
+            # admission dispatches behind the in-flight chunk (stream
+            # order: chunk → prefills → inserts into the chunk's
+            # output caches)
+            pending = self._admit_dispatch()
+            t_admit = time.perf_counter()
+            toks_np = np.asarray(toks_all)  # ONE sync for K tokens
+        except BaseException as e:
+            if not self._recoverable(e):
+                raise
+            # quarantine: the chunk's host state never advanced (the
+            # sync above is where tokens would have landed), so the
+            # chunk's participants replay; an un-synced but dispatched
+            # chunk re-runs over the same positions bit-identically
+            self._recover_step(e, chunk_slots, "decode_chunk")
+            return True
         # TPOT window closes at the chunk's token sync — before the
         # admitted requests' first-token syncs in _admit_integrate, so
         # loaded chunks report decode latency, not admission latency
@@ -1891,7 +2501,7 @@ class ContinuousBatchingEngine:
                     dispatch_ms=(t_disp - t0) * 1e3,
                     admit_dispatch_ms=(t_admit - t_disp) * 1e3,
                     device_wall_ms_est=(t_sync - t_disp) * 1e3)
-        self._admit_integrate(pending)
+        self._integrate_guarded(pending, "chunk_integrate")
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
             self._tel.on_state(*self._tel_state())
@@ -1922,9 +2532,15 @@ class ContinuousBatchingEngine:
         K-1 frozen steps behind a slot that finished at step 0). When
         every slot is busy with long remaining budgets, full chunks
         win: each boundary sync costs a host round-trip (~85 ms
-        through the remote-TPU tunnel) and buys nothing."""
+        through the remote-TPU tunnel) and buys nothing.
+
+        Degradation (throttle level): forced to ``probe_chunk`` — an
+        already-compiled program, so shrinking the chunk budget under
+        pressure never triggers a new jit specialization."""
         k = max_chunk
-        if self._queue:
+        if self._degctl is not None and self._degctl.throttle:
+            k = min(probe_chunk, max_chunk)
+        elif self._queue:
             if not self.active.all():
                 k = min(probe_chunk, max_chunk)
             else:
@@ -1949,8 +2565,9 @@ class ContinuousBatchingEngine:
                 for p in prompts]
         while self.step_chunk(max_chunk) or self._queue or \
                 self.active.any():
-            pass
-        return [self._finished[r] for r in rids]
+            if self._draining and not self.active.any():
+                break  # drained mid-run: queued requests stay queued
+        return [self._finished[r] for r in rids if r in self._finished]
 
     # ---------------- telemetry ----------------
     def _tel_state(self):
@@ -1994,6 +2611,7 @@ class ContinuousBatchingEngine:
         snap["prefix_cache"] = self.prefix_snapshot()
         snap["spec_decode"] = self.spec_snapshot()
         snap["slo"] = self.slo_snapshot()
+        snap["resilience"] = self.resilience_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -2060,6 +2678,7 @@ class ContinuousBatchingEngine:
         thread (same staleness contract as ``_tel_state``)."""
         qd = len(self._queue)
         free = len(self._free_heap)
+        ctl = self._degctl
         out = {
             "queue_depth": qd,
             "free_slots": free,
@@ -2068,6 +2687,11 @@ class ContinuousBatchingEngine:
             # engine's dominant stall — slots free but the last
             # admission pass blocked on KV-pool pages
             "saturated": qd > 0 and (free == 0 or self._pool_blocked),
+            # resilience bits a router steers on: draining (stop
+            # sending, we're shutting down) and the degradation ladder
+            "draining": self._draining,
+            "degraded": ctl.degraded if ctl is not None else False,
+            "degradation_level": ctl.level if ctl is not None else 0,
         }
         if self.cfg.paged:
             out["free_pages"] = self.pool.free_pages
@@ -2086,17 +2710,55 @@ class ContinuousBatchingEngine:
 # /metrics + /healthz exposition (parity: FastDeploy-style serving
 # endpoints; scrape target for Prometheus)
 # ---------------------------------------------------------------------------
+class MetricsServer:
+    """Handle for a running metrics endpoint: ``server_address`` for
+    the bound port and a CLEAN ``shutdown()`` — stop ``serve_forever``,
+    JOIN the serving thread, CLOSE the listening socket — so chaos
+    tests and multi-engine runs don't leak listeners or fds.
+    Idempotent; also a context manager."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self._closed = False
+
+    @property
+    def server_address(self):
+        return self._server.server_address
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()
+        self._thread.join(timeout=10)
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
 def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                          host: str = "127.0.0.1", port: int = 0):
     """Serve ``/metrics`` (Prometheus text exposition of the process
     registry), ``/healthz`` (JSON readiness: liveness + engine snapshot
-    + back-pressure state — **503** while admission is saturated, so a
-    router can drain the replica) and ``/trace`` (the engine's
-    lifecycle tracer as Chrome trace-event JSON, Perfetto-loadable;
-    404 when tracing is off) on a daemon thread. Returns the
-    ``ThreadingHTTPServer``; read ``server.server_address`` for the
-    bound port (``port=0`` picks a free one), call
-    ``server.shutdown()`` to stop."""
+    + back-pressure state — **503** while admission is saturated or
+    the engine is draining, so a router can drain the replica) and
+    ``/trace`` (the engine's lifecycle tracer as Chrome trace-event
+    JSON, Perfetto-loadable; 404 when tracing is off) on a daemon
+    thread. Returns a :class:`MetricsServer` handle; read
+    ``handle.server_address`` for the bound port (``port=0`` picks a
+    free one), call ``handle.shutdown()`` for a clean stop (thread
+    joined, socket closed)."""
     import json
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -2127,7 +2789,17 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                         bp = engine.backpressure()
                         payload["backpressure"] = bp
                         payload["engine"] = engine.metrics_snapshot()
-                        if bp["saturated"]:
+                        # degraded is NOT a readiness failure: the
+                        # replica still serves (shed/throttled) — a
+                        # router reads the bit to deprioritize it
+                        payload["degraded"] = bool(bp.get("degraded"))
+                        if bp.get("draining"):
+                            # drain() in progress: in-flight requests
+                            # still complete, but a router must stop
+                            # sending — readiness fails first
+                            payload["status"] = "draining"
+                            code = 503
+                        elif bp["saturated"]:
                             # honest readiness: requests are waiting
                             # and no slot can take them — tell the
                             # router to drain, don't smile through it
@@ -2164,5 +2836,4 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="pt-metrics-server")
     thread.start()
-    server._pt_thread = thread
-    return server
+    return MetricsServer(server, thread)

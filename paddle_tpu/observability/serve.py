@@ -118,6 +118,40 @@ class ServingTelemetry:
             "pt_serve_requests_cancelled_total",
             "requests cancelled (queued or mid-flight) — their slots "
             "and KV pages were released without finishing", L)
+        self._timeouts = reg.counter(
+            "pt_serve_requests_timeout_total",
+            "requests expired by their deadline (queued or mid-"
+            "flight) — slots, KV pages and prefix refs were released",
+            L)
+        self._failed = reg.counter(
+            "pt_serve_requests_failed_total",
+            "requests finished as failed after exhausting crash-"
+            "recovery replay retries", L)
+        self._recoveries = reg.counter(
+            "pt_serve_recoveries_total",
+            "quarantined steps: a decode/verify/prefill fault was "
+            "caught, the step's device effects were discarded and the "
+            "affected in-flight requests were re-queued for "
+            "deterministic replay", L)
+        self._retries = reg.counter(
+            "pt_serve_retries_total",
+            "request replay re-queues charged by quarantined steps "
+            "(bounded per request by max_retries)", L)
+        self._faults = reg.counter(
+            "pt_serve_faults_injected_total",
+            "fault-injector fires observed at the engine's dispatch "
+            "seams, by site (PT_FLAGS_fault_inject)",
+            ("engine", "site"))
+        self._deg_level = reg.gauge(
+            "pt_serve_degradation_level",
+            "graceful-degradation ladder level: 0 normal, 1 shed "
+            "batch-class admissions, 2 + admission throttled, 3 + "
+            "spec decode and prefix-cache adoption disabled "
+            "(min_service)", L)
+        self._draining = reg.gauge(
+            "pt_serve_draining",
+            "1 while the engine drains (admission stopped, in-flight "
+            "running to completion)", L)
         LS = ("engine", "slo")
         self._slo_met = reg.counter(
             "pt_serve_slo_met_total",
@@ -155,6 +189,35 @@ class ServingTelemetry:
 
     def on_cancel(self):
         self._cancelled.inc(**self._lab())
+
+    def on_timeout(self):
+        self._timeouts.inc(**self._lab())
+
+    def on_failed(self):
+        self._failed.inc(**self._lab())
+
+    def on_recovery(self, requeued: int):
+        """One quarantined step (``requeued`` requests re-queued for
+        replay; per-request retries counted via ``on_retry``)."""
+        self._recoveries.inc(**self._lab())
+
+    def on_retry(self):
+        self._retries.inc(**self._lab())
+
+    def on_readmit(self):
+        """A replayed request re-admitted: its re-prefill sampled one
+        fresh output token (TTFT/admitted counted only at the FIRST
+        admission)."""
+        self._tokens.inc(**self._lab())
+
+    def on_fault(self, site: str):
+        self._faults.inc(**dict(self._lab(), site=site))
+
+    def on_degradation(self, level: int):
+        self._deg_level.set(level, **self._lab())
+
+    def on_drain(self, active: bool):
+        self._draining.set(1 if active else 0, **self._lab())
 
     def on_slo(self, slo: str, met: bool, goodput: float):
         """One SLO-tracked request finished: ``met`` is its attainment,
@@ -284,6 +347,9 @@ class ServingTelemetry:
                 "fallback_steps": self._spec_fallback.value(**lab),
                 "acceptance_rate": self._spec_rate.value(**lab),
             },
+            # resilience counters are NOT duplicated here: the
+            # engine's metrics_snapshot() attaches its host-side
+            # resilience_snapshot() (one source, telemetry-off-safe)
         }
 
     def window_reset(self):

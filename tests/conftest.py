@@ -70,6 +70,20 @@ def compile_counter():
                     if v - base[k]}
         return serving.TRACE_COUNTS[key] - base[key]
 
+    def assert_programs(allowed):
+        """Pin the compiled-program set: fail on any specialization
+        outside ``allowed`` since the fixture (or the last snapshot
+        the caller diffs against). The recovery/replay guard calls
+        this to prove that quarantine + deterministic replay adds
+        ZERO new compiled programs — replay must reuse the existing
+        ``prefill_chunk``/``decode_chunk`` programs."""
+        got = counter()
+        extra = {k: v for k, v in got.items() if k not in set(allowed)}
+        assert not extra, (
+            f"unexpected compiled-program specializations: {extra} "
+            f"(allowed: {sorted(allowed)})")
+
+    counter.assert_programs = assert_programs
     return counter
 
 

@@ -418,7 +418,8 @@ def test_healthz_backpressure_and_trace_endpoint(obs_flags):
     eng.step()  # r0 admitted, r1 waits: saturated
     bp = eng.backpressure()
     assert bp == {"queue_depth": 1, "free_slots": 0, "occupancy": 1.0,
-                  "saturated": True}
+                  "saturated": True, "draining": False,
+                  "degraded": False, "degradation_level": 0}
     srv = start_metrics_server(eng, port=0)
     try:
         port = srv.server_address[1]
