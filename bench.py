@@ -552,6 +552,16 @@ def _compact_line(result):
                 row["goodput"] = {
                     k: mid.get(k) for k in
                     ("qps", "goodput", "p99_ttft_ms", "p99_tpot_ms")}
+            # quantized-serving scalars (serve7b): the MODELED compound
+            # ×-factor names the expected win on the ledger before the
+            # TPU window, and outputs_match/first_divergence carry the
+            # measured quality delta with it
+            qs = (r.get("extra") or {}).get("quant") or {}
+            if qs:
+                row["quant"] = {
+                    k: qs.get(k) for k in
+                    ("modeled_int8_w_x", "modeled_compound_x",
+                     "outputs_match", "first_divergence")}
             keep["secondary"][name] = row
     out["extra"] = keep
 
@@ -561,6 +571,7 @@ def _compact_line(result):
         for row in keep["secondary"].values():
             row.pop("error", None)
             row.pop("goodput", None)
+            row.pop("quant", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:
         # the capture pointer survives the final shed: a truncated CPU

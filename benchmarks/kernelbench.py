@@ -39,7 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
                      page_size=None, max_len=None, cache_bytes=2,
-                     act_bytes=2, n_tokens=1):
+                     act_bytes=2, n_tokens=1, cache_scale_bytes=0):
     """Modeled per-layer HBM bytes for one decode step's attention
     stage (RoPE + KV-append + attention over the cached KV) — the
     denominator of the decode roofline and the fused-vs-unfused A/B.
@@ -61,6 +61,11 @@ def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
     ``len + n_tokens`` up to the streaming granularity. The per-layer
     WEIGHT stream (the number spec decode amortizes) is not counted
     here — attention-stage traffic only, same as the n_tokens=1 rows.
+
+    ``cache_bytes`` is the KV byte width (2 bf16, 1 int8);
+    ``cache_scale_bytes`` adds the int8 pools' per-row f32 dequant
+    scales (4): one scale per cached row per head streams with the K
+    and V payloads, and each appended row writes one.
     """
     from paddle_tpu.kernels.decode_attention import contiguous_chunk
 
@@ -83,6 +88,11 @@ def decode_hbm_bytes(mode, fused, seq_lens, kvh, group, d,
     else:
         rows = slots * max_len
     total += 2 * rows * kvh * d * cache_bytes          # K+V stream
+    if cache_scale_bytes:
+        # int8 pools: per-row scales stream with the payload and one
+        # scale row is written per appended row (K and V each)
+        total += 2 * rows * kvh * cache_scale_bytes
+        total += 2 * kv_new_elems // d * cache_scale_bytes
     if not fused:
         # rope materialization round-trip: write q_rot+k_rot, re-read
         total += 2 * (q_elems + kv_new_elems) * act_bytes
@@ -164,9 +174,113 @@ def prefill_cost_ab():
         print(json.dumps(row), flush=True)
 
 
+def llama7b_weight_stream_bytes(weight_dtype="int8", group_size=128,
+                                kvh=8, d=128, hidden=4096, inter=11008,
+                                n_layers=32, vocab=32000):
+    """Modeled HBM bytes of ONE full weight stream at the serve7b
+    shape — the quantity EVERY decode pass re-reads, and what
+    weight-only quantization shrinks. Linears (qkvo with GQA-sized kv,
+    gated MLP, lm head) carry the chosen byte width plus group-wise
+    f32 scales (params/group_size × 4, int8/int4). The embedding is
+    NOT in the stream — decode reads one table row per token, not the
+    table (it is reported separately for residency accounting). Pure
+    python — runs anywhere."""
+    linear = n_layers * (2 * hidden * hidden + 2 * hidden * kvh * d
+                         + 3 * hidden * inter) + hidden * vocab
+    dense = hidden * vocab  # embedding (HBM residency, not stream)
+    widths = {"bf16": 2.0, "bfloat16": 2.0, "int8": 1.0, "int4": 0.5}
+    if weight_dtype not in widths:
+        raise ValueError(f"unknown weight_dtype {weight_dtype!r}")
+    payload = linear * widths[weight_dtype]
+    scales = (0 if weight_dtype in ("bf16", "bfloat16")
+              else linear // group_size * 4)
+    return {
+        "weight_dtype": weight_dtype,
+        "group_size": group_size,
+        "linear_params": int(linear),
+        "embed_params": int(dense),
+        "stream_bytes": int(payload + scales),
+        "scale_bytes": int(scales),
+    }
+
+
+def quant_decode_model(weight_dtype="int8", kv_dtype="bf16",
+                       accept_rate=0.0, k=4, kvh=8, heads=32, d=128,
+                       n_layers=32, group_size=128, seq_len=512,
+                       slots=8, page_size=64):
+    """THE compound quantized-serving model: bytes/token for a
+    (weight dtype × KV dtype × spec-decode acceptance) serving config
+    vs the bf16-weights / bf16-KV / no-spec baseline — pure python,
+    runs on any backend. Weight and KV byte-widths multiply with spec
+    decode's tokens-per-weight-stream, which is why int8-W alone
+    models ~1.9× and int8-W × int8-KV × acceptance 0.6 models ~4.6×
+    over plain bf16 decode."""
+    group = heads // kvh
+    lens = [seq_len] * slots
+    kv_bytes = {"bf16": 2, "bfloat16": 2, "fp16": 2, "int8": 1,
+                "fp32": 4, "float32": 4}[kv_dtype]
+    scale_b = 4 if kv_dtype == "int8" else 0
+    base_w = llama7b_weight_stream_bytes(
+        "bf16", group_size, kvh=kvh, d=d, n_layers=n_layers)
+    quant_w = llama7b_weight_stream_bytes(
+        weight_dtype, group_size, kvh=kvh, d=d, n_layers=n_layers)
+    attn_base = n_layers * decode_hbm_bytes(
+        "paged", True, lens, kvh, group, d, page_size=page_size,
+        cache_bytes=2)
+    n_tok = (k + 1) if accept_rate > 0 else 1
+    attn = n_layers * decode_hbm_bytes(
+        "paged", True, lens, kvh, group, d, page_size=page_size,
+        cache_bytes=kv_bytes, cache_scale_bytes=scale_b,
+        n_tokens=n_tok)
+    exp_tokens = (1.0 + sum(accept_rate ** j for j in range(1, k + 1))
+                  if accept_rate > 0 else 1.0)
+    base_bpt = (base_w["stream_bytes"] + attn_base) / slots
+    bpt = (quant_w["stream_bytes"] + attn) / slots / exp_tokens
+    return {
+        "weight_dtype": weight_dtype,
+        "kv_dtype": kv_dtype,
+        "accept_rate": accept_rate,
+        "k": k,
+        "kvh": kvh,
+        "group_size": group_size,
+        "seq_len": seq_len,
+        "slots": slots,
+        "weight_stream_bytes": quant_w["stream_bytes"],
+        "attn_bytes_per_pass": int(attn),
+        "tokens_per_weight_stream": round(exp_tokens, 3),
+        "bytes_per_token": int(bpt),
+        "baseline_bf16_bytes_per_token": int(base_bpt),
+        "modeled_speedup": round(base_bpt / bpt, 3),
+    }
+
+
+def quant_cost_ab():
+    """Print the modeled quantized-serving rows (pure cost models —
+    runs on ANY backend, ahead of the TPU guard): the weight-only
+    stream micro A/B at int8/int4 × group 64/128, and the compound
+    decode model (weight dtype × KV dtype × spec acceptance) whose
+    int8-W and int8-W×0.6-acceptance rows are the driver-ledger
+    prediction for the next TPU window."""
+    for wd in ("int8", "int4"):
+        for g in (64, 128):
+            row = llama7b_weight_stream_bytes(wd, group_size=g)
+            row["kernel"] = "weight_only_stream_model"
+            row["vs_bf16_x"] = round(
+                llama7b_weight_stream_bytes("bf16")["stream_bytes"]
+                / row["stream_bytes"], 3)
+            print(json.dumps(row), flush=True)
+    for wd, kv, a in (("int8", "bf16", 0.0), ("int4", "bf16", 0.0),
+                      ("int8", "int8", 0.0), ("int8", "int8", 0.6),
+                      ("int4", "int8", 0.6)):
+        row = quant_decode_model(wd, kv, accept_rate=a)
+        row["kernel"] = "quant_decode_model"
+        print(json.dumps(row), flush=True)
+
+
 def spec_decode_model(accept_rate, k, kvh, heads=32, d=128, n_layers=32,
                       weight_bytes=None, seq_len=512, slots=8,
-                      page_size=64, cache_bytes=2):
+                      page_size=64, cache_bytes=2, weight_byte_width=1,
+                      cache_scale_bytes=0):
     """Modeled tokens-per-weight-stream A/B: plain decode vs
     speculative decoding at a given per-draft acceptance rate (pure
     python, runs anywhere).
@@ -186,13 +300,16 @@ def spec_decode_model(accept_rate, k, kvh, heads=32, d=128, n_layers=32,
     group = heads // kvh
     lens = [seq_len] * slots
     if weight_bytes is None:
-        # serve7b-class int8 weight-only stream: qkvo (GQA-sized kv)
-        # + gated MLP per layer + the lm head, 1 byte/param
+        # serve7b-class weight-only stream: qkvo (GQA-sized kv)
+        # + gated MLP per layer + the lm head, ``weight_byte_width``
+        # bytes/param (1 = int8, the historical default; 2 = bf16,
+        # 0.5 = packed int4)
         hidden, inter, vocab = 4096, 11008, 32000
-        weight_bytes = n_layers * (
+        weight_bytes = (n_layers * (
             2 * hidden * hidden + 2 * hidden * kvh * d
-            + 3 * hidden * inter) + hidden * vocab
-    kw = dict(page_size=page_size, cache_bytes=cache_bytes)
+            + 3 * hidden * inter) + hidden * vocab) * weight_byte_width
+    kw = dict(page_size=page_size, cache_bytes=cache_bytes,
+              cache_scale_bytes=cache_scale_bytes)
     attn_plain = n_layers * decode_hbm_bytes(
         "paged", True, lens, kvh, group, d, **kw)
     attn_verify = n_layers * decode_hbm_bytes(
@@ -381,11 +498,13 @@ def _rope_one(q, k_new, positions, cos, sin):
 
 
 def main():
-    # the modeled prefill + spec-decode A/Bs are pure Python — emit
-    # them on ANY backend, before the TPU-only guards (they are the
-    # only output a CPU/GPU host gets from this CLI)
+    # the modeled prefill + spec-decode + quantized-serving A/Bs are
+    # pure Python — emit them on ANY backend, before the TPU-only
+    # guards (they are the only output a CPU/GPU host gets from this
+    # CLI)
     prefill_cost_ab()
     spec_decode_cost_ab()
+    quant_cost_ab()
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # fail fast WITHOUT importing jax: with the tunnel down, axon
         # plugin registration can hang the interpreter for minutes

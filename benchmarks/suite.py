@@ -918,6 +918,116 @@ def _fault_recovery_scenario(model, base_ecfg, tpu):
     return out
 
 
+def _quant_scenario(base_ecfg, tpu):
+    """Quantized-serving A/B: the SAME greedy workload served three
+    ways — bf16 weights (baseline), int8 weight streaming, and
+    int8 weights × int8 KV pools — through engines the ENGINE itself
+    quantizes at init (``EngineConfig.weight_dtype`` /
+    ``cache_dtype="int8"``, the production path). Reports tok/s per
+    arm, the modeled bytes/token ×-factors from
+    ``kernelbench.quant_decode_model`` (what the driver ledger
+    predicts ahead of the TPU window), and — the quality claim —
+    ``outputs_match`` plus the FIRST-DIVERGENCE token index per arm:
+    quantization's greedy delta is measured, never asserted away.
+
+    Builds its own DENSE model (the arms need fp weights to quantize
+    from; the main bench model is meta-built at int8 already). On TPU
+    it is depth-reduced so the bf16 arm fits HBM next to its KV pool —
+    the tok/s ratios isolate byte-width, which is depth-independent."""
+    from benchmarks.kernelbench import quant_decode_model
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.serving import (
+        ContinuousBatchingEngine,
+        EngineConfig,
+    )
+
+    if tpu:
+        mcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=4096,
+            intermediate_size=11008, num_hidden_layers=4,
+            num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=2048, use_flash_attention=False,
+            dtype="bfloat16")
+        n_requests, new_tokens, max_chunk = 8, 48, 8
+    else:
+        # CPU smoke: contract validation (three arms run, divergence is
+        # measured), not measurement — smallest config that still
+        # exercises GQA + both quant paths keeps the bench suite's
+        # tier-1 smoke cheap (compiles dominate at this size)
+        mcfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            use_flash_attention=False)
+        n_requests, new_tokens, max_chunk = 2, 8, 4
+    pt.seed(0)
+    model = LlamaForCausalLM(mcfg)
+    if mcfg.dtype == "bfloat16":
+        model.to(pt.bfloat16)
+    model.eval()
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, mcfg.vocab_size,
+                            (int(rng.integers(8, 24)),))
+               for _ in range(n_requests)]
+
+    arms = (("bf16", "bf16", base_ecfg.cache_dtype),
+            ("int8_w", "int8", base_ecfg.cache_dtype),
+            ("int8_w_int8_kv", "int8", "int8"))
+    out = {"n_requests": n_requests, "new_tokens": new_tokens,
+           "model_layers": mcfg.num_hidden_layers}
+    outputs = {}
+    for name, wdtype, cdtype in arms:
+        ecfg = EngineConfig(
+            max_slots=base_ecfg.max_slots, max_len=base_ecfg.max_len,
+            seq_buckets=tuple(base_ecfg.seq_buckets),
+            paged=base_ecfg.paged, page_size=base_ecfg.page_size,
+            cache_dtype=cdtype, weight_dtype=wdtype)
+        eng = ContinuousBatchingEngine(model, ecfg)
+        eng.run([prompts[0]], max_new_tokens=2,
+                max_chunk=max_chunk)  # compile outside the window
+        eng._finished.clear()
+        t0 = time.perf_counter()
+        reqs = eng.run(prompts, new_tokens, max_chunk=max_chunk)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        outputs[name] = [list(r.output) for r in reqs]
+        out[name] = {"tokens_per_sec": round(toks / wall, 1),
+                     "wall_s": round(wall, 3)}
+        eng = None  # drop this arm's KV pool before the next builds
+
+    def divergence(a, b):
+        """First token index (in the concatenated stream order) where
+        the arm diverges from the bf16 baseline; None if identical."""
+        idx = 0
+        for ra, rb in zip(a, b):
+            for ta, tb in zip(ra, rb):
+                if ta != tb:
+                    return idx
+                idx += 1
+            if len(ra) != len(rb):
+                return idx
+        return None
+
+    base = outputs["bf16"]
+    for name in ("int8_w", "int8_w_int8_kv"):
+        d = divergence(base, outputs[name])
+        out[name]["outputs_match"] = d is None
+        out[name]["first_divergence"] = d
+    out["outputs_match"] = out["int8_w"]["outputs_match"] \
+        and out["int8_w_int8_kv"]["outputs_match"]
+    out["first_divergence"] = out["int8_w_int8_kv"]["first_divergence"]
+    # the modeled prediction the ledger carries ahead of the TPU window
+    out["modeled_int8_w_x"] = quant_decode_model(
+        "int8", "bf16", 0.0)["modeled_speedup"]
+    out["modeled_int8_w_int8_kv_x"] = quant_decode_model(
+        "int8", "int8", 0.0)["modeled_speedup"]
+    out["modeled_compound_x"] = quant_decode_model(
+        "int8", "int8", 0.6)["modeled_speedup"]
+    return out
+
+
 def bench_serve7b(tpu_diags):
     """7B-class int8 weight-only decode through the paged continuous-
     batching engine — the first production-scale silicon path (VERDICT
@@ -976,6 +1086,7 @@ def bench_serve7b(tpu_diags):
     spec_ngram = _spec_ngram_scenario(model, ecfg, tpu)
     goodput = _goodput_scenario(model, ecfg, tpu)
     fault_recovery = _fault_recovery_scenario(model, ecfg, tpu)
+    quant = _quant_scenario(ecfg, tpu)
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
@@ -1026,6 +1137,7 @@ def bench_serve7b(tpu_diags):
         "spec_ngram": spec_ngram,
         "goodput_under_slo": goodput,
         "fault_recovery": fault_recovery,
+        "quant": quant,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,
             2 if cache_dtype == jnp.bfloat16 else 4),

@@ -164,7 +164,25 @@ define_flag("degradation", True,
 define_flag("kv_cache_dtype", "auto",
             "serving KV-cache dtype when EngineConfig.cache_dtype is "
             "'auto': auto = bfloat16 on TPU (halves decode KV traffic), "
-            "float32 elsewhere; or explicit bfloat16|float16|float32")
+            "float32 elsewhere; or explicit "
+            "bfloat16|float16|float32|int8. int8 stores per-row f32 "
+            "scales alongside the pools (per page-row paged, per block "
+            "row contiguous), quantizes on append and dequantizes "
+            "inside the fused decode kernels — KV stream bytes halve "
+            "again vs bf16; greedy outputs may differ from the fp "
+            "cache (the serve7b 'quant' bench scenario MEASURES that "
+            "delta, outputs_match + first-divergence index)")
+define_flag("serve_weight_dtype", "bf16",
+            "serving weight stream when EngineConfig.weight_dtype is "
+            "'auto': bf16 = serve the model's own weights; int8/int4 = "
+            "group-wise weight-only quantization at engine init "
+            "(quantize_model_weight_only), weights + scales ride every "
+            "compiled serving program as jit arguments and dequantize "
+            "in-kernel (weight_only_matmul_pallas on TPU, the XLA "
+            "dequant reference elsewhere) — weight HBM traffic drops "
+            "2x/4x, the decode roofline's other half. Single-chip "
+            "serving only (no mesh); quality delta is measured, not "
+            "asserted away, by the serve7b 'quant' scenario")
 define_flag("flash_attention_block_q", 256, "Pallas flash attn q block")
 define_flag("flash_attention_block_k", 256, "Pallas flash attn k block")
 define_flag("moe_capacity_factor", 1.25, "default MoE capacity factor")

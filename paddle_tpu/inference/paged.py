@@ -32,10 +32,21 @@ import numpy as np
 
 
 class PagedLayerCache(NamedTuple):
-    """Per-layer page pool + indirection (all device arrays)."""
+    """Per-layer page pool + indirection (all device arrays).
+
+    ``k_scale``/``v_scale`` are present only for int8 pools: per-ROW
+    f32 dequant scales laid out ``[kv_heads, n_pages, page_size, 1]``
+    so a page's scale rows travel WITH the page — adopt/COW/evict are
+    page-id bookkeeping, and the scale arrays are indexed by the same
+    page ids, so prefix sharing and rollback carry quantization state
+    for free. The trailing 1 keeps the scale blocks the same
+    (sublane, lane)-shaped as the pool blocks the Pallas decode kernel
+    already streams (page_size × d with d→1)."""
 
     k_pages: jax.Array  # [kv_heads, n_pages, page_size, head_dim]
     v_pages: jax.Array  # [kv_heads, n_pages, page_size, head_dim]
+    k_scale: Optional[jax.Array] = None  # [kv_heads, n_pages, page_size, 1]
+    v_scale: Optional[jax.Array] = None
 
 
 class PagedState(NamedTuple):
@@ -45,17 +56,76 @@ class PagedState(NamedTuple):
     seq_lens: jax.Array  # [slots] int32 — tokens already in cache
 
 
+class QuantizedKV(NamedTuple):
+    """int8 CONTIGUOUS cache side (K or V): payload + per-row scales.
+
+    q: [slots, max_len, kv_heads, head_dim] int8;
+    scale: [slots, max_len, kv_heads] f32 — one symmetric absmax scale
+    per written row per head (the "block row" granularity: dequant is
+    ``q * scale[..., None]``). Drop-in for the plain array in the
+    engine's per-layer ``(K, V)`` tuples — ``shape``/``dtype`` mirror
+    the payload so shape-derived dispatch (chunk length, fused-kernel
+    gating) keeps working."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+# one eps for every int8-KV quantization site — the kernels own it,
+# this module's XLA append paths import it (see the constant's note
+# in kernels/paged_attention.py)
+from ..kernels.paged_attention import KV_QUANT_EPS  # noqa: E402
+
+
+def quantize_kv_rows(x, out_dtype=jnp.int8):
+    """Symmetric per-row int8 over the LAST axis: x [..., d] →
+    (q int8 [..., d], scale f32 [...]). THE quantization rule for every
+    KV append path — host XLA scatters and the fused Pallas kernels
+    share the same math (absmax/127, round, clip) so fused and unfused
+    engines write bit-identical pools."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, KV_QUANT_EPS)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
+        .astype(out_dtype)
+    return q, scale
+
+
+def dequantize_kv(c):
+    """QuantizedKV (or raw array) → f32 values."""
+    if isinstance(c, QuantizedKV):
+        return c.q.astype(jnp.float32) * c.scale[..., None]
+    return c
+
+
 def init_paged_pool(n_layers: int, n_pages: int, page_size: int,
                     kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
-    return [
-        PagedLayerCache(
-            k_pages=jnp.zeros((kv_heads, n_pages, page_size, head_dim),
-                              dtype),
-            v_pages=jnp.zeros((kv_heads, n_pages, page_size, head_dim),
-                              dtype),
-        )
-        for _ in range(n_layers)
-    ]
+    """int8 ``dtype`` builds quantized pools with per-row scale arrays
+    alongside (zero-init: q=0 × scale=0 dequantizes to the same zeros a
+    fp pool starts with; every read row is appended first)."""
+    quant = jnp.dtype(dtype) == jnp.int8
+
+    def one():
+        pages = jnp.zeros((kv_heads, n_pages, page_size, head_dim),
+                          dtype)
+        scale = (jnp.zeros((kv_heads, n_pages, page_size, 1),
+                           jnp.float32) if quant else None)
+        return pages, scale
+
+    out = []
+    for _ in range(n_layers):
+        kp, ks = one()
+        vp, vs = one()
+        out.append(PagedLayerCache(kp, vp, ks, vs))
+    return out
 
 
 def append_kv(cache: PagedLayerCache, state: PagedState, k, v
@@ -72,13 +142,28 @@ def append_kv(cache: PagedLayerCache, state: PagedState, k, v
     page_idx = lens // page_size
     offs = lens % page_size
     pages = state.block_tables[jnp.arange(slots), page_idx]  # [slots]
+    if cache.k_scale is not None:
+        # quantize-on-append: the row's int8 payload and its f32 scale
+        # land at the SAME (page, offset) — the scale rides the page
+        kq, ks = quantize_kv_rows(k[:, 0])  # [slots, kvh, d] / [s, kvh]
+        vq, vs = quantize_kv_rows(v[:, 0])
+        return cache._replace(
+            k_pages=cache.k_pages.at[:, pages, offs].set(
+                kq.transpose(1, 0, 2)),
+            v_pages=cache.v_pages.at[:, pages, offs].set(
+                vq.transpose(1, 0, 2)),
+            k_scale=cache.k_scale.at[:, pages, offs, 0].set(
+                ks.transpose(1, 0)),
+            v_scale=cache.v_scale.at[:, pages, offs, 0].set(
+                vs.transpose(1, 0)),
+        )
     # destination [kvh, pages[i], offs[i]] <- k[i, 0, h]: value laid out
     # head-major to match the pool
     k_pages = cache.k_pages.at[:, pages, offs].set(
         k[:, 0].astype(cache.k_pages.dtype).transpose(1, 0, 2))
     v_pages = cache.v_pages.at[:, pages, offs].set(
         v[:, 0].astype(cache.v_pages.dtype).transpose(1, 0, 2))
-    return PagedLayerCache(k_pages, v_pages)
+    return cache._replace(k_pages=k_pages, v_pages=v_pages)
 
 
 def append_kv_chunk(cache: PagedLayerCache, state: PagedState, k, v,
@@ -103,23 +188,41 @@ def append_kv_chunk(cache: PagedLayerCache, state: PagedState, k, v,
     safe = jnp.minimum(page_idx, max_pages - 1)
     pages = jnp.take_along_axis(state.block_tables, safe, axis=1)
     pages = jnp.where(valid, pages, n_pages)  # OOB page id -> dropped
+    if cache.k_scale is not None:
+        kq, ks = quantize_kv_rows(k)  # [slots, s, kvh, d] / [slots, s, kvh]
+        vq, vs = quantize_kv_rows(v)
+        return cache._replace(
+            k_pages=cache.k_pages.at[:, pages, offs].set(
+                kq.transpose(2, 0, 1, 3), mode="drop"),
+            v_pages=cache.v_pages.at[:, pages, offs].set(
+                vq.transpose(2, 0, 1, 3), mode="drop"),
+            k_scale=cache.k_scale.at[:, pages, offs, 0].set(
+                ks.transpose(2, 0, 1), mode="drop"),
+            v_scale=cache.v_scale.at[:, pages, offs, 0].set(
+                vs.transpose(2, 0, 1), mode="drop"),
+        )
     # value laid out head-major to match the pool: [kvh, slots, s, d]
     k_pages = cache.k_pages.at[:, pages, offs].set(
         k.astype(cache.k_pages.dtype).transpose(2, 0, 1, 3), mode="drop")
     v_pages = cache.v_pages.at[:, pages, offs].set(
         v.astype(cache.v_pages.dtype).transpose(2, 0, 1, 3), mode="drop")
-    return PagedLayerCache(k_pages, v_pages)
+    return cache._replace(k_pages=k_pages, v_pages=v_pages)
 
 
 def gather_kv(cache: PagedLayerCache, state: PagedState
               ) -> Tuple[jax.Array, jax.Array]:
     """Materialize each slot's logical KV view: [slots, max_ctx, kvh, d]
-    where max_ctx = max_pages * page_size (mask handles the tail)."""
+    where max_ctx = max_pages * page_size (mask handles the tail).
+    int8 pools are DEQUANTIZED in the gather (q × per-row scale), so
+    every downstream consumer sees f32 values."""
     bt = state.block_tables  # [slots, max_pages]
     slots, max_pages = bt.shape
     kvh, _, page_size, d = cache.k_pages.shape
     k = cache.k_pages[:, bt]  # [kvh, slots, max_pages, page_size, d]
     v = cache.v_pages[:, bt]
+    if cache.k_scale is not None:
+        k = k.astype(jnp.float32) * cache.k_scale[:, bt]
+        v = v.astype(jnp.float32) * cache.v_scale[:, bt]
     k = k.reshape(kvh, slots, max_pages * page_size, d)
     v = v.reshape(kvh, slots, max_pages * page_size, d)
     return (k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3))
@@ -132,6 +235,11 @@ def _use_pallas_decode(cache: PagedLayerCache) -> bool:
 
     from ..kernels.decode_attention import decode_tiles_ok
 
+    if cache.k_scale is not None:
+        # int8 pools: the plain (non-fused) block-table kernel has no
+        # dequant path — the FUSED kernel is the int8 production path,
+        # and this dispatch's fallback is the dense dequant reference
+        return False
     page_size, d = cache.k_pages.shape[2], cache.k_pages.shape[3]
     aligned = decode_tiles_ok(d, page_size)
     if os.environ.get("PADDLE_TPU_FORCE_PALLAS"):

@@ -68,8 +68,28 @@ class EngineConfig:
     page_size: int = 64
     n_pages: Optional[int] = None  # default: slots*max_len/page_size (+sink)
     # "auto" resolves through PT_FLAGS_kv_cache_dtype: bf16 on TPU
-    # (halves decode KV traffic), fp32 elsewhere; explicit dtypes win
+    # (halves decode KV traffic), fp32 elsewhere; explicit dtypes win.
+    # "int8" builds quantized pools with per-row f32 scales alongside
+    # (quantize-on-append, in-kernel dequant) — requires the chunked
+    # prefill path and single-chip serving, both validated at init
     cache_dtype: object = "auto"
+    # serving weight stream: "auto" resolves through
+    # PT_FLAGS_serve_weight_dtype (default bf16 = the model's own
+    # weights). int8/int4 group-wise weight-only quantization happens
+    # at ENGINE INIT via quantize_model_weight_only — qweights+scales
+    # are buffers, so they ride every compiled program as jit
+    # arguments (the seam below) and dequantize in-kernel
+    weight_dtype: str = "auto"
+    # group size for the weight-only quantization's group-wise scales
+    # (layers whose in_features don't divide it fall back to one
+    # degenerate whole-column group, same rule as WeightOnlyLinear)
+    weight_group_size: int = 128
+    # quantize the CALLER'S model tree in place (frees the fp linears
+    # as they are replaced — the right trade for a 7B model that fits
+    # HBM only once). Default False: the engine deep-copies first, so
+    # the caller's model stays servable at full precision (A/B benches
+    # and tests build bf16 and int8 engines from ONE model)
+    quantize_inplace: bool = False
     # contiguous-mode prefix store cap (blocks of materialized
     # per-layer K/V — real device memory on top of the engine's own
     # cache); None = a QUARTER engine's worth
@@ -96,10 +116,13 @@ def _resolve_cache_dtype(requested):
     """EngineConfig.cache_dtype → concrete dtype. ``"auto"`` defers to
     the ``PT_FLAGS_kv_cache_dtype`` flag (auto = bfloat16 on TPU,
     float32 elsewhere — decode is KV-bandwidth-bound, so the cache
-    dtype IS the decode traffic); explicit dtypes pass through."""
+    dtype IS the decode traffic); explicit dtypes pass through.
+    ``"int8"`` selects quantized KV pools (per-row f32 scales stored
+    alongside; quantize-on-append, dequant in-kernel)."""
     named = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
              "float16": jnp.float16, "fp16": jnp.float16,
-             "float32": jnp.float32, "fp32": jnp.float32}
+             "float32": jnp.float32, "fp32": jnp.float32,
+             "int8": jnp.int8}
 
     def lookup(val, origin):
         if val not in named:
@@ -117,6 +140,27 @@ def _resolve_cache_dtype(requested):
         return (jnp.bfloat16 if jax.default_backend() == "tpu"
                 else jnp.float32)
     return lookup(val, "PT_FLAGS_kv_cache_dtype")
+
+
+_WEIGHT_DTYPES = ("bf16", "int8", "int4")
+
+
+def _resolve_weight_dtype(requested) -> str:
+    """EngineConfig.weight_dtype → "bf16" | "int8" | "int4".
+    ``"auto"`` defers to ``PT_FLAGS_serve_weight_dtype``; "bf16" means
+    "serve the model's weights as they are" (no quantization pass)."""
+    origin = "EngineConfig.weight_dtype"
+    if requested in (None, "auto"):
+        requested = flags.flag("serve_weight_dtype")
+        origin = "PT_FLAGS_serve_weight_dtype"
+    val = str(requested).lower()
+    if val == "bfloat16":
+        val = "bf16"
+    if val not in _WEIGHT_DTYPES:
+        raise ValueError(
+            f"{origin} must be 'auto' or one of {list(_WEIGHT_DTYPES)}; "
+            f"got {requested!r}")
+    return val
 
 
 def _validate_buckets(cfg: "EngineConfig") -> List[int]:
@@ -231,9 +275,56 @@ class ContinuousBatchingEngine:
         KV caches shard the kv-head axis; every compiled program runs
         under the mesh and GSPMD inserts the TP collectives. Requires
         num_key_value_heads divisible by the tp degree."""
-        self.model = model
         self.cfg = config or EngineConfig()
+        cfg = self.cfg
         self.mesh = mesh
+
+        # ---- quantized-serving config validation (at INIT, not at
+        # first dispatch: a weight/cache dtype combination with no
+        # kernel path must fail before any program compiles) ----
+        self.weight_dtype = _resolve_weight_dtype(cfg.weight_dtype)
+        self.cache_dtype = _resolve_cache_dtype(cfg.cache_dtype)
+        if not isinstance(cfg.weight_group_size, (int, np.integer)) \
+                or isinstance(cfg.weight_group_size, bool) \
+                or cfg.weight_group_size < 1:
+            raise ValueError(
+                f"EngineConfig.weight_group_size must be a positive "
+                f"int; got {cfg.weight_group_size!r}")
+        if self.weight_dtype != "bf16" and mesh is not None:
+            raise ValueError(
+                f"weight_dtype={self.weight_dtype!r} has no "
+                "tensor-parallel kernel path — quantized weight "
+                "streaming is single-chip serving today (drop the "
+                "mesh, or serve bf16 weights under it)")
+        if self.cache_dtype == jnp.int8:
+            if mesh is not None:
+                raise ValueError(
+                    "cache_dtype='int8' has no tensor-parallel kernel "
+                    "path (scale pools are not mesh-sharded) — drop "
+                    "the mesh or use a float cache dtype")
+            if int(flags.flag("prefill_chunk")) <= 0:
+                raise ValueError(
+                    "cache_dtype='int8' requires the chunked prefill "
+                    "path (PT_FLAGS_prefill_chunk > 0): the legacy "
+                    "per-bucket prefill's one-shot insert programs "
+                    "have no quantize-on-append path")
+
+        # ---- weight-only quantization (the tentpole seam): replace
+        # every linear with WeightOnlyLinear BEFORE param/buffer
+        # extraction so the int8/int4 qweights + group scales become
+        # buffers and ride every compiled program as jit arguments ----
+        if self.weight_dtype != "bf16":
+            import copy
+
+            from ..quantization import quantize_model_weight_only
+
+            if not cfg.quantize_inplace:
+                model = copy.deepcopy(model)
+            model = quantize_model_weight_only(
+                model, weight_dtype=self.weight_dtype,
+                group_size=cfg.weight_group_size)
+
+        self.model = model
         model.eval()
         self.params = extract_params(model)
         # buffers (rope tables, int8/int4 qweights+scales after
@@ -284,9 +375,7 @@ class ContinuousBatchingEngine:
                 if sub is not None and bname in sub._buffers:
                     sub._buffers[bname] = v
         self._pb = {"p": self.params, "b": self.buffers}
-        cfg = self.cfg
 
-        self.cache_dtype = _resolve_cache_dtype(cfg.cache_dtype)
         self.seq_lens = np.zeros((cfg.max_slots,), np.int64)
         self.active = np.zeros((cfg.max_slots,), bool)
         self.last_tok = np.zeros((cfg.max_slots,), np.int64)
@@ -749,10 +838,14 @@ class ContinuousBatchingEngine:
                         .transpose(2, 0, 1, 3)
                     ovp = ov[0].reshape(n_used, ps, *ov.shape[2:]) \
                         .transpose(2, 0, 1, 3)
-                    out.append(PagedLayerCache(
-                        cache.k_pages.at[:, pages].set(
+                    # _replace (not positional rebuild): this legacy
+                    # path never serves int8 pools (rejected at init),
+                    # but a positional ctor would silently DROP scale
+                    # arrays if that ever changed
+                    out.append(cache._replace(
+                        k_pages=cache.k_pages.at[:, pages].set(
                             okp.astype(cache.k_pages.dtype)),
-                        cache.v_pages.at[:, pages].set(
+                        v_pages=cache.v_pages.at[:, pages].set(
                             ovp.astype(cache.v_pages.dtype)),
                     ))
                 return out
@@ -809,17 +902,28 @@ class ContinuousBatchingEngine:
         mode's scale — production paged serving shares pages with zero
         copies instead."""
         if self._insert_prefix_c is None:
+            from .paged import QuantizedKV
+
+            def ins(g, blk, i, slot, start):
+                if isinstance(g, QuantizedKV):
+                    # int8 caches: the stored block carries its scale
+                    # rows — payload and scales insert together
+                    return QuantizedKV(
+                        jax.lax.dynamic_update_slice(
+                            g.q, blk.q[i][None].astype(g.q.dtype),
+                            (slot, start, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            g.scale, blk.scale[i][None],
+                            (slot, start, 0)))
+                return jax.lax.dynamic_update_slice(
+                    g, blk[i][None].astype(g.dtype), (slot, start, 0, 0))
+
             def fn(global_caches, kblk, vblk, slot, start):
                 TRACE_COUNTS["prefix_insert"] += 1
                 out = []
                 for i, (gk, gv) in enumerate(global_caches):
-                    gk = jax.lax.dynamic_update_slice(
-                        gk, kblk[i][None].astype(gk.dtype),
-                        (slot, start, 0, 0))
-                    gv = jax.lax.dynamic_update_slice(
-                        gv, vblk[i][None].astype(gv.dtype),
-                        (slot, start, 0, 0))
-                    out.append((gk, gv))
+                    out.append((ins(gk, kblk, i, slot, start),
+                                ins(gv, vblk, i, slot, start)))
                 return out
             self._insert_prefix_c = jax.jit(fn, donate_argnums=(0,))
         return self._insert_prefix_c
@@ -830,17 +934,37 @@ class ContinuousBatchingEngine:
         materialized copy of a fresh prefix block."""
         if self._read_block_c is None:
             B = self._prefix_block
+            from .paged import QuantizedKV
+
+            def rd(g, slot, start):
+                if isinstance(g, QuantizedKV):
+                    qsz = (1, B) + g.q.shape[2:]
+                    ssz = (1, B) + g.scale.shape[2:]
+                    return QuantizedKV(
+                        jax.lax.dynamic_slice(
+                            g.q, (slot, start, 0, 0), qsz)[0],
+                        jax.lax.dynamic_slice(
+                            g.scale, (slot, start, 0), ssz)[0])
+                sz = (1, B) + g.shape[2:]
+                return jax.lax.dynamic_slice(
+                    g, (slot, start, 0, 0), sz)[0]
+
+            def stack(blks):
+                if isinstance(blks[0], QuantizedKV):
+                    # the store's block keeps its scale rows: dequant
+                    # state survives insert into a future slot
+                    return QuantizedKV(
+                        jnp.stack([b.q for b in blks]),
+                        jnp.stack([b.scale for b in blks]))
+                return jnp.stack(blks)
 
             def fn(global_caches, slot, start):
                 TRACE_COUNTS["prefix_read"] += 1
                 ks, vs = [], []
                 for gk, gv in global_caches:
-                    sz = (1, B) + gk.shape[2:]
-                    ks.append(jax.lax.dynamic_slice(
-                        gk, (slot, start, 0, 0), sz)[0])
-                    vs.append(jax.lax.dynamic_slice(
-                        gv, (slot, start, 0, 0), sz)[0])
-                return jnp.stack(ks), jnp.stack(vs)
+                    ks.append(rd(gk, slot, start))
+                    vs.append(rd(gv, slot, start))
+                return stack(ks), stack(vs)
             self._read_block_c = jax.jit(fn)
         return self._read_block_c
 
@@ -849,21 +973,24 @@ class ContinuousBatchingEngine:
         ``dst`` across every layer's pool (src/dst are traced scalars —
         one specialization ever)."""
         if self._copy_page_c is None:
+            def copy1(arr, src, dst):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    arr,
+                    jax.lax.dynamic_slice_in_dim(arr, src, 1, axis=1),
+                    dst, axis=1)
+
             def fn(layer_caches, src, dst):
                 TRACE_COUNTS["page_copy"] += 1
                 out = []
                 for c in layer_caches:
-                    kp = jax.lax.dynamic_update_slice_in_dim(
-                        c.k_pages,
-                        jax.lax.dynamic_slice_in_dim(c.k_pages, src, 1,
-                                                     axis=1),
-                        dst, axis=1)
-                    vp = jax.lax.dynamic_update_slice_in_dim(
-                        c.v_pages,
-                        jax.lax.dynamic_slice_in_dim(c.v_pages, src, 1,
-                                                     axis=1),
-                        dst, axis=1)
-                    out.append(PagedLayerCache(kp, vp))
+                    rep = {"k_pages": copy1(c.k_pages, src, dst),
+                           "v_pages": copy1(c.v_pages, src, dst)}
+                    if c.k_scale is not None:
+                        # int8 pools: a COW'd page keeps its dequant
+                        # state — the scale rows copy with the page
+                        rep["k_scale"] = copy1(c.k_scale, src, dst)
+                        rep["v_scale"] = copy1(c.v_scale, src, dst)
+                    out.append(c._replace(**rep))
                 return out
             self._copy_page_c = jax.jit(fn, donate_argnums=(0,))
         return self._copy_page_c

@@ -46,6 +46,7 @@ from ..jax_compat import tpu_compiler_params
 from .paged_attention import (
     NEG_INF,
     _interpret,
+    kernel_quant_rows,
     kernel_rope_rot,
     online_softmax_update,
 )
@@ -59,21 +60,24 @@ def contiguous_chunk(max_len: int) -> int:
     return math.gcd(max_len, 128)
 
 
-def decode_tiles_ok(head_dim: int, minor: int) -> bool:
+def decode_tiles_ok(head_dim: int, minor: int, dtype=None) -> bool:
     """THE tiling rule for every Pallas decode kernel (block-table and
     fused, both cache modes — ``inference.paged._use_pallas_decode``
     shares it): d fills the lane dim, and ``minor`` (page_size or the
-    contiguous chunk) respects the bf16 sublane tile, so one rule
-    covers both pool dtypes."""
-    return head_dim % 128 == 0 and minor % 16 == 0
+    contiguous chunk) respects the pool dtype's sublane tile — 16 for
+    the bf16/f32 pools, 32 for int8 (the int8 min tile is (32, 128))."""
+    sub = 32 if (dtype is not None
+                 and jnp.dtype(dtype) == jnp.int8) else 16
+    return head_dim % 128 == 0 and minor % sub == 0
 
 
-def fused_decode_active(head_dim: int, minor: int) -> bool:
+def fused_decode_active(head_dim: int, minor: int, dtype=None) -> bool:
     """Gate for the fused decode kernels (PT_FLAGS_fused_decode).
 
     ``minor``: page_size (paged mode) or the contiguous chunk length —
-    the streamed block's sublane dim. auto = compiled kernel on TPU when
-    the block tiles (``decode_tiles_ok``); the lax reference elsewhere.
+    the streamed block's sublane dim; ``dtype``: the pool dtype (int8
+    tightens the tile rule). auto = compiled kernel on TPU when the
+    block tiles (``decode_tiles_ok``); the lax reference elsewhere.
     ``on`` forces the kernel (Pallas interpret mode off-TPU — how the
     tier-1 parity tests run it); ``off`` forces the reference path.
     """
@@ -84,17 +88,22 @@ def fused_decode_active(head_dim: int, minor: int) -> bool:
         return val in ("on", "1", "true", "yes")
     if val in ("on", "1", "true", "yes"):
         return True
-    return decode_tiles_ok(head_dim, minor)
+    return decode_tiles_ok(head_dim, minor, dtype)
 
 
 # ---------------------------------------------------------------------------
 # Pallas kernel — contiguous per-slot caches
 # ---------------------------------------------------------------------------
 def _fused_contig_kernel(lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
-                         k_ref, v_ref, cos_ref, sin_ref,
-                         o_ref, ko_ref, vo_ref,
-                         q_scratch, m_scratch, l_scratch, acc_scratch,
-                         *, scale, chunk, n_chunks, kvh, d):
+                         k_ref, v_ref, *rest,
+                         scale, chunk, n_chunks, kvh, d, quant):
+    if quant:
+        (ks_ref, vs_ref, cos_ref, sin_ref, o_ref, ko_ref, vo_ref,
+         kso_ref, vso_ref, q_scratch, m_scratch, l_scratch,
+         acc_scratch) = rest
+    else:
+        (cos_ref, sin_ref, o_ref, ko_ref, vo_ref, q_scratch,
+         m_scratch, l_scratch, acc_scratch) = rest
     s = pl.program_id(0)
     j = pl.program_id(1)
     seq_len = lens_ref[s]  # position of THIS token (== tokens cached)
@@ -110,15 +119,29 @@ def _fused_contig_kernel(lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
     # rotated new-token K for all heads, flattened to the cache row
     # layout [1, kvh*d]; written back as ONE aliased row per slot.
     # Attention merges the CACHE-DTYPE-ROUNDED values — same rounding
-    # the unfused path's appended row gets — so bf16 caches cannot
+    # the unfused path's appended row gets — so bf16/int8 caches cannot
     # flip a greedy argmax between the fused and unfused engines
-    k_store = rot(kn_ref[...].astype(jnp.float32)) \
-        .reshape(1, kvh * d).astype(ko_ref.dtype)
-    v_store = vn_ref[...].reshape(1, kvh * d).astype(vo_ref.dtype)
-    ko_ref[...] = k_store
-    vo_ref[...] = v_store
-    k_new = k_store.astype(jnp.float32)
-    v_new = v_store.astype(jnp.float32)
+    k_rot = rot(kn_ref[...].astype(jnp.float32))  # [kvh, 1, d]
+    v_raw = vn_ref[...].astype(jnp.float32)
+    if quant:
+        # quantize-on-append in-kernel (per head over d — the same row
+        # rule as inference.paged.quantize_kv_rows): int8 payload to
+        # the cache row, f32 scales to the [1, kvh] scale row
+        kq, kscl = kernel_quant_rows(k_rot)   # [kvh, 1, d], [kvh, 1, 1]
+        vq, vscl = kernel_quant_rows(v_raw)
+        ko_ref[...] = kq.reshape(1, kvh * d)
+        vo_ref[...] = vq.reshape(1, kvh * d)
+        kso_ref[...] = kscl.reshape(1, kvh)
+        vso_ref[...] = vscl.reshape(1, kvh)
+        k_new = (kq.astype(jnp.float32) * kscl).reshape(1, kvh * d)
+        v_new = (vq.astype(jnp.float32) * vscl).reshape(1, kvh * d)
+    else:
+        k_store = k_rot.reshape(1, kvh * d).astype(ko_ref.dtype)
+        v_store = v_raw.reshape(1, kvh * d).astype(vo_ref.dtype)
+        ko_ref[...] = k_store
+        vo_ref[...] = v_store
+        k_new = k_store.astype(jnp.float32)
+        v_new = v_store.astype(jnp.float32)
 
     @pl.when(j == 0)
     def _init():
@@ -132,9 +155,16 @@ def _fused_contig_kernel(lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
         is_last = j == last_chunk
         row = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)
         sel = (row == offs) & is_last
+        kf = k_ref[...].astype(jnp.float32)
+        vf = v_ref[...].astype(jnp.float32)
+        if quant:
+            # dequantize the streamed chunk: scale rows [chunk, kvh]
+            # broadcast over each head's d-segment of the row layout
+            kf = kf * jnp.repeat(ks_ref[...], d, axis=1)
+            vf = vf * jnp.repeat(vs_ref[...], d, axis=1)
         # merge the new token into the streamed chunk in VMEM
-        k_blk = jnp.where(sel, k_new, k_ref[...].astype(jnp.float32))
-        v_blk = jnp.where(sel, v_new, v_ref[...].astype(jnp.float32))
+        k_blk = jnp.where(sel, k_new, kf)
+        v_blk = jnp.where(sel, v_new, vf)
         valid = (j * chunk + jax.lax.broadcasted_iota(
             jnp.int32, (1, chunk), 1)) <= seq_len  # [1, chunk]
         for h in range(kvh):  # static unroll; all heads share the fetch
@@ -162,7 +192,8 @@ def _fused_contig_kernel(lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
 
 
 def fused_contiguous_decode_attention(q, k_new, v_new, ck, cv, seq_lens,
-                                      positions, cos, sin, scale=None):
+                                      positions, cos, sin, scale=None,
+                                      k_scale=None, v_scale=None):
     """Single-pass decode over the engine's contiguous per-slot caches:
     RoPE(q, k_new) + write (k_new, v_new) at each slot's current length
     + length-pruned online-softmax attention, one kernel per layer.
@@ -180,12 +211,22 @@ def fused_contiguous_decode_attention(q, k_new, v_new, ck, cv, seq_lens,
     the last cached row) and positions[i] < cos.shape[0]. The serving
     engine guarantees both (add_request length check + _maybe_finish).
 
-    Returns (out [slots, kv_heads, group, d], ck', cv').
+    INT8 CACHES: pass ``k_scale``/``v_scale`` f32
+    [slots, max_len, kvh] per-row dequant scales (the layout
+    ``QuantizedKV`` carries). The kernel quantizes the appended row
+    per head in-kernel (same absmax rule as the XLA scatter paths),
+    writes payload + scale rows together, and dequantizes each
+    streamed chunk in VMEM. Scale blocks are (chunk, kvh) — sublane
+    matches the cache blocks, lane is the full kvh dim.
+
+    Returns (out [slots, kv_heads, group, d], ck', cv') — plus
+    (k_scale', v_scale') when quantized.
     """
     slots, kvh, group, d = q.shape
     max_len = ck.shape[1]
     chunk = contiguous_chunk(max_len)
     n_chunks = max_len // chunk
+    quant = k_scale is not None
     if scale is None:
         scale = d ** -0.5
 
@@ -214,26 +255,57 @@ def fused_contiguous_decode_attention(q, k_new, v_new, ck, cv, seq_lens,
     def append_index(s, j, lens_ref, pos_ref):
         return (s, lens_ref[s], 0)  # the new token's row, constant in j
 
+    in_specs = [
+        pl.BlockSpec((None, kvh, group_pad, d),
+                     lambda s, j, l, p: (s, 0, 0, 0)),
+        pl.BlockSpec((None, kvh, 1, d),
+                     lambda s, j, l, p: (s, 0, 0, 0)),
+        pl.BlockSpec((None, kvh, 1, d),
+                     lambda s, j, l, p: (s, 0, 0, 0)),
+        pl.BlockSpec((None, chunk, kvh * d), kv_index),
+        pl.BlockSpec((None, chunk, kvh * d), kv_index),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, kvh, group_pad, d), q_index),
+        pl.BlockSpec((None, 1, kvh * d), append_index),
+        pl.BlockSpec((None, 1, kvh * d), append_index),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
+        jax.ShapeDtypeStruct(ck2.shape, ck2.dtype),
+        jax.ShapeDtypeStruct(cv2.shape, cv2.dtype),
+    ]
+    # operand order: 2 prefetch scalars, q, kn, vn, ck(5), cv(6),
+    # [ks(7), vs(8),] cos, sin — caches (and scale arrays) alias
+    # their outputs (in-place append)
+    aliases = {5: 1, 6: 2}
+    operands = [q, k_new, v_new, ck2, cv2]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((None, chunk, kvh), kv_index),
+            pl.BlockSpec((None, chunk, kvh), kv_index),
+        ]
+        out_specs += [
+            pl.BlockSpec((None, 1, kvh), append_index),
+            pl.BlockSpec((None, 1, kvh), append_index),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        aliases.update({7: 3, 8: 4})
+        operands += [k_scale, v_scale]
+    in_specs += [
+        pl.BlockSpec((1, half), rope_index),
+        pl.BlockSpec((1, half), rope_index),
+    ]
+    operands += [cos, sin]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, n_chunks),
-        in_specs=[
-            pl.BlockSpec((None, kvh, group_pad, d),
-                         lambda s, j, l, p: (s, 0, 0, 0)),
-            pl.BlockSpec((None, kvh, 1, d),
-                         lambda s, j, l, p: (s, 0, 0, 0)),
-            pl.BlockSpec((None, kvh, 1, d),
-                         lambda s, j, l, p: (s, 0, 0, 0)),
-            pl.BlockSpec((None, chunk, kvh * d), kv_index),
-            pl.BlockSpec((None, chunk, kvh * d), kv_index),
-            pl.BlockSpec((1, half), rope_index),
-            pl.BlockSpec((1, half), rope_index),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, kvh, group_pad, d), q_index),
-            pl.BlockSpec((None, 1, kvh * d), append_index),
-            pl.BlockSpec((None, 1, kvh * d), append_index),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((kvh, group_pad, d), jnp.float32),
             pltpu.VMEM((kvh, group_pad, 128), jnp.float32),
@@ -243,26 +315,27 @@ def fused_contiguous_decode_attention(q, k_new, v_new, ck, cv, seq_lens,
     )
     kernel = functools.partial(
         _fused_contig_kernel, scale=scale, chunk=chunk,
-        n_chunks=n_chunks, kvh=kvh, d=d,
+        n_chunks=n_chunks, kvh=kvh, d=d, quant=quant,
     )
-    out, ck2, cv2 = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
-            jax.ShapeDtypeStruct(ck2.shape, ck2.dtype),
-            jax.ShapeDtypeStruct(cv2.shape, cv2.dtype),
-        ],
-        # operand order: 2 prefetch scalars, q, kn, vn, ck(5), cv(6),
-        # cos, sin — caches alias outputs 1/2 (in-place append)
-        input_output_aliases={5: 1, 6: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=_interpret(),
     )(jnp.asarray(seq_lens, jnp.int32),
       jnp.asarray(positions, jnp.int32),
-      q, k_new, v_new, ck2, cv2, cos, sin)
+      *operands)
+    if quant:
+        out, ck2, cv2, k_scale, v_scale = res
+        return (out[:, :, :group, :],
+                ck2.reshape(slots, max_len, kvh, d),
+                cv2.reshape(slots, max_len, kvh, d),
+                k_scale, v_scale)
+    out, ck2, cv2 = res
     return (out[:, :, :group, :],
             ck2.reshape(slots, max_len, kvh, d),
             cv2.reshape(slots, max_len, kvh, d))
@@ -284,10 +357,14 @@ def _rope_rotate(x, positions, cos, sin):
 
 def fused_paged_decode_reference(q, k_new, v_new, k_pages, v_pages,
                                  block_tables, seq_lens, positions,
-                                 cos, sin, scale=None):
+                                 cos, sin, scale=None,
+                                 k_scale=None, v_scale=None):
     """Unfused reference for ``fused_paged_decode_attention``: rope →
     append_kv scatter → dense gathered attention (the pre-fusion decode
-    path, kept as the parity oracle)."""
+    path, kept as the parity oracle). int8 pools (``k_scale`` set) ride
+    the same path: ``append_kv`` quantizes-on-append, ``gather_kv``
+    dequantizes, so this stays the numeric oracle for the quantized
+    kernel too."""
     from ..inference.paged import (
         PagedLayerCache,
         PagedState,
@@ -299,21 +376,29 @@ def fused_paged_decode_reference(q, k_new, v_new, k_pages, v_pages,
     qr = _rope_rotate(q.reshape(slots, kvh * group, d), positions,
                       cos, sin).reshape(slots, kvh, group, d)
     kr = _rope_rotate(k_new, positions, cos, sin)
-    cache = PagedLayerCache(k_pages, v_pages)
+    cache = PagedLayerCache(k_pages, v_pages, k_scale, v_scale)
     state = PagedState(jnp.asarray(block_tables, jnp.int32),
                        jnp.asarray(seq_lens, jnp.int32))
     cache = append_kv(cache, state, kr[:, None], v_new[:, None])
     out = dense_paged_attention(
         qr.reshape(slots, 1, kvh * group, d), cache, state, scale=scale)
-    return (out[:, 0].reshape(slots, kvh, group, d),
-            cache.k_pages, cache.v_pages)
+    out = out[:, 0].reshape(slots, kvh, group, d)
+    if k_scale is not None:
+        return (out, cache.k_pages, cache.v_pages,
+                cache.k_scale, cache.v_scale)
+    return out, cache.k_pages, cache.v_pages
 
 
 def fused_contiguous_decode_reference(q, k_new, v_new, ck, cv, seq_lens,
-                                      positions, cos, sin, scale=None):
+                                      positions, cos, sin, scale=None,
+                                      k_scale=None, v_scale=None):
     """Unfused reference for ``fused_contiguous_decode_attention``:
     rope → per-slot scatter → dense masked attention over the full
-    [slots, max_len] cache (the pre-fusion contiguous decode path)."""
+    [slots, max_len] cache (the pre-fusion contiguous decode path).
+    int8 caches (``k_scale`` set): the appended row is quantized with
+    the shared absmax rule and attention reads the dequantized cache."""
+    from ..inference.paged import quantize_kv_rows
+
     slots, kvh, group, d = q.shape
     max_len = ck.shape[1]
     if scale is None:
@@ -322,15 +407,29 @@ def fused_contiguous_decode_reference(q, k_new, v_new, ck, cv, seq_lens,
                       cos, sin).reshape(slots, kvh, group, d)
     kr = _rope_rotate(k_new, positions, cos, sin)
     lens = jnp.asarray(seq_lens, jnp.int32)
-    ck = ck.at[jnp.arange(slots), lens].set(kr.astype(ck.dtype))
-    cv = cv.at[jnp.arange(slots), lens].set(v_new.astype(cv.dtype))
-    k = jnp.repeat(ck.astype(jnp.float32), group, axis=2)
-    v = jnp.repeat(cv.astype(jnp.float32), group, axis=2)
+    quant = k_scale is not None
+    if quant:
+        kq, ks = quantize_kv_rows(kr)      # [slots, kvh, d] / [s, kvh]
+        vq, vs = quantize_kv_rows(v_new)
+        ck = ck.at[jnp.arange(slots), lens].set(kq)
+        cv = cv.at[jnp.arange(slots), lens].set(vq)
+        k_scale = k_scale.at[jnp.arange(slots), lens].set(ks)
+        v_scale = v_scale.at[jnp.arange(slots), lens].set(vs)
+        kf = ck.astype(jnp.float32) * k_scale[..., None]
+        vf = cv.astype(jnp.float32) * v_scale[..., None]
+    else:
+        ck = ck.at[jnp.arange(slots), lens].set(kr.astype(ck.dtype))
+        cv = cv.at[jnp.arange(slots), lens].set(v_new.astype(cv.dtype))
+        kf, vf = ck, cv
+    k = jnp.repeat(kf.astype(jnp.float32), group, axis=2)
+    v = jnp.repeat(vf.astype(jnp.float32), group, axis=2)
     qf = qr.reshape(slots, kvh * group, 1, d).astype(jnp.float32) * scale
     s = jnp.einsum("shqd,skhd->shqk", qf, k)
     mask = jnp.arange(max_len)[None, :] <= lens[:, None]
     s = jnp.where(mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("shqk,skhd->shqd", p, v)
-    return (out[:, :, 0].reshape(slots, kvh, group, d).astype(q.dtype),
-            ck, cv)
+    out = out[:, :, 0].reshape(slots, kvh, group, d).astype(q.dtype)
+    if quant:
+        return out, ck, cv, k_scale, v_scale
+    return out, ck, cv

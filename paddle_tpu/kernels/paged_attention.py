@@ -34,6 +34,12 @@ from jax.experimental.pallas import tpu as pltpu
 from ..jax_compat import tpu_compiler_params
 
 NEG_INF = -1e30
+# THE int8-KV quantization epsilon (scale = max(absmax/127, eps)) —
+# one constant shared by the in-kernel quantize-on-append below and
+# the XLA append paths (inference.paged.quantize_kv_rows imports it):
+# a divergent eps would silently break the fused-vs-unfused
+# bit-identical-pools contract
+KV_QUANT_EPS = 1e-8
 
 
 def _interpret() -> bool:
@@ -172,6 +178,19 @@ def kernel_rope_rot(x, cos, sin):
                             x2 * cos + x1 * sin], axis=-1)
 
 
+def kernel_quant_rows(x):
+    """In-kernel symmetric per-row int8: x [rows, d] f32 → (int8 rows,
+    f32 scales [rows, 1]). ONE definition shared by the paged and
+    contiguous fused kernels, matching ``inference.paged.
+    quantize_kv_rows`` exactly (absmax/127, round, clip, same eps) so
+    the fused quantize-on-append and the XLA scatter paths write
+    bit-identical pools."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, KV_QUANT_EPS)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def online_softmax_update(sc, v, m_prev, l_prev, acc_prev):
     """One streaming-softmax step shared by the fused decode kernels:
     fold scores ``sc`` [q, kblock] and values ``v`` [kblock, d] into the
@@ -189,10 +208,15 @@ def online_softmax_update(sc, v, m_prev, l_prev, acc_prev):
 
 
 def _fused_decode_kernel(bt_ref, lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
-                         k_ref, v_ref, cos_ref, sin_ref,
-                         o_ref, ko_ref, vo_ref,
-                         q_scratch, m_scratch, l_scratch, acc_scratch,
-                         *, scale, page_size, max_pages, group_pad):
+                         k_ref, v_ref, *rest,
+                         scale, page_size, max_pages, group_pad, quant):
+    if quant:
+        (ks_ref, vs_ref, cos_ref, sin_ref, o_ref, ko_ref, vo_ref,
+         kso_ref, vso_ref, q_scratch, m_scratch, l_scratch,
+         acc_scratch) = rest
+    else:
+        (cos_ref, sin_ref, o_ref, ko_ref, vo_ref, q_scratch,
+         m_scratch, l_scratch, acc_scratch) = rest
     s = pl.program_id(0)
     j = pl.program_id(2)
     seq_len = lens_ref[s]  # position of THIS token (== tokens cached)
@@ -212,15 +236,28 @@ def _fused_decode_kernel(bt_ref, lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
     # token never round-trips through HBM before attention reads it.
     # Attention merges the CACHE-DTYPE-ROUNDED values (not the f32
     # intermediates): the unfused path attends to the appended row
-    # as the pool stores it, and bf16 pools must not flip a greedy
+    # as the pool stores it, and bf16/int8 pools must not flip a greedy
     # argmax between the fused and unfused engines
-    k_store = rot(kn_ref[0, 0].astype(jnp.float32)) \
-        .astype(ko_ref.dtype)  # [1, d]
-    v_store = vn_ref[0, 0].astype(vo_ref.dtype)
-    ko_ref[...] = k_store
-    vo_ref[...] = v_store
-    k_new = k_store.astype(jnp.float32)
-    v_new = v_store.astype(jnp.float32)
+    k_rot = rot(kn_ref[0, 0].astype(jnp.float32))  # [1, d]
+    v_raw = vn_ref[0, 0].astype(jnp.float32)
+    if quant:
+        # quantize-on-append in-kernel: the int8 row and its f32 scale
+        # land together; attention merges the DEQUANTIZED stored values
+        kq, kscl = kernel_quant_rows(k_rot)
+        vq, vscl = kernel_quant_rows(v_raw)
+        ko_ref[...] = kq
+        vo_ref[...] = vq
+        kso_ref[...] = kscl
+        vso_ref[...] = vscl
+        k_new = kq.astype(jnp.float32) * kscl
+        v_new = vq.astype(jnp.float32) * vscl
+    else:
+        k_store = k_rot.astype(ko_ref.dtype)
+        v_store = v_raw.astype(vo_ref.dtype)
+        ko_ref[...] = k_store
+        vo_ref[...] = v_store
+        k_new = k_store.astype(jnp.float32)
+        v_new = v_store.astype(jnp.float32)
 
     @pl.when(j == 0)
     def _init():
@@ -240,8 +277,15 @@ def _fused_decode_kernel(bt_ref, lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
         # merge the new token into the streamed page IN VMEM: the HBM
         # page still holds stale data at `offs`; attention must see the
         # rotated k / raw v of the token being appended this step
-        k = jnp.where(sel, k_new, k_ref[...].astype(jnp.float32))
-        v = jnp.where(sel, v_new, v_ref[...].astype(jnp.float32))
+        kf = k_ref[...].astype(jnp.float32)
+        vf = v_ref[...].astype(jnp.float32)
+        if quant:
+            # dequantize the streamed page: per-row scales ride as a
+            # [page_size, 1] block alongside the [page_size, d] page
+            kf = kf * ks_ref[...]
+            vf = vf * vs_ref[...]
+        k = jnp.where(sel, k_new, kf)
+        v = jnp.where(sel, v_new, vf)
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -266,7 +310,8 @@ def _fused_decode_kernel(bt_ref, lens_ref, pos_ref, q_ref, kn_ref, vn_ref,
 
 def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
                                  block_tables, seq_lens, positions,
-                                 cos, sin, scale=None):
+                                 cos, sin, scale=None,
+                                 k_scale=None, v_scale=None):
     """Single-pass decode: RoPE(q, k_new) + append (k_new, v_new) into
     each slot's current page + length-pruned online-softmax attention,
     one kernel per layer.
@@ -290,11 +335,22 @@ def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
     silently overwrites the last allocated row) and positions[i] <
     cos.shape[0]. The serving engine guarantees both.
 
-    Returns (out [slots, kv_heads, group, d], k_pages', v_pages').
+    INT8 POOLS: pass ``k_scale``/``v_scale`` f32
+    [kv_heads, n_pages, page_size, 1] per-row dequant scales (the
+    layout ``inference.paged.init_paged_pool`` builds). The kernel
+    quantizes the appended row in-kernel (same absmax rule as the XLA
+    append paths), writes payload + scale together, and dequantizes
+    each streamed page in VMEM — attention math stays f32. Scale
+    blocks mirror the pool blocks with d→1 so they tile wherever the
+    pool does.
+
+    Returns (out [slots, kv_heads, group, d], k_pages', v_pages') —
+    plus (k_scale', v_scale') when quantized.
     """
     slots, kvh, group, d = q.shape
     _, n_pages, page_size, _ = k_pages.shape
     max_pages = block_tables.shape[1]
+    quant = k_scale is not None
     if scale is None:
         scale = d ** -0.5
 
@@ -321,23 +377,55 @@ def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
         return (h, bt_ref[s, lens_ref[s] // page_size],
                 lens_ref[s] % page_size, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, group_pad, d), q_index),
+        pl.BlockSpec((1, 1, 1, d), q_index),
+        pl.BlockSpec((1, 1, 1, d), q_index),
+        pl.BlockSpec((None, None, page_size, d), kv_index),
+        pl.BlockSpec((None, None, page_size, d), kv_index),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, group_pad, d), q_index),
+        pl.BlockSpec((None, None, 1, d), append_index),
+        pl.BlockSpec((None, None, 1, d), append_index),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
+        jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+        jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+    ]
+    # operand order: 3 prefetch scalars, q, kn, vn, k_pages(6),
+    # v_pages(7), [k_scale(8), v_scale(9),] cos, sin — pools (and
+    # scale arrays) alias their outputs so the append is in-place on
+    # the donated cache buffers
+    aliases = {6: 1, 7: 2}
+    operands = [q, k_new, v_new, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((None, None, page_size, 1), kv_index),
+            pl.BlockSpec((None, None, page_size, 1), kv_index),
+        ]
+        out_specs += [
+            pl.BlockSpec((None, None, 1, 1), append_index),
+            pl.BlockSpec((None, None, 1, 1), append_index),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        aliases.update({8: 3, 9: 4})
+        operands += [k_scale, v_scale]
+    in_specs += [
+        pl.BlockSpec((1, half), rope_index),
+        pl.BlockSpec((1, half), rope_index),
+    ]
+    operands += [cos, sin]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(slots, kvh, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, group_pad, d), q_index),
-            pl.BlockSpec((1, 1, 1, d), q_index),
-            pl.BlockSpec((1, 1, 1, d), q_index),
-            pl.BlockSpec((None, None, page_size, d), kv_index),
-            pl.BlockSpec((None, None, page_size, d), kv_index),
-            pl.BlockSpec((1, half), rope_index),
-            pl.BlockSpec((1, half), rope_index),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, group_pad, d), q_index),
-            pl.BlockSpec((None, None, 1, d), append_index),
-            pl.BlockSpec((None, None, 1, d), append_index),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((group_pad, d), jnp.float32),
             pltpu.VMEM((group_pad, 128), jnp.float32),
@@ -347,20 +435,13 @@ def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
     )
     kernel = functools.partial(
         _fused_decode_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages, group_pad=group_pad,
+        max_pages=max_pages, group_pad=group_pad, quant=quant,
     )
-    out, k_pages, v_pages = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((slots, kvh, group_pad, d), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ],
-        # operand order: 3 prefetch scalars, q, kn, vn, k_pages(6),
-        # v_pages(7), cos, sin — the pools alias outputs 1/2 so the
-        # append is in-place on the donated cache buffers
-        input_output_aliases={6: 1, 7: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
@@ -368,5 +449,9 @@ def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
     )(jnp.asarray(block_tables, jnp.int32),
       jnp.asarray(seq_lens, jnp.int32),
       jnp.asarray(positions, jnp.int32),
-      q, k_new, v_new, k_pages, v_pages, cos, sin)
+      *operands)
+    if quant:
+        out, k_pages, v_pages, k_scale, v_scale = res
+        return out[:, :, :group, :], k_pages, v_pages, k_scale, v_scale
+    out, k_pages, v_pages = res
     return out[:, :, :group, :], k_pages, v_pages

@@ -44,16 +44,37 @@ def quantize_weight_int8_grouped(w: jax.Array, group_size: int = 128):
     return q.reshape(k, n), scale[:, 0, :]
 
 
+def _largest_group(k: int, group_size: int) -> int:
+    """Largest divisor of ``k`` that is <= group_size (>= 1) — the
+    suggestion the int4 error message offers."""
+    g = min(group_size, k)
+    while g > 1 and k % g:
+        g -= 1
+    return g
+
+
 def quantize_weight_int4_grouped(w: jax.Array, group_size: int = 128):
     """Symmetric group-wise int4, packed two values per int8 byte along k.
 
     w: [k, n] → (packed int8 [k // 2, n], scale f32 [k // group_size, n]).
     Row 2i lives in the low nibble of packed row i, row 2i+1 in the high
-    nibble.
+    nibble (the order ``_unpack_int4`` inverts — pinned by test).
     """
     k, n = w.shape
-    if k % group_size or k % 2:
-        raise ValueError(f"k={k} must be even and divisible by group_size")
+    if k % 2:
+        raise ValueError(
+            f"int4 packing stores two rows per byte, so the in (k) "
+            f"dimension must be even; got k={k}. Pad the weight with "
+            f"one zero row (scales are per-group, a zero row is "
+            f"exact) or keep this layer at int8.")
+    if k % group_size:
+        raise ValueError(
+            f"k={k} is not divisible by group_size={group_size}: "
+            f"group-wise scales cover whole [group_size, n] row "
+            f"blocks. Pick a group_size that divides k (e.g. "
+            f"group_size={_largest_group(k, group_size)}), or pass "
+            f"group_size=k for one degenerate whole-column group — "
+            f"WeightOnlyLinear does that fallback automatically.")
     wf = w.astype(jnp.float32).reshape(k // group_size, group_size, n)
     amax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)
     scale = jnp.maximum(amax / 7.0, 1e-8)

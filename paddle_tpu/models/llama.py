@@ -147,8 +147,10 @@ class LlamaAttention(Layer):
         v = shard_activation(v, ("dp", "fsdp"), "sep", "tp", None)
         if kv_cache is not None:
             from ..distributed.sharding import current_mesh
-            from ..inference.paged import (PagedLayerCache, append_kv,
-                                           paged_attention)
+            from ..inference.paged import (PagedLayerCache, QuantizedKV,
+                                           append_kv, dequantize_kv,
+                                           paged_attention,
+                                           quantize_kv_rows)
             from ..kernels import decode_attention as da
 
             paged_mode = isinstance(kv_cache[0], PagedLayerCache)
@@ -163,7 +165,9 @@ class LlamaAttention(Layer):
             if fused:
                 minor = (kv_cache[0].k_pages.shape[2] if paged_mode
                          else da.contiguous_chunk(kv_cache[0].shape[1]))
-                fused = da.fused_decode_active(cfg.head_dim, minor)
+                fused = da.fused_decode_active(
+                    cfg.head_dim, minor, kv_cache[0].k_pages.dtype
+                    if paged_mode else kv_cache[0].dtype)
             if not fused:
                 q, k = apply_rope(q, k, cos, sin, position_ids)
             kvh = cfg.num_key_value_heads
@@ -183,17 +187,41 @@ class LlamaAttention(Layer):
                     )
 
                     cache, state = kv_cache
-                    og, kp, vp = fused_paged_decode_attention(
-                        qg, k[:, 0], v[:, 0], cache.k_pages,
-                        cache.v_pages, state.block_tables,
-                        state.seq_lens, pos, rope_cos, rope_sin)
-                    new_cache = (PagedLayerCache(kp, vp), state)
+                    if cache.k_scale is not None:
+                        # int8 pool: the kernel quantizes the appended
+                        # row and returns updated scale arrays — they
+                        # ride the cache pytree like the pages do
+                        og, kp, vp, ksc, vsc = \
+                            fused_paged_decode_attention(
+                                qg, k[:, 0], v[:, 0], cache.k_pages,
+                                cache.v_pages, state.block_tables,
+                                state.seq_lens, pos, rope_cos,
+                                rope_sin, k_scale=cache.k_scale,
+                                v_scale=cache.v_scale)
+                        new_cache = (PagedLayerCache(kp, vp, ksc, vsc),
+                                     state)
+                    else:
+                        og, kp, vp = fused_paged_decode_attention(
+                            qg, k[:, 0], v[:, 0], cache.k_pages,
+                            cache.v_pages, state.block_tables,
+                            state.seq_lens, pos, rope_cos, rope_sin)
+                        new_cache = (PagedLayerCache(kp, vp), state)
                 else:
                     ck, cv = kv_cache
-                    og, ck, cv = da.fused_contiguous_decode_attention(
-                        qg, k[:, 0], v[:, 0], ck, cv, lens, pos,
-                        rope_cos, rope_sin)
-                    new_cache = (ck, cv)
+                    if isinstance(ck, QuantizedKV):
+                        og, ckq, cvq, ksc, vsc = \
+                            da.fused_contiguous_decode_attention(
+                                qg, k[:, 0], v[:, 0], ck.q, cv.q,
+                                lens, pos, rope_cos, rope_sin,
+                                k_scale=ck.scale, v_scale=cv.scale)
+                        new_cache = (QuantizedKV(ckq, ksc),
+                                     QuantizedKV(cvq, vsc))
+                    else:
+                        og, ck, cv = \
+                            da.fused_contiguous_decode_attention(
+                                qg, k[:, 0], v[:, 0], ck, cv, lens,
+                                pos, rope_cos, rope_sin)
+                        new_cache = (ck, cv)
                 out = og.reshape(b, 1, cfg.num_attention_heads, hd)
             elif paged_mode and per_slot and s > 1:
                 # chunked prefill (paged): scatter the chunk's rows
@@ -229,8 +257,10 @@ class LlamaAttention(Layer):
                 new_cache = (cache, state)
             else:
                 ck, cv = kv_cache
-                k = k.astype(ck.dtype)
-                v = v.astype(cv.dtype)
+                quant = isinstance(ck, QuantizedKV)
+                if not quant:
+                    k = k.astype(ck.dtype)
+                    v = v.astype(cv.dtype)
                 if per_slot and s > 1:
                     # chunked prefill (contiguous): slot b's chunk lands
                     # at rows cache_index[b]..+s-1; mode="drop" makes
@@ -239,18 +269,45 @@ class LlamaAttention(Layer):
                     rows, kv_mask = _chunk_history_mask(
                         cache_index, s, ck.shape[1])
                     bidx = jnp.arange(b)[:, None]
-                    ck = ck.at[bidx, rows].set(k, mode="drop")
-                    cv = cv.at[bidx, rows].set(v, mode="drop")
+                    if quant:
+                        # quantize-on-append: payload + per-row scales
+                        # scatter together (scale rows share the drop
+                        # semantics of the sentinel rows)
+                        kq, ks = quantize_kv_rows(k)
+                        vq, vs = quantize_kv_rows(v)
+                        ck = QuantizedKV(
+                            ck.q.at[bidx, rows].set(kq, mode="drop"),
+                            ck.scale.at[bidx, rows].set(ks, mode="drop"))
+                        cv = QuantizedKV(
+                            cv.q.at[bidx, rows].set(vq, mode="drop"),
+                            cv.scale.at[bidx, rows].set(vs, mode="drop"))
+                    else:
+                        ck = ck.at[bidx, rows].set(k, mode="drop")
+                        cv = cv.at[bidx, rows].set(v, mode="drop")
                 elif per_slot:
                     # continuous batching: each slot writes at its own
                     # length (s == 1) and masks to its own history
-                    ck = ck.at[jnp.arange(b), cache_index].set(k[:, 0])
-                    cv = cv.at[jnp.arange(b), cache_index].set(v[:, 0])
+                    bi = jnp.arange(b)
+                    if quant:
+                        kq, ks = quantize_kv_rows(k[:, 0])
+                        vq, vs = quantize_kv_rows(v[:, 0])
+                        ck = QuantizedKV(
+                            ck.q.at[bi, cache_index].set(kq),
+                            ck.scale.at[bi, cache_index].set(ks))
+                        cv = QuantizedKV(
+                            cv.q.at[bi, cache_index].set(vq),
+                            cv.scale.at[bi, cache_index].set(vs))
+                    else:
+                        ck = ck.at[bi, cache_index].set(k[:, 0])
+                        cv = cv.at[bi, cache_index].set(v[:, 0])
                     kv_idx = jnp.arange(ck.shape[1])
                     kv_mask = (kv_idx[None, :] <=
                                cache_index[:, None])[:, None, None, :]
                 else:
                     # single shared index: insert current kv block
+                    # (one-shot bucketed prefill — int8 caches never
+                    # reach here: the engine requires chunked prefill
+                    # for them at init)
                     ck = jax.lax.dynamic_update_slice_in_dim(
                         ck, k, cache_index, 1)
                     cv = jax.lax.dynamic_update_slice_in_dim(
@@ -263,7 +320,8 @@ class LlamaAttention(Layer):
                     kv_mask = (kv_idx[None, :] <=
                                q_pos[:, None])[None, None, :, :]
                 out = F.scaled_dot_product_attention(
-                    q, ck, cv, attn_mask=kv_mask, training=False
+                    q, dequantize_kv(ck), dequantize_kv(cv),
+                    attn_mask=kv_mask, training=False
                 )
                 new_cache = (ck, cv)
         else:
@@ -447,6 +505,22 @@ class LlamaForCausalLM(Layer):
     def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
         cfg = self.config
         dtype = dtype or jnp.bfloat16
+        if jnp.dtype(dtype) == jnp.int8:
+            # quantized contiguous caches: int8 payload + per-row f32
+            # dequant scales (see inference.paged.QuantizedKV). Zero
+            # scales dequantize untouched rows to the same zeros a fp
+            # cache starts with.
+            from ..inference.paged import QuantizedKV
+
+            def one():
+                return QuantizedKV(
+                    jnp.zeros((batch_size, max_len,
+                               cfg.num_key_value_heads, cfg.head_dim),
+                              jnp.int8),
+                    jnp.zeros((batch_size, max_len,
+                               cfg.num_key_value_heads), jnp.float32))
+            return [(one(), one())
+                    for _ in range(cfg.num_hidden_layers)]
         return [
             (
                 jnp.zeros((batch_size, max_len, cfg.num_key_value_heads,

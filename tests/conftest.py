@@ -87,6 +87,22 @@ def compile_counter():
     return counter
 
 
+@pytest.fixture
+def serving_flags():
+    """set_flags with restore for the serving knobs the engine suites
+    flip (spec decode, prefix cache, prefill chunking, fused decode,
+    KV/weight dtypes). Shared by test_spec_decode and
+    test_quant_serving — yield the setter, restore on teardown."""
+    from paddle_tpu import flags as F
+
+    keys = ("spec_decode", "prefix_cache", "prefill_chunk",
+            "fused_decode", "kv_cache_dtype", "serve_weight_dtype",
+            "serve_recovery")
+    saved = {k: F.flag(k) for k in keys}
+    yield F.set_flags
+    F.set_flags(saved)
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt

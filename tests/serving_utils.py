@@ -1,0 +1,92 @@
+"""Shared serving-engine test helpers.
+
+The greedy-parity machinery (tiny model + engine config factories, the
+drain loop, the mixed-prompt workload, and THE spec-off-vs-on parity
+comparison) started life in test_spec_decode.py; the quantized-serving
+suite (test_quant_serving.py) runs the same comparisons under int8
+weights / int8 KV pools, so the helpers live here once instead of being
+copy-pasted per suite. Import from test modules as ``import
+serving_utils`` (pytest puts tests/ on sys.path).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.inference.serving import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def tiny_model(seed=0):
+    import paddle_tpu as pt
+
+    pt.seed(seed)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+def tiny_ecfg(paged, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("seq_buckets", (32,))
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("page_size", 8)
+    return EngineConfig(paged=paged, **kw)
+
+
+def drain(eng, step=None):
+    step = step or eng.step
+    while step() or eng._queue or eng.active.any():
+        pass
+
+
+def mixed_prompts(cfg, rng):
+    """Repetitive prompts (drafts fire) + a random one + a ragged short
+    one — and callers add one request whose 1-token budget can NEVER
+    draft (see ``spec_parity_outputs``)."""
+    unit = rng.integers(1, cfg.vocab_size, 4)
+    return [
+        np.concatenate([unit] * 5),                       # periodic
+        rng.integers(1, cfg.vocab_size, 11),              # random
+        np.concatenate([rng.integers(1, cfg.vocab_size, 3), unit, unit]),
+    ]
+
+
+def spec_parity_outputs(model, make_ecfg, prompts, set_flags,
+                        max_new_tokens=24, never_drafts_probe=True,
+                        flags_extra=None):
+    """THE greedy spec-parity comparison: the same workload runs
+    spec-off and spec-ngram (fresh engine per arm, ``make_ecfg()``
+    builds each arm's config), returning ``({mode: outputs},
+    {mode: spec_snapshot})``. ``never_drafts_probe`` appends a 1-token
+    request whose budget leaves no draft headroom. ``flags_extra``
+    merges extra serving flags into each arm (e.g. prefix_cache).
+    Callers restore flags via their ``serving_flags`` fixture."""
+    outs, snaps = {}, {}
+    for mode in ("off", "ngram"):
+        fl = {"spec_decode": mode}
+        if flags_extra:
+            fl.update(flags_extra)
+        set_flags(fl)
+        eng = ContinuousBatchingEngine(model, make_ecfg())
+        reqs = eng.run(prompts, max_new_tokens=max_new_tokens)
+        if never_drafts_probe:
+            reqs += eng.run([prompts[0]], max_new_tokens=1)
+        outs[mode] = [r.output for r in reqs]
+        snaps[mode] = eng.spec_snapshot()
+    return outs, snaps
+
+
+def assert_spec_parity(outs, snaps, require_accepts=True):
+    """Spec-on greedy outputs must be bit-identical to spec-off — and
+    the spec arm must actually have accepted drafts (or the comparison
+    proves nothing), while the off arm must never have verified."""
+    if require_accepts:
+        assert snaps["ngram"]["verify_calls"] > 0
+        assert snaps["ngram"]["accepted"] > 0
+        assert snaps["ngram"]["emitted"] > snaps["ngram"]["verify_calls"]
+    assert snaps["off"]["verify_calls"] == 0
+    assert snaps["off"]["proposed"] == 0
+    assert outs["ngram"] == outs["off"]

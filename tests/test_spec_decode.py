@@ -30,41 +30,20 @@ from paddle_tpu.inference.serving import (
     EngineConfig,
 )
 from paddle_tpu.inference.spec_decode import Drafter, NgramDrafter
-from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+# greedy-parity helpers shared with test_quant_serving (satellite of
+# PR 9: the quant parity tests reuse the same comparison instead of
+# copy-pasting it); serving_flags comes from conftest now
+from serving_utils import (
+    assert_spec_parity,
+    drain as _drain,
+    mixed_prompts as _mixed_prompts,
+    spec_parity_outputs,
+    tiny_ecfg as _ecfg,
+    tiny_model as _model,
+)
 
 pytestmark = pytest.mark.fast
-
-
-def _model(seed=0):
-    import paddle_tpu as pt
-
-    pt.seed(seed)
-    cfg = LlamaConfig.tiny()
-    return LlamaForCausalLM(cfg), cfg
-
-
-@pytest.fixture
-def serving_flags():
-    """set_flags with restore for the serving knobs this file flips."""
-    keys = ("spec_decode", "prefix_cache", "prefill_chunk")
-    saved = {k: F.flag(k) for k in keys}
-    yield F.set_flags
-    F.set_flags(saved)
-
-
-def _ecfg(paged, **kw):
-    kw.setdefault("max_slots", 2)
-    kw.setdefault("max_len", 128)
-    kw.setdefault("seq_buckets", (32,))
-    kw.setdefault("cache_dtype", jnp.float32)
-    kw.setdefault("page_size", 8)
-    return EngineConfig(paged=paged, **kw)
-
-
-def _drain(eng, step=None):
-    step = step or eng.step
-    while step() or eng._queue or eng.active.any():
-        pass
 
 
 # ---------------- n-gram drafter ----------------
@@ -107,17 +86,6 @@ def test_ngram_drafter_validates():
 
 # ---------------- greedy token parity ----------------
 
-def _mixed_prompts(cfg, rng):
-    """Repetitive prompts (drafts fire) + a random one + a ragged short
-    one — and one request whose 1-token budget can NEVER draft."""
-    unit = rng.integers(1, cfg.vocab_size, 4)
-    return [
-        np.concatenate([unit] * 5),                       # periodic
-        rng.integers(1, cfg.vocab_size, 11),              # random
-        np.concatenate([rng.integers(1, cfg.vocab_size, 3), unit, unit]),
-    ]
-
-
 @pytest.mark.parametrize("paged", [False, True])
 @pytest.mark.parametrize("cache_dtype", [jnp.float32, jnp.bfloat16])
 def test_spec_token_parity(paged, cache_dtype, serving_flags):
@@ -125,27 +93,15 @@ def test_spec_token_parity(paged, cache_dtype, serving_flags):
     to spec-off in both cache modes incl. bf16 pools, with the prefix
     cache on, across ragged lengths and non-drafting slots — and the
     spec arm must actually have accepted drafts (or the test proves
-    nothing)."""
+    nothing). The comparison itself lives in serving_utils, shared
+    with the quantized-serving parity suite."""
     model, cfg = _model(3)
     rng = np.random.default_rng(5)
     prompts = _mixed_prompts(cfg, rng)
-
-    outs = {}
-    for mode in ("off", "ngram"):
-        serving_flags({"spec_decode": mode, "prefix_cache": True})
-        eng = ContinuousBatchingEngine(
-            model, _ecfg(paged, cache_dtype=cache_dtype))
-        reqs = eng.run(prompts, max_new_tokens=24)
-        # the never-drafts slot: budget 1 leaves no draft headroom
-        reqs += eng.run([prompts[0]], max_new_tokens=1)
-        outs[mode] = [r.output for r in reqs]
-        snap = eng.spec_snapshot()
-        if mode == "ngram":
-            assert snap["verify_calls"] > 0 and snap["accepted"] > 0
-            assert snap["emitted"] > snap["verify_calls"]  # amortized
-        else:
-            assert snap["verify_calls"] == 0 and snap["proposed"] == 0
-    assert outs["ngram"] == outs["off"]
+    outs, snaps = spec_parity_outputs(
+        model, lambda: _ecfg(paged, cache_dtype=cache_dtype), prompts,
+        serving_flags, flags_extra={"prefix_cache": True})
+    assert_spec_parity(outs, snaps)
 
 
 @pytest.mark.parametrize("paged", [False, True])
