@@ -19,6 +19,24 @@ from . import errors  # noqa: F401
 from . import fft  # noqa: F401
 from . import generation  # noqa: F401
 from . import flags  # noqa: F401
+
+# PT_FLAGS_default_matmul_precision: process-wide jax matmul precision
+# override, applied once at import (first-use time, like the registry's
+# xla_* passthrough); empty = jax's own default (bf16 on the MXU)
+_mmp = flags.flag("default_matmul_precision")
+if _mmp:
+    import jax as _jax_cfg
+
+    try:
+        _jax_cfg.config.update("jax_default_matmul_precision",
+                               str(_mmp))
+    except Exception as _e:
+        raise ValueError(
+            f"PT_FLAGS_default_matmul_precision={_mmp!r} is not a "
+            "valid jax matmul precision (use bfloat16|tensorfloat32|"
+            "float32|highest, or empty for the default)") from _e
+    del _jax_cfg
+del _mmp
 from . import incubate  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401

@@ -1,0 +1,23 @@
+"""Static analysis + runtime invariant checking for the serving stack.
+
+Two halves of one correctness story:
+
+* :mod:`paddle_tpu.analysis.lint` — **ptlint**, an AST-based static
+  lint (``python -m paddle_tpu.analysis.lint <paths>`` or the
+  ``ptlint`` console entry) with rule families tuned to this codebase:
+  trace-safety (TS), determinism (DT), flags hygiene (FL) and
+  concurrency copy-on-read (CC). Catches the recompile hazards,
+  host-sync leaks and scrape races *before* runtime that earlier PRs
+  only caught by observation. The analysis engine is stdlib-``ast``
+  only (importing :mod:`.lint`/:mod:`.rules` directly pulls in no
+  jax; the ``-m``/console launches import the parent package once).
+
+* :mod:`paddle_tpu.analysis.sanitizer` — a runtime invariant checker
+  behind ``PT_FLAGS_sanitize`` (off = one identity check per hook
+  site, the telemetry-off pattern): per-tick page/refcount
+  conservation, slot-heap + block-table + scale-pool shape agreement,
+  seq_len bounds, and a thread-ownership checker for scrape-thread
+  reads. The chaos lane (``pytest -m chaos``) runs with it on.
+"""
+
+from .sanitizer import EngineSanitizer, SanitizerError  # noqa: F401

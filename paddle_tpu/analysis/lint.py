@@ -1,0 +1,305 @@
+"""ptlint — trace-safety / determinism / flags-hygiene / concurrency
+static analysis for the paddle_tpu serving stack.
+
+Usage::
+
+    python -m paddle_tpu.analysis.lint paddle_tpu tests benchmarks
+    ptlint paddle_tpu tests benchmarks          # console entry
+    python -m paddle_tpu.analysis.lint --rules  # list rule families
+
+Exit status: 0 when the scan is clean (after the committed baseline is
+applied), 1 on any new violation, 2 on usage errors. The analysis
+engine is pure stdlib ``ast`` — THIS module imports no jax and the
+scan itself takes milliseconds; note the ``-m`` / console-entry
+launches still import the parent ``paddle_tpu`` package (and thus
+jax) once at startup.
+
+**Suppressions** (use sparingly; ``paddle_tpu/inference`` and
+``paddle_tpu/kernels`` are contractually suppression-free, enforced by
+``tests/test_lint_clean.py``). Append a trailing comment of the form
+``ptlint: disable=<RULE>`` (comma-separate several rule ids, e.g.
+``disable=<RULEA>,<RULEB>``) to the flagged line; a whole module opts
+out with ``ptlint: skip-file`` in its first 5 lines.
+
+**Baseline**: ``.ptlint-baseline.json`` at the repo root records
+accepted pre-existing violations as ``{"file::RULE": count}``; the
+linter only fails on violations beyond it (diff-friendly: counts, not
+line numbers). Regenerate with ``--write-baseline`` — but prefer
+fixing the finding; the committed baseline is empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .rules import ALL_RULES, RULE_DOCS, Project, Violation
+
+BASELINE_NAME = ".ptlint-baseline.json"
+_SUPPRESS_RE = re.compile(r"#\s*ptlint:\s*disable=([A-Z]{2}\d{3}"
+                          r"(?:\s*,\s*[A-Z]{2}\d{3})*)")
+_SKIP_FILE_RE = re.compile(r"#\s*ptlint:\s*skip-file")
+
+
+@dataclass
+class Suppression:
+    file: str
+    line: int
+    rules: Tuple[str, ...]  # () == skip-file
+
+
+@dataclass
+class ScanResult:
+    violations: List[Violation]
+    suppressions: List[Suppression]
+    suppressed: List[Violation]
+    files: int
+
+
+def find_root(start: str) -> str:
+    """Nearest ancestor carrying pyproject.toml (fallback: start)."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def iter_py_files(paths: Sequence[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith(".") and d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _parse_suppressions(src: str, relpath: str) -> List[Suppression]:
+    out: List[Suppression] = []
+    lines = src.splitlines()
+    for i, line in enumerate(lines[:5], start=1):
+        if _SKIP_FILE_RE.search(line):
+            return [Suppression(relpath, i, ())]
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = tuple(r.strip() for r in m.group(1).split(","))
+            out.append(Suppression(relpath, i, rules))
+    return out
+
+
+def scan(paths: Sequence[str], root: Optional[str] = None) -> ScanResult:
+    """Run every rule over ``paths``; returns violations with
+    suppressions already applied (they land in ``suppressed``)."""
+    root = root or find_root(paths[0] if paths else ".")
+    project = Project(root)
+    violations: List[Violation] = []
+    suppressions: List[Suppression] = []
+    suppressed: List[Violation] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        relpath = os.path.relpath(os.path.abspath(path), root) \
+            .replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as e:
+            violations.append(Violation(
+                relpath, getattr(e, "lineno", 1) or 1, "XX001",
+                f"unparseable module: {e}"))
+            continue
+        n_files += 1
+        sups = _parse_suppressions(src, relpath)
+        suppressions.extend(sups)
+        skip_all = any(s.rules == () for s in sups)
+        per_line: Dict[int, Tuple[str, ...]] = {
+            s.line: s.rules for s in sups if s.rules}
+        for rule in ALL_RULES:
+            if not rule.applies(relpath):
+                continue
+            for v in rule.check_module(project, tree, src, relpath):
+                v.file = v.file or relpath
+                if skip_all or v.rule in per_line.get(v.line, ()):
+                    suppressed.append(v)
+                else:
+                    violations.append(v)
+    for rule in ALL_RULES:
+        for v in rule.check_project(project):
+            # project-level findings anchor to real files too;
+            # line-level suppressions apply the same way
+            sup = next(
+                (s for s in suppressions
+                 if s.file == v.file
+                 and (s.rules == () or
+                      (s.line == v.line and v.rule in s.rules))),
+                None)
+            (suppressed if sup else violations).append(v)
+    # dedup: taint analysis walks loop bodies twice (loop-carried
+    # state), which can report one site twice
+    seen = set()
+    unique = []
+    for v in sorted(violations,
+                    key=lambda v: (v.file, v.line, v.rule)):
+        k = (v.file, v.line, v.rule, v.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(v)
+    return ScanResult(unique, suppressions, suppressed, n_files)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def load_baseline(path: str) -> Dict[str, int]:
+    """Missing file = empty baseline; a PRESENT but malformed file is
+    a loud, clearly-attributed error (a merge-conflict marker in the
+    baseline must not read as a lint crash — or worse, pass)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return {str(k): int(v)
+                for k, v in data.get("entries", {}).items()}
+    except OSError:
+        return {}
+    except (ValueError, TypeError, AttributeError) as e:
+        raise ValueError(
+            f"invalid ptlint baseline file {path}: {e} — fix it or "
+            "regenerate with --write-baseline") from e
+
+
+def apply_baseline(violations: List[Violation],
+                   baseline: Dict[str, int]
+                   ) -> Tuple[List[Violation], List[Violation]]:
+    """(new, accepted): per (file, rule) pair the first ``count``
+    violations are accepted, the rest are new."""
+    budget = dict(baseline)
+    new: List[Violation] = []
+    accepted: List[Violation] = []
+    for v in violations:
+        if budget.get(v.key(), 0) > 0:
+            budget[v.key()] -= 1
+            accepted.append(v)
+        else:
+            new.append(v)
+    return new, accepted
+
+
+def write_baseline(path: str, violations: List[Violation]):
+    entries: Dict[str, int] = {}
+    for v in violations:
+        entries[v.key()] = entries.get(v.key(), 0) + 1
+    payload = {
+        "comment": ("ptlint accepted pre-existing violations; "
+                    "entries under paddle_tpu/inference/ and "
+                    "paddle_tpu/kernels/ are FORBIDDEN "
+                    "(tests/test_lint_clean.py)"),
+        "entries": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptlint",
+        description="paddle_tpu static analysis: trace-safety, "
+                    "determinism, flags hygiene, concurrency")
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: nearest pyproject.toml)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME}"
+                         " when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, baseline ignored")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current violations into the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--rules", action="store_true", dest="list_rules",
+                    help="list rule ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, doc in sorted(RULE_DOCS.items()):
+            print(f"{rid}  {doc}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not read as a vacuously clean scan
+        print(f"ptlint: no such file or directory: {missing}",
+              file=sys.stderr)
+        return 2
+
+    root = args.root or find_root(args.paths[0])
+    result = scan(args.paths, root)
+    if result.files == 0:
+        # existing-but-python-free paths must not read as a
+        # vacuously clean scan either
+        print("ptlint: no Python files found under "
+              f"{list(args.paths)}", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    try:
+        baseline = {} if args.no_baseline \
+            else load_baseline(baseline_path)
+    except ValueError as e:
+        print(f"ptlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(baseline_path, result.violations)
+        print(f"ptlint: wrote {len(result.violations)} accepted "
+              f"violation(s) to {baseline_path}")
+        return 0
+
+    new, accepted = apply_baseline(result.violations, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.files,
+            "violations": [v.__dict__ for v in new],
+            "baselined": [v.__dict__ for v in accepted],
+            "suppressions": [s.__dict__ for s in result.suppressions],
+        }, indent=2, default=list))
+        return 1 if new else 0
+
+    for v in new:
+        print(f"{v.file}:{v.line}: {v.rule} {v.message}")
+    n_sup = len(result.suppressions)
+    tail = []
+    if accepted:
+        tail.append(f"{len(accepted)} baselined")
+    if n_sup:
+        tail.append(f"{n_sup} suppression(s)")
+    extra = f" ({', '.join(tail)})" if tail else ""
+    print(f"ptlint: {result.files} file(s), {len(new)} "
+          f"violation(s){extra}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

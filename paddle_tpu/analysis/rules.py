@@ -1,0 +1,863 @@
+"""ptlint rule implementations (stdlib-only: ``ast`` + regex).
+
+Four rule families, each mechanizing a class of review finding this
+codebase has already paid for at runtime (see ISSUE/CHANGES history —
+the slo_snapshot scrape race, the `_pool_blocked` visibility gap, the
+coordinated-omission TTFT fix were all findable by these rules):
+
+* **TS — trace safety.** Host syncs and Python control flow on traced
+  values inside directly-jitted program bodies, jit wrappers built
+  inside loops (each ``jax.jit`` object owns its own compile cache — a
+  fresh wrapper per iteration recompiles every time), and — in modules
+  that carry a ``TRACE_COUNTS`` compile-accounting counter — jitted
+  program bodies that fail to register a name in it (a blind spot for
+  the tests' compile-count guards).
+
+* **DT — determinism.** The crash-recovery replay and spec-verify
+  paths promise bit-identical outputs; unseeded randomness and
+  wall-clock reads in ``paddle_tpu/inference`` / ``paddle_tpu/kernels``
+  are how that promise quietly breaks. ``time.perf_counter`` (latency
+  measurement, never a decision input) stays allowed; ``time.time``
+  does not — artifact timestamps belong to the flight recorder.
+
+* **FL — flags hygiene.** Every ``flag("x")`` / ``get_flags`` /
+  ``set_flags`` literal must resolve against the canonical registry
+  (``flags.py`` plus any ``define_flag`` call site, e.g.
+  ``nn/layout.py``); every defined flag must be read somewhere outside
+  ``tests/`` (else it is dead weight) and documented in README's flags
+  tables.
+
+* **CC — concurrency (copy-on-read).** Engine host structures are
+  scheduler-owned. Reader methods the metrics/scrape thread may call
+  (``*_snapshot`` / ``snapshot`` / ``backpressure`` / ``_tel_state``)
+  must iterate *copies* — ``list(x.items())`` is the blessed marker —
+  and must not mutate scheduler state, directly or one self-call
+  level down. The runtime side of the same contract lives in
+  ``analysis/sanitizer.py`` (thread-ownership checker).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# shared plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Violation:
+    file: str  # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> str:
+        return f"{self.file}::{self.rule}"
+
+
+class Project:
+    """Cross-file scan state: the flag registry view and read/write
+    sites accumulate here module by module; project-level rules
+    (FL001-FL003) run once after every module has been scanned."""
+
+    def __init__(self, root: str):
+        self.root = root
+        # flag name -> (file, line) of its define_flag site
+        self.flag_defs: Dict[str, Tuple[str, int]] = {}
+        # flag name -> [(file, line)] of flag()/get_flags reads
+        self.flag_reads: Dict[str, List[Tuple[str, int]]] = {}
+        # flag name -> [(file, line)] of set_flags writes
+        self.flag_writes: Dict[str, List[Tuple[str, int]]] = {}
+        self.saw_registry_module = False
+
+    def readme_text(self) -> str:
+        path = os.path.join(self.root, "README.md")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+
+def _iter_with_parents(tree: ast.AST):
+    """Yield (node, parents tuple) in document order."""
+    stack = [(tree, ())]
+    while stack:
+        node, parents = stack.pop()
+        yield node, parents
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            stack.append((child, parents + (node,)))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Imports:
+    """Module-level alias map for jax / jax.jit / functools.partial."""
+
+    def __init__(self, tree: ast.Module):
+        self.jax: Set[str] = set()
+        self.jit: Set[str] = set()
+        self.partial: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        self.jax.add(a.asname or "jax")
+                    if a.name == "functools":
+                        pass
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "jit":
+                            self.jit.add(a.asname or "jit")
+                if node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial.add(a.asname or "partial")
+
+    def is_jax_jit(self, func: ast.expr) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.jit
+        if isinstance(func, ast.Attribute) and func.attr == "jit":
+            v = func.value
+            return isinstance(v, ast.Name) and v.id in (self.jax | {"jax"})
+        return False
+
+
+def _jit_static_names(call: Optional[ast.Call],
+                      fd: ast.FunctionDef) -> Optional[Set[str]]:
+    """Param names a jit spec marks static. None = spec unparseable
+    (the caller then skips control-flow checks to avoid noise)."""
+    params = [a.arg for a in fd.args.posonlyargs + fd.args.args]
+    static: Set[str] = set()
+    if call is None:
+        return static
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = []
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.append(e.value)
+                else:
+                    return None
+            for i in nums:
+                if 0 <= i < len(params):
+                    static.add(params[i])
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                s = _const_str(e)
+                if s is None:
+                    return None
+                static.add(s)
+    return static
+
+
+def _collect_jitted(tree: ast.Module, imports: _Imports):
+    """Directly-jitted function bodies: ``jax.jit(fn, ...)`` over a
+    local ``def fn``, and ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    decorated defs. Returns [(funcdef, static_names_or_None,
+    jit_call_line)]."""
+    out = []
+    seen: Set[int] = set()
+    # decorator forms
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            call = None
+            if imports.is_jax_jit(dec):
+                pass
+            elif isinstance(dec, ast.Call) and imports.is_jax_jit(dec.func):
+                call = dec
+            elif (isinstance(dec, ast.Call)
+                  and isinstance(dec.func, ast.Name)
+                  and dec.func.id in imports.partial
+                  and dec.args and imports.is_jax_jit(dec.args[0])):
+                call = dec
+            else:
+                continue
+            if id(node) not in seen:
+                seen.add(id(node))
+                out.append((node, _jit_static_names(call, node),
+                            node.lineno))
+    # jax.jit(fn, ...) over a local def: resolve fn through enclosing
+    # scopes, innermost first
+    for node, parents in _iter_with_parents(tree):
+        if not (isinstance(node, ast.Call)
+                and imports.is_jax_jit(node.func) and node.args):
+            continue
+        target = node.args[0]
+        if not isinstance(target, ast.Name):
+            continue
+        scopes = [p for p in parents
+                  if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Module))]
+        fd = None
+        for scope in reversed(scopes):
+            for child in ast.walk(scope):
+                if isinstance(child, ast.FunctionDef) \
+                        and child.name == target.id:
+                    fd = child
+                    break
+            if fd is not None:
+                break
+        if fd is not None and id(fd) not in seen:
+            seen.add(id(fd))
+            out.append((fd, _jit_static_names(node, fd), node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule base
+# ---------------------------------------------------------------------------
+class Rule:
+    id = "XX000"
+    #: one-line description printed by ``lint --rules``
+    doc = ""
+
+    def applies(self, relpath: str) -> bool:  # noqa: ARG002
+        return True
+
+    def check_module(self, project: Project, tree: ast.Module, src: str,
+                     relpath: str) -> List[Violation]:  # noqa: ARG002
+        return []
+
+    def check_project(self, project: Project) -> List[Violation]:  # noqa: ARG002
+        return []
+
+
+def _in(relpath: str, *prefixes: str) -> bool:
+    return any(relpath == p or relpath.startswith(p + "/")
+               for p in prefixes)
+
+
+# ---------------------------------------------------------------------------
+# TS — trace safety
+# ---------------------------------------------------------------------------
+class TS001HostSyncInJit(Rule):
+    id = "TS001"
+    doc = ("host sync / Python control flow on a traced value inside a "
+           "directly-jitted program body")
+
+    _NP = {"np", "numpy"}
+    _CASTS = {"float", "int", "bool"}
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        imports = _Imports(tree)
+        out = []
+        for fd, static, _line in _collect_jitted(tree, imports):
+            params = {a.arg for a in fd.args.posonlyargs + fd.args.args
+                      + fd.args.kwonlyargs}
+            traced = params - (static or set())
+            out.extend(self._scan(fd, traced,
+                                  control_flow=static is not None))
+        return out
+
+    def _scan(self, fd: ast.FunctionDef, traced: Set[str],
+              control_flow: bool) -> List[Violation]:
+        out: List[Violation] = []
+
+        def visit(node, names: Set[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    shadow = {a.arg for a in child.args.posonlyargs
+                              + child.args.args + child.args.kwonlyargs}
+                    visit(child, names - shadow)
+                    continue
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    if isinstance(f, ast.Attribute) and f.attr == "item" \
+                            and _names_in(f.value) & names:
+                        out.append(self._v(
+                            child, "`.item()` on a traced value "
+                            "forces a host sync at trace time"))
+                    elif (isinstance(f, ast.Name)
+                          and f.id in self._CASTS and child.args
+                          and _names_in(child.args[0]) & names):
+                        out.append(self._v(
+                            child, f"`{f.id}()` cast on a traced value "
+                            "forces a host sync (keep it in jnp, or "
+                            "mark the argument static)"))
+                    elif (isinstance(f, ast.Attribute)
+                          and f.attr in ("asarray", "array")
+                          and isinstance(f.value, ast.Name)
+                          and f.value.id in self._NP and child.args
+                          and _names_in(child.args[0]) & names):
+                        out.append(self._v(
+                            child, f"`{f.value.id}.{f.attr}()` on a "
+                            "traced value materializes it on the host"))
+                elif (control_flow
+                      and isinstance(child, (ast.If, ast.While))
+                      and _names_in(child.test) & names):
+                    kind = "if" if isinstance(child, ast.If) else "while"
+                    out.append(self._v(
+                        child, f"Python `{kind}` on traced argument(s) "
+                        f"{sorted(_names_in(child.test) & names)} — use "
+                        "jnp.where/lax.cond, or mark the arg static"))
+                visit(child, names)
+
+        for stmt in fd.body:
+            visit(stmt, traced)
+            # top-level statements themselves (visit only descends)
+            if control_flow and isinstance(stmt, (ast.If, ast.While)) \
+                    and _names_in(stmt.test) & traced:
+                out.append(self._v(
+                    stmt, "Python control flow on traced argument(s) "
+                    f"{sorted(_names_in(stmt.test) & traced)}"))
+        return out
+
+    def _v(self, node, msg):
+        return Violation("", node.lineno, self.id, msg)
+
+
+class TS002TraceCountRegistration(Rule):
+    id = "TS002"
+    doc = ("in modules carrying a TRACE_COUNTS compile counter, every "
+           "directly-jitted program body must register a name in it")
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        has_counter = any(
+            isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "TRACE_COUNTS"
+                for t in n.targets)
+            or (isinstance(n, ast.AnnAssign)
+                and isinstance(n.target, ast.Name)
+                and n.target.id == "TRACE_COUNTS")
+            for n in tree.body)
+        if not has_counter:
+            return []
+        imports = _Imports(tree)
+        out = []
+        for fd, _static, line in _collect_jitted(tree, imports):
+            registers = any(
+                isinstance(n, ast.AugAssign)
+                and isinstance(n.target, ast.Subscript)
+                and isinstance(n.target.value, ast.Name)
+                and n.target.value.id == "TRACE_COUNTS"
+                for n in ast.walk(fd))
+            if not registers:
+                out.append(Violation(
+                    "", fd.lineno, self.id,
+                    f"jitted program `{fd.name}` (jit at line {line}) "
+                    "does not bump a TRACE_COUNTS name — the compile-"
+                    "count guard cannot see its specializations"))
+        return out
+
+
+class TS003JitInLoop(Rule):
+    id = "TS003"
+    doc = ("jax.jit wrapper constructed inside a loop — every fresh "
+           "wrapper owns a fresh compile cache (recompile hazard). "
+           "Product code only: bench/test sweeps recompile by design")
+
+    def applies(self, relpath):
+        return _in(relpath, "paddle_tpu")
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        imports = _Imports(tree)
+        out = []
+        for node, parents in _iter_with_parents(tree):
+            if isinstance(node, ast.Call) and imports.is_jax_jit(node.func):
+                if any(isinstance(p, (ast.For, ast.While))
+                       for p in parents):
+                    out.append(Violation(
+                        "", node.lineno, self.id,
+                        "jax.jit(...) inside a loop builds a new "
+                        "wrapper (and compile cache) per iteration — "
+                        "hoist it out and reuse one wrapper"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# DT — determinism (replay / spec-verify paths)
+# ---------------------------------------------------------------------------
+_DT_SCOPE = ("paddle_tpu/inference", "paddle_tpu/kernels")
+
+
+class DT001StdlibRandom(Rule):
+    id = "DT001"
+    doc = ("stdlib `random` in the serving/kernel paths — replay "
+           "promises bit-identical outputs; use a seeded "
+           "np.random.default_rng stream")
+
+    def applies(self, relpath):
+        return _in(relpath, *_DT_SCOPE)
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "random" or a.name.startswith("random."):
+                        out.append(Violation(
+                            "", node.lineno, self.id,
+                            "stdlib `random` is process-global state — "
+                            "deterministic replay needs a seeded "
+                            "per-site Generator"))
+            elif isinstance(node, ast.ImportFrom) and \
+                    node.module == "random":
+                out.append(Violation(
+                    "", node.lineno, self.id,
+                    "stdlib `random` import in a deterministic path"))
+        return out
+
+
+class DT002GlobalNumpyRandom(Rule):
+    id = "DT002"
+    doc = ("global-state numpy randomness in the serving/kernel paths "
+           "(np.random.<fn>) — use np.random.default_rng(seed)")
+
+    _BAD = {"seed", "rand", "randn", "random", "randint", "choice",
+            "shuffle", "permutation", "random_sample", "standard_normal",
+            "uniform", "normal", "get_state", "set_state"}
+
+    def applies(self, relpath):
+        return _in(relpath, *_DT_SCOPE)
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        out = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in self._BAD
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "random"
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id in ("np", "numpy")):
+                out.append(Violation(
+                    "", node.lineno, self.id,
+                    f"np.random.{node.attr} draws from process-global "
+                    "RNG state — replay determinism needs a seeded "
+                    "default_rng stream"))
+        return out
+
+
+class DT003WallClock(Rule):
+    id = "DT003"
+    doc = ("time.time() in the serving engine — scheduling/replay code "
+           "uses perf_counter; wall-clock stamps belong to the "
+           "recorder's dump path")
+
+    def applies(self, relpath):
+        return _in(relpath, "paddle_tpu/inference")
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src, relpath
+        out = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "time"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"):
+                out.append(Violation(
+                    "", node.lineno, self.id,
+                    "time.time() is wall clock (NTP steps, DST): "
+                    "durations/deadlines must use time.perf_counter; "
+                    "artifact timestamps are the FlightRecorder's job"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# FL — flags hygiene
+# ---------------------------------------------------------------------------
+class FlagsHygiene(Rule):
+    """Collector + three project-level verdicts (FL001/FL002/FL003).
+
+    The canonical registry is ``paddle_tpu/flags.py`` (the satellite
+    contract: ``flags.registry()`` exposes it at runtime) plus any
+    other ``define_flag`` call site (e.g. ``nn/layout.py``) — both are
+    gathered by the same AST scan, so the lint needs no imports."""
+
+    id = "FL001"
+    doc = ("flag reads must resolve in the registry; defined flags "
+           "must be read outside tests (FL002) and documented in "
+           "README's flags tables (FL003)")
+
+    @staticmethod
+    def _in_raises(parents) -> bool:
+        """True inside a ``with pytest.raises(...)`` block — a flag
+        name that is *supposed* to be unknown (negative test) is not a
+        hygiene finding."""
+        for p in parents:
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    c = item.context_expr
+                    if isinstance(c, ast.Call):
+                        f = c.func
+                        name = f.attr if isinstance(f, ast.Attribute) \
+                            else getattr(f, "id", "")
+                        if name == "raises":
+                            return True
+        return False
+
+    def check_module(self, project, tree, src, relpath):
+        del src
+        if relpath == "paddle_tpu/flags.py":
+            project.saw_registry_module = True
+        for node, parents in _iter_with_parents(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._in_raises(parents):
+                continue
+            f = node.func
+            fname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            site = (relpath, node.lineno)
+            if fname == "define_flag" and node.args:
+                name = _const_str(node.args[0])
+                if name is not None and name not in project.flag_defs:
+                    project.flag_defs[name] = site
+            elif fname == "flag" and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    project.flag_reads.setdefault(name, []).append(site)
+            elif fname == "get_flags" and node.args:
+                arg = node.args[0]
+                elts = (arg.elts if isinstance(arg, (ast.List, ast.Tuple))
+                        else [arg])
+                for e in elts:
+                    s = _const_str(e)
+                    if s is not None:
+                        key = s.removeprefix("FLAGS_")
+                        project.flag_reads.setdefault(key, []) \
+                            .append(site)
+            elif fname == "set_flags" and node.args \
+                    and isinstance(node.args[0], ast.Dict):
+                for k in node.args[0].keys:
+                    s = _const_str(k)
+                    if s is not None:
+                        key = s.removeprefix("FLAGS_")
+                        project.flag_writes.setdefault(key, []) \
+                            .append(site)
+        return []
+
+    def check_project(self, project):
+        if not project.saw_registry_module:
+            # partial scan (e.g. `lint tests/`): resolution/deadness
+            # verdicts would all be noise without the registry in view
+            return []
+        out: List[Violation] = []
+        for name, sites in sorted(project.flag_reads.items()):
+            if name not in project.flag_defs:
+                f, ln = sites[0]
+                out.append(Violation(
+                    f, ln, "FL001",
+                    f"flag {name!r} is read but never defined — it "
+                    "does not resolve in the registry (flags.py / any "
+                    "define_flag site)"))
+        for name, sites in sorted(project.flag_writes.items()):
+            if name not in project.flag_defs:
+                f, ln = sites[0]
+                out.append(Violation(
+                    f, ln, "FL001",
+                    f"set_flags writes unknown flag {name!r} (would "
+                    "raise KeyError at runtime)"))
+        readme = project.readme_text()
+        for name, (f, ln) in sorted(project.flag_defs.items()):
+            live = [s for s in project.flag_reads.get(name, ())
+                    if not s[0].startswith("tests/")]
+            if not live:
+                out.append(Violation(
+                    f, ln, "FL002",
+                    f"dead flag {name!r}: defined but never read "
+                    "outside tests/ — wire it or remove it"))
+            if f"`{name}`" not in readme \
+                    and f"PT_FLAGS_{name}" not in readme:
+                out.append(Violation(
+                    f, ln, "FL003",
+                    f"flag {name!r} missing from README's flags "
+                    "tables (document as `" + name + "` or "
+                    f"PT_FLAGS_{name})"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# CC — concurrency: copy-on-read snapshots, scheduler-owned mutation
+# ---------------------------------------------------------------------------
+_FRESH, _SHALLOW, _TAINTED = 0, 1, 2
+_COPY_FUNCS = {"list", "tuple", "sorted", "set", "frozenset"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "remove", "clear",
+             "move_to_end", "update", "add", "discard", "setdefault",
+             "sort", "reverse", "rotate"}
+_VIEW_ATTRS = {"items", "keys", "values", "get"}
+
+
+class CC001CopyOnRead(Rule):
+    id = "CC001"
+    doc = ("scrape-thread reader methods (snapshot/backpressure) must "
+           "iterate copies of scheduler-owned structures — wrap in "
+           "list(...) (CC001) and never mutate them (CC002)")
+
+    _READER_NAMES = {"backpressure", "_tel_state", "snapshot"}
+
+    def applies(self, relpath):
+        return _in(relpath, "paddle_tpu/inference")
+
+    def _is_reader(self, name: str) -> bool:
+        return name in self._READER_NAMES or name.endswith("_snapshot")
+
+    def check_module(self, project, tree, src, relpath):
+        del project, src
+        out: List[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = {n.name: n for n in node.body
+                       if isinstance(n, ast.FunctionDef)}
+            # sanitizer-bearing classes (the engine): every reader
+            # must carry its runtime thread-ownership hook, so the
+            # static rule guarantees the hook and the sanitizer's
+            # SAFE_READS registration check can actually fire (CC003)
+            sanitized_class = any(
+                isinstance(n, ast.Attribute) and n.attr == "_san"
+                for m in methods.values() for n in ast.walk(m))
+            for name, fd in methods.items():
+                if not self._is_reader(name):
+                    continue
+                if sanitized_class and not self._has_check_read(fd, name):
+                    out.append(Violation(
+                        relpath, fd.lineno, "CC003",
+                        f"reader `{name}` lacks its sanitizer hook — "
+                        f"call self._san.check_read({name!r}) (guarded "
+                        "by `is not None`) so a foreign-thread caller "
+                        "is checked against SAFE_READS at runtime"))
+                out.extend(self._check_fn(fd, name))
+                # one level of self-call expansion: a reader leaning on
+                # a helper inherits the helper's races
+                for callee in self._self_calls(fd):
+                    sub = methods.get(callee)
+                    if sub is not None and not self._is_reader(callee):
+                        out.extend(self._check_fn(
+                            sub, f"{callee} (called from reader "
+                            f"{name})"))
+        return out
+
+    @staticmethod
+    def _has_check_read(fd: ast.FunctionDef, name: str) -> bool:
+        for n in ast.walk(fd):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "check_read" and n.args
+                    and _const_str(n.args[0]) == name):
+                return True
+        return False
+
+    def _self_calls(self, fd: ast.FunctionDef) -> Set[str]:
+        out = set()
+        for n in ast.walk(fd):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self"):
+                out.add(n.func.attr)
+        return out
+
+    # -------- taint machine --------
+    def _check_fn(self, fd: ast.FunctionDef, ctx: str) -> List[Violation]:
+        out: List[Violation] = []
+        env: Dict[str, int] = {}
+
+        def state(expr) -> int:
+            if isinstance(expr, ast.Name):
+                if expr.id == "self":
+                    return _TAINTED
+                return env.get(expr.id, _FRESH)
+            if isinstance(expr, (ast.Attribute, ast.Subscript)):
+                return _TAINTED if state(expr.value) >= _SHALLOW \
+                    else _FRESH
+            if isinstance(expr, ast.Call):
+                f = expr.func
+                arg_states = [state(a) for a in expr.args] + \
+                    [state(k.value) for k in expr.keywords]
+                if isinstance(f, ast.Name) and f.id in _COPY_FUNCS \
+                        | {"dict"}:
+                    return _SHALLOW if any(
+                        s >= _SHALLOW for s in arg_states) else _FRESH
+                if isinstance(f, ast.Attribute):
+                    recv = state(f.value)
+                    if f.attr in _VIEW_ATTRS:
+                        # dict views / .get alias the live interior
+                        return _TAINTED if recv >= _SHALLOW else _FRESH
+                    # other method results: computed values, fresh-ish
+                    if recv >= _SHALLOW or any(
+                            s >= _SHALLOW for s in arg_states):
+                        return _SHALLOW
+                    return _FRESH
+                return _SHALLOW if any(
+                    s >= _SHALLOW for s in arg_states) else _FRESH
+            if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                return _SHALLOW
+            if isinstance(expr, ast.IfExp):
+                return max(state(expr.body), state(expr.orelse))
+            if isinstance(expr, (ast.Dict, ast.List, ast.Tuple, ast.Set,
+                                 ast.Constant, ast.BinOp, ast.BoolOp,
+                                 ast.Compare, ast.UnaryOp, ast.JoinedStr)):
+                return _FRESH
+            return _FRESH
+
+        def root_state(target) -> int:
+            node = target
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                node = node.value
+            return state(node)
+
+        def check_iter(it, line_node):
+            if state(it) == _TAINTED:
+                out.append(Violation(
+                    "", line_node.lineno, "CC001",
+                    f"reader `{ctx}` iterates live scheduler state — "
+                    "snapshot it first (the copy-on-read pattern: "
+                    "`list(x.items())`)"))
+
+        def check_expr(expr):
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Name) and f.id == "dict" \
+                            and n.args and state(n.args[0]) == _TAINTED:
+                        out.append(Violation(
+                            "", n.lineno, "CC001",
+                            f"reader `{ctx}` copies a live dict with "
+                            "dict(...) — iterate a list() copy instead "
+                            "(`{k: v for k, v in list(x.items())}`)"))
+                    elif isinstance(f, ast.Attribute) \
+                            and f.attr in _MUTATORS \
+                            and state(f.value) == _TAINTED:
+                        out.append(Violation(
+                            "", n.lineno, "CC002",
+                            f"reader `{ctx}` mutates scheduler-owned "
+                            f"state (.{f.attr}) — readers must be "
+                            "pure; mutation belongs to engine methods "
+                            "on the scheduler thread"))
+                elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                    ast.DictComp, ast.GeneratorExp)):
+                    for gen in n.generators:
+                        check_iter(gen.iter, n)
+
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # nested defs: separate execution context
+                if isinstance(stmt, ast.Assign):
+                    check_expr(stmt.value)
+                    val = state(stmt.value)
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            env[t.id] = val
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            inner = _TAINTED if val == _TAINTED else (
+                                _TAINTED if val == _SHALLOW else _FRESH)
+                            for e in t.elts:
+                                if isinstance(e, ast.Name):
+                                    env[e.id] = inner
+                        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                            if root_state(t) == _TAINTED:
+                                out.append(Violation(
+                                    "", stmt.lineno, "CC002",
+                                    f"reader `{ctx}` writes scheduler-"
+                                    "owned state — readers must be "
+                                    "pure"))
+                elif isinstance(stmt, ast.AugAssign):
+                    check_expr(stmt.value)
+                    if isinstance(stmt.target,
+                                  (ast.Attribute, ast.Subscript)) \
+                            and root_state(stmt.target) == _TAINTED:
+                        out.append(Violation(
+                            "", stmt.lineno, "CC002",
+                            f"reader `{ctx}` mutates scheduler-owned "
+                            "state in place"))
+                elif isinstance(stmt, ast.For):
+                    check_expr(stmt.iter)
+                    check_iter(stmt.iter, stmt)
+                    it = state(stmt.iter)
+                    inner = _TAINTED if it >= _SHALLOW else _FRESH
+                    for e in ast.walk(stmt.target):
+                        if isinstance(e, ast.Name):
+                            env[e.id] = inner
+                    walk(stmt.body)
+                    walk(stmt.body)  # loop-carried taint: second pass
+                    walk(stmt.orelse)
+                elif isinstance(stmt, ast.While):
+                    check_expr(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, ast.If):
+                    check_expr(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.Expr, ast.Return)):
+                    if stmt.value is not None:
+                        check_expr(stmt.value)
+                elif isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        check_expr(item.context_expr)
+                    walk(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for h in stmt.handlers:
+                        walk(h.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    check_expr(stmt.value)
+                    if isinstance(stmt.target, ast.Name):
+                        env[stmt.target.id] = state(stmt.value)
+
+        walk(fd.body)
+        return out
+
+
+ALL_RULES: Sequence[Rule] = (
+    TS001HostSyncInJit(),
+    TS002TraceCountRegistration(),
+    TS003JitInLoop(),
+    DT001StdlibRandom(),
+    DT002GlobalNumpyRandom(),
+    DT003WallClock(),
+    FlagsHygiene(),
+    CC001CopyOnRead(),
+)
+
+RULE_DOCS: Dict[str, str] = {
+    "TS001": TS001HostSyncInJit.doc,
+    "TS002": TS002TraceCountRegistration.doc,
+    "TS003": TS003JitInLoop.doc,
+    "DT001": DT001StdlibRandom.doc,
+    "DT002": DT002GlobalNumpyRandom.doc,
+    "DT003": DT003WallClock.doc,
+    "FL001": "flag reads/writes must resolve in the canonical registry",
+    "FL002": "defined flags must be read somewhere outside tests/",
+    "FL003": "defined flags must appear in README's flags tables",
+    "CC001": "scrape-thread readers iterate copies (list(...)-wrapped)",
+    "CC002": "scrape-thread readers never mutate scheduler-owned state",
+    "CC003": ("readers on sanitizer-bearing classes carry their "
+              "check_read hook (closes the SAFE_READS loop)"),
+}

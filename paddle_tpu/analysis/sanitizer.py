@@ -1,0 +1,277 @@
+"""Runtime invariant sanitizer for the continuous-batching engine.
+
+The static half of this PR (ptlint) proves properties of the *code*;
+this module checks properties of the *state* the scheduler actually
+builds, once per tick, behind ``PT_FLAGS_sanitize``:
+
+* **page/refcount conservation** (paged mode): every pool page is
+  exactly one of {free, referenced}; each page's refcount equals its
+  recounted owners (slots holding it in their block tables + the
+  prefix store's retain); the free list has no duplicates; the
+  reserved sink page is out of circulation; the ``shared_pages``
+  fast-path counter agrees with a full recount.
+* **slot-heap agreement**: the free heap and the active mask partition
+  the slots; ``_slot_req`` holds exactly the active slots.
+* **seq_len bounds + host-truth agreement**: inactive slots sit at 0;
+  active slots fit ``max_len`` (paged: their allocated pages), and
+  match the host-side token ledger — ``prefill_ids + generated - 1``
+  (the first token is sampled by prefill), which is exactly the state
+  deterministic replay rebuilds from.
+* **scale-pool shape agreement** (int8 caches): per-row dequant scale
+  arrays mirror their payload pools block for block (paged:
+  ``[kvh, n_pages, page_size, 1]``; contiguous ``QuantizedKV``:
+  ``scale.shape == q.shape[:-1]``) — shape metadata only, never a
+  device sync.
+* **thread ownership**: ticks belong to ONE scheduler thread, and a
+  foreign (metrics/scrape) thread may only enter readers registered
+  copy-on-read-safe (``SAFE_READS`` — the same list ptlint's CC rules
+  keep honest statically).
+
+Every hook in ``serving.py`` is a single ``if self._san is not None``
+identity check when the flag is off (the telemetry=off pattern; pinned
+by test). Violations raise :class:`SanitizerError` naming the violated
+invariant and the site. All checks are host bookkeeping — O(slots +
+pages) python, zero compiled programs, zero device traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+# reader methods registered copy-on-read-safe: a foreign thread may
+# call these (and ONLY these) while the scheduler runs. Kept in sync
+# with ptlint's CC reader set — adding a reader here without the
+# list()-copy discipline is what the lint exists to catch, and CC003
+# statically requires every engine reader to carry its check_read
+# hook, so an unregistered reader cannot silently skip this check.
+SAFE_READS = frozenset({
+    "metrics_snapshot", "prefix_snapshot", "spec_snapshot",
+    "slo_snapshot", "resilience_snapshot", "backpressure", "_tel_state",
+})
+
+
+class SanitizerError(AssertionError):
+    """An engine invariant does not hold. ``invariant`` names the
+    violated invariant class, ``site`` the hook that caught it."""
+
+    def __init__(self, invariant: str, site: str, detail: str):
+        self.invariant = invariant
+        self.site = site
+        super().__init__(
+            f"[sanitize] invariant {invariant!r} violated at "
+            f"{site!r}: {detail}")
+
+
+class EngineSanitizer:
+    """Per-engine invariant checker (constructed only when
+    ``PT_FLAGS_sanitize`` is on — the engine holds None otherwise)."""
+
+    def __init__(self, engine=None):
+        del engine  # checks read the engine per-call; no cycle held
+        self._owner: Optional[int] = None
+
+    # ---------------- thread ownership ----------------
+    def note_tick(self, site: str):
+        """Called at every scheduler-tick entry: the first ticking
+        thread owns the engine; a second thread ticking it is exactly
+        the race the scheduler contract forbids."""
+        tid = threading.get_ident()
+        if self._owner is None:
+            self._owner = tid
+        elif tid != self._owner:
+            raise SanitizerError(
+                "scheduler-ownership", site,
+                f"tick from thread {tid} but the engine is owned by "
+                f"scheduler thread {self._owner} — one thread drives "
+                "step()/step_chunk()/drain()")
+
+    def check_read(self, name: str):
+        """Called at reader entries: a foreign thread may only use the
+        registered copy-on-read-safe readers."""
+        if self._owner is None:
+            return
+        tid = threading.get_ident()
+        if tid != self._owner and name not in SAFE_READS:
+            raise SanitizerError(
+                "thread-ownership", name,
+                f"read of unlocked scheduler state from foreign thread "
+                f"{tid} (owner {self._owner}); register the method in "
+                "analysis.sanitizer.SAFE_READS only once it follows "
+                "the copy-on-read pattern (ptlint CC001/CC002)")
+
+    # ---------------- per-tick state invariants ----------------
+    def check_tick(self, engine, site: str = "tick"):
+        self._check_slots(engine, site)
+        if engine.pool is not None:
+            self._check_pool(engine, site)
+            self._check_block_tables(engine, site)
+        self._check_scale_shapes(engine, site)
+
+    # -- slot heap / active mask / request map / seq_len bounds --
+    def _check_slots(self, engine, site):
+        cfg = engine.cfg
+        heap = list(engine._free_heap)
+        free = set(heap)
+        if len(free) != len(heap):
+            raise SanitizerError(
+                "slot-heap", site,
+                f"duplicate slots in the free heap: {sorted(heap)}")
+        active = {s for s in range(cfg.max_slots) if engine.active[s]}
+        if free & active:
+            raise SanitizerError(
+                "slot-heap", site,
+                f"slots {sorted(free & active)} are both free and "
+                "active")
+        if free | active != set(range(cfg.max_slots)):
+            missing = set(range(cfg.max_slots)) - free - active
+            raise SanitizerError(
+                "slot-heap", site,
+                f"slots {sorted(missing)} are neither free nor active "
+                "(leaked from the heap)")
+        if set(engine._slot_req) != active:
+            raise SanitizerError(
+                "slot-heap", site,
+                f"_slot_req keys {sorted(engine._slot_req)} != active "
+                f"slots {sorted(active)}")
+        for s in range(cfg.max_slots):
+            L = int(engine.seq_lens[s])
+            if s not in active:
+                if L != 0:
+                    raise SanitizerError(
+                        "seq-len", site,
+                        f"inactive slot {s} has seq_len {L} (expect 0)")
+                continue
+            if not 0 <= L <= cfg.max_len:
+                raise SanitizerError(
+                    "seq-len", site,
+                    f"slot {s} seq_len {L} outside [0, {cfg.max_len}]")
+            if engine.pool is not None:
+                cap = len(engine.pool.pages_of[s]) * cfg.page_size
+                if L > cap:
+                    raise SanitizerError(
+                        "seq-len", site,
+                        f"slot {s} seq_len {L} exceeds its "
+                        f"{len(engine.pool.pages_of[s])} allocated "
+                        f"page(s) = {cap} tokens")
+            req = engine._slot_req[s]
+            expect = req.prompt.size + len(req.output) - 1
+            if req.output and L != expect:
+                raise SanitizerError(
+                    "seq-len", site,
+                    f"slot {s} (rid {req.rid}) seq_len {L} != host "
+                    f"token ledger prompt({req.prompt.size}) + "
+                    f"output({len(req.output)}) - 1 = {expect} — the "
+                    "cache and the replay source of truth disagree")
+
+    # -- page/refcount conservation --
+    def _check_pool(self, engine, site):
+        pool = engine.pool
+        sink = 1 if getattr(pool, "reserve_sink", False) else 0
+        free = list(pool._free)
+        if len(set(free)) != len(free):
+            raise SanitizerError(
+                "page-conservation", site,
+                "duplicate page ids on the free list")
+        if sink and (0 in set(free) or pool.ref.get(0, 0) > 0):
+            raise SanitizerError(
+                "page-conservation", site,
+                "reserved sink page 0 re-entered circulation")
+        owners = {}
+        for s, pages in list(pool.pages_of.items()):
+            for p in pages:
+                owners[p] = owners.get(p, 0) + 1
+        store = engine._prefix
+        if engine.cfg.paged and store is not None:
+            for p in list(getattr(store, "_blocks", {}).values()):
+                owners[p] = owners.get(p, 0) + 1
+        for p, n in sorted(owners.items()):
+            if pool.ref.get(p, 0) != n:
+                raise SanitizerError(
+                    "page-conservation", site,
+                    f"page {p} refcount {pool.ref.get(p, 0)} != "
+                    f"recounted owners {n} (slots holding it + prefix-"
+                    "store retain) — a leak or double-free in the "
+                    "making")
+        for p, n in sorted(pool.ref.items()):
+            if n <= 0:
+                raise SanitizerError(
+                    "page-conservation", site,
+                    f"page {p} carries non-positive refcount {n}")
+            if owners.get(p, 0) != n:
+                raise SanitizerError(
+                    "page-conservation", site,
+                    f"page {p} refcount {n} has only "
+                    f"{owners.get(p, 0)} recounted owner(s)")
+        freeset = set(free)
+        if freeset & set(pool.ref):
+            both = sorted(freeset & set(pool.ref))
+            raise SanitizerError(
+                "page-conservation", site,
+                f"pages {both} are both free and referenced")
+        if len(free) + len(pool.ref) != pool.n_pages - sink:
+            raise SanitizerError(
+                "page-conservation", site,
+                f"free({len(free)}) + referenced({len(pool.ref)}) != "
+                f"n_pages({pool.n_pages}) - sink({sink}) — pages "
+                "leaked out of both ledgers")
+        shared = sum(1 for n in pool.ref.values() if n > 1)
+        if shared != pool.shared_pages:
+            raise SanitizerError(
+                "page-conservation", site,
+                f"shared_pages fast-path counter {pool.shared_pages} "
+                f"!= recount {shared} — the decode COW guard would "
+                "skip its scan while pages are shared")
+
+    # -- block table mirrors pages_of --
+    def _check_block_tables(self, engine, site):
+        pool = engine.pool
+        for s in range(pool.slots):
+            pages = pool.pages_of[s]
+            row = pool.block_tables[s]
+            for i, p in enumerate(pages):
+                if int(row[i]) != int(p):
+                    raise SanitizerError(
+                        "block-table", site,
+                        f"slot {s} block_tables[{i}] = {int(row[i])} "
+                        f"but pages_of lists page {p}")
+            for i in range(len(pages), pool.max_pages_per_slot):
+                if int(row[i]) != 0:
+                    raise SanitizerError(
+                        "block-table", site,
+                        f"slot {s} block_tables[{i}] = {int(row[i])} "
+                        "past its allocation (expect the sink id 0)")
+
+    # -- int8 scale pools mirror their payload --
+    def _check_scale_shapes(self, engine, site):
+        from ..inference.paged import QuantizedKV
+
+        if engine.pool is not None:
+            for li, c in enumerate(engine.layer_caches):
+                if (c.k_scale is None) != (c.v_scale is None):
+                    raise SanitizerError(
+                        "scale-pool", site,
+                        f"layer {li}: k_scale/v_scale presence differs")
+                if c.k_scale is None:
+                    continue
+                want = tuple(c.k_pages.shape[:3]) + (1,)
+                for nm, scale, pages in (("k", c.k_scale, c.k_pages),
+                                         ("v", c.v_scale, c.v_pages)):
+                    if tuple(scale.shape) != want:
+                        raise SanitizerError(
+                            "scale-pool", site,
+                            f"layer {li} {nm}_scale shape "
+                            f"{tuple(scale.shape)} desynced from pool "
+                            f"{tuple(pages.shape)} (want {want}) — "
+                            "dequant state no longer travels with the "
+                            "page")
+            return
+        for li, (k, v) in enumerate(engine.caches):
+            for nm, c in (("k", k), ("v", v)):
+                if isinstance(c, QuantizedKV):
+                    want = tuple(c.q.shape[:-1])
+                    if tuple(c.scale.shape) != want:
+                        raise SanitizerError(
+                            "scale-pool", site,
+                            f"layer {li} contiguous {nm} scale shape "
+                            f"{tuple(c.scale.shape)} != q rows {want}")

@@ -48,9 +48,21 @@ def get_seed() -> int:
 
 
 def default_key() -> jax.Array:
-    """Draw a fresh deterministic key from the global eager-mode stream."""
+    """Draw a fresh deterministic key from the global eager-mode
+    stream. ``PT_FLAGS_rng_use_global_seed=off`` swaps the stream's
+    base for a once-per-thread OS-entropy seed — explicitly
+    non-reproducible runs (the reference's unseeded-generator mode)."""
+    from .. import flags
+
     st = _ensure_state()
-    key = jax.random.fold_in(jax.random.PRNGKey(st.seed), st.counter)
+    base = st.seed
+    if not flags.flag("rng_use_global_seed"):
+        if not hasattr(_state, "entropy_seed"):
+            import secrets
+
+            _state.entropy_seed = secrets.randbits(63)
+        base = _state.entropy_seed
+    key = jax.random.fold_in(jax.random.PRNGKey(base), st.counter)
     st.counter += 1
     return key
 

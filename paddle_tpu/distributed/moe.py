@@ -141,7 +141,7 @@ class MoELayer(Layer):
         d_hidden: Optional[int] = None,
         gate: str = "gshard",
         top_k: int = 2,
-        capacity_factor: float = 1.25,
+        capacity_factor: Optional[float] = None,
         expert_axis: str = "ep",
         aux_loss_weight: float = 1e-2,
     ):
@@ -150,6 +150,11 @@ class MoELayer(Layer):
         self.num_experts = num_experts
         self.gate_type = gate
         self.top_k = 1 if gate == "switch" else top_k
+        if capacity_factor is None:
+            # layer default rides PT_FLAGS_moe_capacity_factor (1.25)
+            from .. import flags
+
+            capacity_factor = float(flags.flag("moe_capacity_factor"))
         self.capacity_factor = capacity_factor
         self.aux_loss_weight = aux_loss_weight
         self.gate_weight = self.create_parameter(

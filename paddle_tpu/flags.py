@@ -63,14 +63,35 @@ def all_flags():
     return {k: v["value"] for k, v in _REGISTRY.items()}
 
 
+def registry():
+    """The CANONICAL flag registry view: name -> {value, default,
+    help}. This is the single source the static lint's flags-hygiene
+    rules (``paddle_tpu.analysis.lint``, FL001–FL003) and the
+    registry-consistency tests check against — every ``PT_FLAGS_*``
+    read anywhere in the repo must resolve here (flags defined in
+    other modules, e.g. ``nn/layout.py``'s ``conv_layout``, register
+    through the same ``define_flag`` and appear too). Returns copies;
+    mutate flags through ``set_flags``."""
+    return {k: dict(v) for k, v in _REGISTRY.items()}
+
+
 # ---------------------------------------------------------------------------
 # built-in flags (the meaningful survivors of the reference's ~hundreds)
 # ---------------------------------------------------------------------------
-define_flag("benchmark", False, "print per-step timing")
+define_flag("benchmark", False,
+            "print per-step wall timing + loss from TrainStep.run "
+            "(blocks on the step's outputs each step — a debug/bench "
+            "knob, not a production setting)")
 define_flag("check_nan_inf", False,
-            "debug-check gradients for NaN/Inf each step (jax.debug)")
+            "debug-check each TrainStep's loss/grad-norm for NaN/Inf "
+            "and raise FloatingPointError at the offending step "
+            "(forces a per-step host sync; read at TrainStep build "
+            "time, where it also forces the grad-norm output on even "
+            "with telemetry off)")
 define_flag("default_matmul_precision", "",
-            "override jax matmul precision: bfloat16|tensorfloat32|highest")
+            "process-wide jax matmul precision override, applied at "
+            "import: bfloat16|tensorfloat32|float32|highest; empty = "
+            "jax's default (bf16 on the MXU)")
 define_flag("log_memory_stats", False,
             "record device bytes_in_use/peak_bytes_in_use through the "
             "telemetry registry on sampled steps")
@@ -99,7 +120,10 @@ define_flag("trace_buffer", 8192,
             "fall off; bounds host memory no matter how long the engine "
             "runs")
 define_flag("rng_use_global_seed", True,
-            "derive eager rng stream from the global seed")
+            "derive the eager rng stream (core.random.default_key) "
+            "from the global paddle_tpu.seed; off = draw the stream's "
+            "base from OS entropy once per thread (non-reproducible "
+            "by request)")
 define_flag("fused_group_norm", True,
             "dispatch NHWC GroupNorm to the fused Pallas kernel")
 define_flag("fused_decode", "auto",
@@ -183,7 +207,32 @@ define_flag("serve_weight_dtype", "bf16",
             "2x/4x, the decode roofline's other half. Single-chip "
             "serving only (no mesh); quality delta is measured, not "
             "asserted away, by the serve7b 'quant' scenario")
-define_flag("flash_attention_block_q", 256, "Pallas flash attn q block")
-define_flag("flash_attention_block_k", 256, "Pallas flash attn k block")
-define_flag("moe_capacity_factor", 1.25, "default MoE capacity factor")
-define_flag("io_prefetch_depth", 2, "host→device prefetch buffers")
+define_flag("sanitize", False,
+            "serving-engine runtime invariant sanitizer "
+            "(analysis/sanitizer.py): once per scheduler tick, check "
+            "page/refcount conservation, slot-heap + block-table + "
+            "int8-scale-pool agreement and seq_len bounds against the "
+            "host token ledger, plus thread-ownership of scrape-"
+            "thread reads (only the registered copy-on-read snapshot "
+            "methods may be called from a foreign thread). Violations "
+            "raise SanitizerError naming the invariant and site. "
+            "off = every hook is a single identity check (the "
+            "telemetry=off pattern); `pytest -m chaos` runs with it "
+            "on. Host bookkeeping only — zero compiled programs, "
+            "zero device syncs")
+define_flag("flash_attention_block_q", 1024,
+            "Pallas flash-attention q block length (rows of q each "
+            "kernel grid step keeps in VMEM; clamped to the padded "
+            "sequence). Default matches the kernel's "
+            "DEFAULT_Q_BLOCK, so the flag is a pure override knob")
+define_flag("flash_attention_block_k", 1024,
+            "Pallas flash-attention k/v block length (the online-"
+            "softmax streaming granularity; clamped to the padded "
+            "sequence). Default matches DEFAULT_K_BLOCK")
+define_flag("moe_capacity_factor", 1.25,
+            "default MoE expert capacity factor when a layer doesn't "
+            "pass one explicitly (capacity = factor * tokens * top_k "
+            "/ num_experts)")
+define_flag("io_prefetch_depth", 2,
+            "host→device prefetch buffers (io.prefetch_to_device "
+            "default queue depth)")

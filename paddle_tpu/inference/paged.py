@@ -322,6 +322,9 @@ class PagePool:
         self.page_size = page_size
         self.slots = slots
         self.max_pages_per_slot = max_pages_per_slot
+        # recorded for the invariant sanitizer: the sink page must
+        # never re-enter circulation
+        self.reserve_sink = reserve_sink
         first = 1 if reserve_sink else 0
         self._free = list(range(n_pages - 1, first - 1, -1))
         self.block_tables = np.zeros((slots, max_pages_per_slot), np.int32)

@@ -34,13 +34,25 @@ compiled programs, importable and testable without jax.
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
 
-# injection sites, in the order the engine consults them at a seam
-SITES = ("step", "nan", "latency", "pool")
+# injection sites, in the order the engine consults them at a seam.
+# APPEND-ONLY: each site's RNG stream is seeded on its index, so
+# inserting a site would shift every later site's schedule and break
+# seeded-chaos reproducibility across versions.
+SITES = ("step", "nan", "latency", "pool",
+         # state-corruption sites (sanitizer chaos): consulted once
+         # per tick AFTER the step's host integration — a fire mangles
+         # the engine's own bookkeeping (leaked page ref / desynced
+         # scale pool / shrunk seq_len) so PT_FLAGS_sanitize runs can
+         # prove the invariant checker catches real damage
+         "leak_ref", "scale_desync", "seq_shrink")
+
+# the subset above that corrupts engine state instead of failing a
+# dispatch (the engine's _corrupt_point consults exactly these)
+CORRUPT_SITES = ("leak_ref", "scale_desync", "seq_shrink")
 
 # exception classes "auto" recovery treats as device/runtime failures
 # (recoverable by quarantine + replay) as opposed to host logic bugs
@@ -162,13 +174,15 @@ class FaultInjector:
         return hit
 
     def snapshot(self) -> dict:
+        # copy-on-read (ptlint CC001): the /healthz scrape thread reads
+        # this through resilience_snapshot while the scheduler fires
         return {
             "enabled": self.enabled,
             "seed": self.seed,
             "latency_ms": self.latency_ms,
-            "rates": dict(self.rates),
-            "draws": dict(self.draws),
-            "fires": dict(self.fires),
+            "rates": {k: v for k, v in list(self.rates.items())},
+            "draws": {k: v for k, v in list(self.draws.items())},
+            "fires": {k: v for k, v in list(self.fires.items())},
         }
 
 
@@ -225,14 +239,21 @@ class DegradationController:
         self._tick = 0
         self._sat_streak = 0
         self._good_streak = 0
-        self._fault_log: deque = deque()  # (tick, count)
-        self.transitions: deque = deque(maxlen=64)
+        # both scrape-read structures are plain lists, NOT deques: the
+        # scrape thread copies them via list(...) in snapshot(), and
+        # list-of-list is atomic under the GIL while deque iteration
+        # raises on concurrent append. _fault_log stays tiny (trimmed
+        # to the sliding window each observe()), so del-from-front is
+        # O(window), not a cost.
+        self._fault_log: list = []  # (tick, count)
+        self.transitions: list = []
+        self._max_transitions = 64
 
     # ---------------- per-tick update ----------------
     def _window_faults(self) -> int:
         horizon = self._tick - self.fault_window
         while self._fault_log and self._fault_log[0][0] <= horizon:
-            self._fault_log.popleft()
+            del self._fault_log[0]
         return sum(c for _, c in self._fault_log)
 
     def observe(self, *, saturated: bool, faults: int = 0) -> int:
@@ -264,6 +285,9 @@ class DegradationController:
                 "tick": self._tick, "from": self.level, "to": new,
                 "saturated": bool(saturated), "window_faults": wf,
             })
+            if len(self.transitions) > self._max_transitions:
+                del self.transitions[
+                    :len(self.transitions) - self._max_transitions]
             self.level = new
         return new
 
@@ -297,6 +321,12 @@ class DegradationController:
         return LEVEL_NAMES[min(self.level, len(LEVEL_NAMES) - 1)]
 
     def snapshot(self) -> dict:
+        # pure read (ptlint CC002): recount the fault window WITHOUT
+        # the trim _window_faults performs — the scrape thread must
+        # never mutate the scheduler-owned log, and the trim races
+        # observe()'s own popleft
+        horizon = self._tick - self.fault_window
+        wf = sum(c for t, c in list(self._fault_log) if t > horizon)
         return {
             "enabled": True,
             "level": self.level,
@@ -308,6 +338,6 @@ class DegradationController:
             "disable_prefix": self.disable_prefix,
             "sat_streak": self._sat_streak,
             "good_streak": self._good_streak,
-            "window_faults": self._window_faults(),
+            "window_faults": wf,
             "transitions": list(self.transitions),
         }

@@ -43,6 +43,7 @@ from ..core.module import Layer
 from .paged import PagedLayerCache, PagedState, PagePool, init_paged_pool
 from .prefix_cache import ContigPrefixStore, PagedPrefixStore, block_hashes
 from .resilience import (
+    CORRUPT_SITES,
     RUNTIME_ERRORS,
     DegradationController,
     FaultInjector,
@@ -524,6 +525,19 @@ class ContinuousBatchingEngine:
         # PR 2's recorder: the dump attaches the tracer tail)
         self._recorder = None
 
+        # ---------------- invariant sanitizer ----------------
+        # PT_FLAGS_sanitize (analysis/sanitizer.py): per-tick state
+        # invariants (page/refcount conservation, slot-heap +
+        # block-table + scale-pool agreement, seq_len bounds vs the
+        # host token ledger) and thread-ownership of scrape reads.
+        # None when off — every hook site below pays a single identity
+        # check, the telemetry=off pattern (pinned by test).
+        self._san = None
+        if bool(flags.flag("sanitize")):
+            from ..analysis.sanitizer import EngineSanitizer
+
+            self._san = EngineSanitizer(self)
+
     def _init_cache_state(self):
         """(Re)build the KV-cache device arrays and the page-pool
         bookkeeping — called at init and by hard crash recovery
@@ -807,6 +821,7 @@ class ContinuousBatchingEngine:
         # global contiguous cache (dynamic_update_slice over slot axis)
         if self._insert_c is None:
             def fn(global_caches, one_caches, slot):
+                TRACE_COUNTS["prefill_insert"] += 1
                 out = []
                 for (gk, gv), (ok, ov) in zip(global_caches, one_caches):
                     pad = gk.shape[1] - ok.shape[1]
@@ -829,6 +844,7 @@ class ContinuousBatchingEngine:
             ps = self.cfg.page_size
 
             def fn(layer_caches, one_caches, bt_row):
+                TRACE_COUNTS["prefill_scatter"] += 1
                 out = []
                 for cache, (ok, ov) in zip(layer_caches, one_caches):
                     n_used = ok.shape[1] // ps
@@ -2132,9 +2148,11 @@ class ContinuousBatchingEngine:
             self._recorder = observability.FlightRecorder(
                 capacity=int(flags.flag("telemetry_flight_window")),
                 dump_dir=str(flags.flag("telemetry_dump_dir")))
+        # no wall-clock stamp here: dump() writes its own unix_time,
+        # and the engine's deterministic paths stay perf_counter-only
         self._recorder.record(
             kind="serve_nan", program=program, requeued=requeued,
-            engine=self._tel.engine_id, wall=time.time())
+            engine=self._tel.engine_id)
         self._recorder.dump(
             f"serving NaN-logits storm in {program} "
             f"(engine {self._tel.engine_id})")
@@ -2230,8 +2248,13 @@ class ContinuousBatchingEngine:
         """Fault/recovery/degradation counters (plain host counters —
         available even with PT_FLAGS_telemetry=off, like
         prefix/spec/slo snapshots)."""
-        st = dict(self.resilience_stats)
-        st["faults"] = dict(st["faults"])
+        if self._san is not None:
+            self._san.check_read("resilience_snapshot")
+        # copy-on-read: the /healthz scrape thread calls this while
+        # the scheduler writes counters; "faults" grows a key on a
+        # site's first fault, so both levels iterate list() copies
+        st = {k: v for k, v in list(self.resilience_stats.items())}
+        st["faults"] = {k: v for k, v in list(st["faults"].items())}
         st["recovery_mode"] = self._recovery_mode
         st["max_retries"] = self.cfg.max_retries
         st["draining"] = self._draining
@@ -2245,6 +2268,21 @@ class ContinuousBatchingEngine:
         return st
 
     def step(self) -> bool:
+        """One per-token scheduler tick (see ``_step_impl``),
+        bracketed by the sanitizer's ownership + invariant hooks and
+        the chaos corruption seam — each a single identity check when
+        its subsystem is off."""
+        san = self._san
+        if san is not None:
+            san.note_tick("step")
+        out = self._step_impl()
+        if self._injector is not None:
+            self._corrupt_point()
+        if san is not None:
+            san.check_tick(self, "step")
+        return out
+
+    def _step_impl(self) -> bool:
         """Admit waiting requests, run one decode step for all active
         slots — or, with speculative decoding enabled and at least one
         slot holding a draft, one multi-token verify pass. Returns
@@ -2504,6 +2542,81 @@ class ContinuousBatchingEngine:
         return budget
 
     def step_chunk(self, max_chunk: int = 8) -> bool:
+        """One chunked scheduler tick (see ``_step_chunk_impl``),
+        bracketed by the sanitizer's ownership + invariant hooks and
+        the chaos corruption seam — each a single identity check when
+        its subsystem is off."""
+        san = self._san
+        if san is not None:
+            san.note_tick("step_chunk")
+        out = self._step_chunk_impl(max_chunk)
+        if self._injector is not None:
+            self._corrupt_point()
+        if san is not None:
+            san.check_tick(self, "step_chunk")
+        return out
+
+    def _corrupt_point(self):
+        """State-corruption chaos seam: consulted once per tick, AFTER
+        the step's host integration. A firing site mangles the
+        engine's own bookkeeping — how a ``PT_FLAGS_sanitize`` run
+        proves the invariant checker catches real damage (and how the
+        sanitizer tests seed their corruptions). Production injector
+        specs leave these rates at 0; with no injector this seam is
+        never reached."""
+        inj = self._injector
+        for site in CORRUPT_SITES:
+            if inj.fire(site) and self._apply_corruption(site):
+                # counted only when damage actually landed — a no-op
+                # fire (e.g. scale_desync on a float cache) must not
+                # report an injected fault the sanitizer then
+                # "misses"
+                self._note_fault(site, "corrupt")
+
+    def _apply_corruption(self, site: str) -> bool:
+        """Deterministic minimal damage per corruption site, aimed at
+        the first active slot (pool/heap when none is active).
+        Returns True when state was actually corrupted."""
+        slots = [s for s in range(self.cfg.max_slots)
+                 if self.active[s]]
+        if site == "seq_shrink":
+            # cache length falls behind the host token ledger — the
+            # replay-source-of-truth desync class
+            if slots:
+                self.seq_lens[slots[0]] -= 1
+                return True
+        elif site == "leak_ref":
+            if self.pool is not None:
+                # a refcount with no owner: the page can never free
+                for s in slots:
+                    if self.pool.pages_of[s]:
+                        p = self.pool.pages_of[s][0]
+                        self.pool.ref[p] = self.pool.ref.get(p, 0) + 1
+                        return True
+            elif self._free_heap:
+                # contiguous mode has no pool: leak a slot instead
+                heapq.heappop(self._free_heap)
+                return True
+        elif site == "scale_desync":
+            # int8 caches only: shear a dequant-scale array off its
+            # payload pool (shape metadata change — no device sync)
+            if self.pool is not None:
+                c = self.layer_caches[0]
+                if c.k_scale is not None:
+                    self.layer_caches[0] = c._replace(
+                        k_scale=c.k_scale[:, :, :-1])
+                    return True
+            else:
+                from .paged import QuantizedKV
+
+                k, v = self.caches[0]
+                if isinstance(k, QuantizedKV):
+                    self.caches[0] = (
+                        QuantizedKV(k.q, k.scale[:, :-1]), v)
+                    return True
+        return False
+
+    def _step_chunk_impl(self, max_chunk: int) -> bool:
         """Run ``max_chunk`` decode steps in ONE device program, with
         admission OVERLAPPED: the decode chunk is dispatched first (no
         host sync), then prefill + cache-insert programs for queued
@@ -2705,6 +2818,8 @@ class ContinuousBatchingEngine:
         on free), so concurrent iteration never sees a resized dict —
         a scrape racing the scheduler can read a momentarily stale
         count, which is acceptable for a gauge."""
+        if self._san is not None:
+            self._san.check_read("_tel_state")
         occ = float(self.active.sum()) / self.cfg.max_slots
         if self.cfg.paged:
             used = float(sum(
@@ -2724,6 +2839,8 @@ class ContinuousBatchingEngine:
         host counters survive ``PT_FLAGS_telemetry=off``). Bench ledger
         lines and the dump CLI read this one call instead of stitching
         ``prefix_snapshot`` + ``spec_snapshot`` + ``slo_snapshot``."""
+        if self._san is not None:
+            self._san.check_read("metrics_snapshot")
         if self._tel is None:
             snap = {"telemetry": "off"}
         else:
@@ -2745,7 +2862,9 @@ class ContinuousBatchingEngine:
         """Prefix-cache effectiveness counters (plain host counters —
         available even with PT_FLAGS_telemetry=off, which is how the
         bench A/B reads hit rates)."""
-        st = dict(self.prefix_stats)
+        if self._san is not None:
+            self._san.check_read("prefix_snapshot")
+        st = {k: v for k, v in list(self.prefix_stats.items())}
         st["enabled"] = self._prefix is not None
         st["cached_blocks"] = (self._prefix.cached_pages
                                if self._prefix is not None else 0)
@@ -2757,7 +2876,9 @@ class ContinuousBatchingEngine:
         """Speculative-decoding effectiveness counters (plain host
         counters — available even with PT_FLAGS_telemetry=off, which is
         how the bench A/B reads acceptance rates)."""
-        st = dict(self.spec_stats)
+        if self._san is not None:
+            self._san.check_read("spec_snapshot")
+        st = {k: v for k, v in list(self.spec_stats.items())}
         st["enabled"] = self._spec_mode != "off"
         st["mode"] = self._spec_mode
         st["k"] = self.cfg.spec_k
@@ -2771,18 +2892,22 @@ class ContinuousBatchingEngine:
         how the bench goodput sweep reads them). ``goodput`` is
         met / (met + violated) over SLO-tracked finishes; cancelled
         requests are counted separately, never as violations."""
+        if self._san is not None:
+            self._san.check_read("slo_snapshot")
         classes = {}
         met = violated = 0
         # list(): slo_stats grows a key on a class's FIRST finish, and
         # this runs on the /healthz scrape thread too — iterating the
         # live dict would race the scheduler with RuntimeError
         for cls, st in list(self.slo_stats.items()):
-            d = dict(st)
-            tracked = st["met"] + st["violated"]
-            d["goodput"] = st["met"] / tracked if tracked else None
+            d = {k: v for k, v in list(st.items())}
+            # derive ONLY from the copy: mixing d with the live st
+            # could report met=5 next to a goodput computed at met=6
+            tracked = d["met"] + d["violated"]
+            d["goodput"] = d["met"] / tracked if tracked else None
             classes[cls] = d
-            met += st["met"]
-            violated += st["violated"]
+            met += d["met"]
+            violated += d["violated"]
         tracked = met + violated
         return {
             "classes": classes,
@@ -2803,6 +2928,8 @@ class ContinuousBatchingEngine:
         waiting with zero free slots) — the state a router drains a
         replica on. Host scheduler state only; safe from the scrape
         thread (same staleness contract as ``_tel_state``)."""
+        if self._san is not None:
+            self._san.check_read("backpressure")
         qd = len(self._queue)
         free = len(self._free_heap)
         ctl = self._degctl

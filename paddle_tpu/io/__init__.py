@@ -467,10 +467,15 @@ class DataLoader:
         return iter(self)
 
 
-def prefetch_to_device(iterator: Iterable, size: int = 2,
+def prefetch_to_device(iterator: Iterable, size: Optional[int] = None,
                        sharding=None) -> Iterator:
     """Double-buffered host→device prefetch (parity: the pinned-memory +
-    stream H2D overlap in the reference's DataLoader)."""
+    stream H2D overlap in the reference's DataLoader). ``size``
+    defaults to ``PT_FLAGS_io_prefetch_depth`` (2)."""
+    if size is None:
+        from .. import flags
+
+        size = int(flags.flag("io_prefetch_depth"))
     buf: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
 

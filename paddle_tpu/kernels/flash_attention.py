@@ -142,7 +142,12 @@ def _segment_reference_attention(q, k, v, segment_ids, causal=False,
 # ---------------------------------------------------------------------------
 def _pallas_flash_attention(q, k, v, causal=False, scale=None,
                             segment_ids=None, window=0):
+    from .. import flags
     from .pallas_attention import mha as pallas_mha
 
+    # VMEM tile shape knobs (PT_FLAGS_flash_attention_block_{q,k});
+    # mha clamps them to the actual (padded) sequence internally
     return pallas_mha(q, k, v, causal=causal, sm_scale=scale,
+                      q_block=int(flags.flag("flash_attention_block_q")),
+                      k_block=int(flags.flag("flash_attention_block_k")),
                       segment_ids=segment_ids, window=window)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import observability
+from .. import flags, observability
 from ..core.functional import extract_param_objs, functional_call
 from ..core.module import Layer
 from ..distributed.sharding import (
@@ -228,7 +228,10 @@ class TrainStep:
         # telemetry object.
         want_tel = (observability.enabled() if telemetry is None
                     else bool(telemetry))
-        emit_gnorm = want_tel
+        # check_nan_inf promises a grad-norm check: it needs the gnorm
+        # output even when telemetry is off (flag read at BUILD time —
+        # the program's output arity is a compile-time shape)
+        emit_gnorm = want_tel or bool(flags.flag("check_nan_inf"))
         self._emit_gnorm = emit_gnorm
         self.telemetry = None
         if want_tel and not abstract:
@@ -393,7 +396,8 @@ class TrainStep:
                 "use lower() for AOT compilation, or rebuild without "
                 "abstract for execution")
         tel = self.telemetry
-        t0 = time.perf_counter() if tel is not None else 0.0
+        bench = bool(flags.flag("benchmark"))
+        t0 = time.perf_counter() if tel is not None or bench else 0.0
         if not sharded:
             batch = self.shard_batch(batch)
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -408,6 +412,46 @@ class TrainStep:
                     self.params, self.opt_state, batch, sub
                 )
         self.step_count += 1
+        if bench or flags.flag("check_nan_inf"):
+            # debug knobs — BOTH force a host sync on the step's
+            # outputs, which is their documented cost (the telemetry
+            # path below never syncs off-sample; these flags exist for
+            # the runs where per-step truth beats throughput)
+            loss_f = float(jnp.asarray(loss))
+            gnorm_f = (float(jnp.asarray(gnorm))
+                       if gnorm is not None else None)
+            if bench:
+                wall_ms = (time.perf_counter() - t0) * 1e3
+                print(f"[pt-benchmark] step {self.step_count}: "
+                      f"{wall_ms:.2f} ms  loss={loss_f:.6g}"
+                      + (f"  grad_norm={gnorm_f:.6g}"
+                         if gnorm_f is not None else ""),
+                      flush=True)
+            if flags.flag("check_nan_inf"):
+                import math as _math
+
+                if gnorm_f is None and not self._emit_gnorm:
+                    # flag flipped on AFTER build: output arity is a
+                    # compile-time shape, so only loss is checkable —
+                    # say so once instead of silently half-checking
+                    if not getattr(self, "_warned_nan_loss_only", False):
+                        self._warned_nan_loss_only = True
+                        import warnings
+
+                        warnings.warn(
+                            "PT_FLAGS_check_nan_inf was enabled after "
+                            "this TrainStep was built: grad-norm is "
+                            "not emitted, so only the loss is checked "
+                            "— rebuild the TrainStep to check "
+                            "gradients too", stacklevel=2)
+                bad = [n for n, v in (("loss", loss_f),
+                                      ("grad_norm", gnorm_f))
+                       if v is not None and not _math.isfinite(v)]
+                if bad:
+                    raise FloatingPointError(
+                        f"PT_FLAGS_check_nan_inf: non-finite "
+                        f"{'/'.join(bad)} at step {self.step_count} "
+                        f"(loss={loss_f}, grad_norm={gnorm_f})")
         if tel is not None:
             # loss/gnorm stay async device futures unless this is a
             # sampled step (TrainTelemetry fetches them only then)

@@ -1,0 +1,213 @@
+"""ptlint over the repo: the tier-1 fast-lane gate.
+
+Three claims:
+
+1. **The repo is clean** — ``python -m paddle_tpu.analysis.lint
+   paddle_tpu tests benchmarks`` reports zero violations beyond the
+   committed baseline (``.ptlint-baseline.json``), so any NEW
+   trace-safety / determinism / flags-hygiene / concurrency finding
+   fails CI at the PR that introduces it.
+
+2. **The core is suppression-free** — the baseline carries no entries
+   under ``paddle_tpu/inference/`` or ``paddle_tpu/kernels/``, and no
+   inline ``ptlint: disable`` markers live there either: in the
+   serving/kernel core, findings get FIXED, not waived.
+
+3. **The rules actually fire** — a synthetic module planted in a tmp
+   repo trips each family (host-sync-in-jit, wall-clock, un-copied
+   snapshot iteration, unknown flag read), and inline suppression +
+   baseline machinery behave as documented.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import lint
+
+pytestmark = pytest.mark.fast
+
+REPO = lint.find_root(os.path.dirname(__file__))
+SCAN_PATHS = [os.path.join(REPO, p)
+              for p in ("paddle_tpu", "tests", "benchmarks")]
+CORE_PREFIXES = ("paddle_tpu/inference/", "paddle_tpu/kernels/")
+
+
+def _scan_repo():
+    return lint.scan(SCAN_PATHS, REPO)
+
+
+def test_repo_lint_clean():
+    result = _scan_repo()
+    baseline = lint.load_baseline(
+        os.path.join(REPO, lint.BASELINE_NAME))
+    new, _accepted = lint.apply_baseline(result.violations, baseline)
+    assert not new, "new ptlint violations:\n" + "\n".join(
+        f"  {v.file}:{v.line}: {v.rule} {v.message}" for v in new)
+
+
+def test_core_is_suppression_free():
+    """paddle_tpu/inference and paddle_tpu/kernels: no baseline
+    entries, no inline disables — zero-suppression is the contract."""
+    baseline = lint.load_baseline(
+        os.path.join(REPO, lint.BASELINE_NAME))
+    dirty = [k for k in baseline if k.startswith(CORE_PREFIXES)]
+    assert not dirty, f"baseline entries in the core: {dirty}"
+    result = _scan_repo()
+    inline = [s for s in result.suppressions
+              if s.file.startswith(CORE_PREFIXES)]
+    assert not inline, (
+        f"inline ptlint suppressions in the core: "
+        f"{[(s.file, s.line) for s in inline]}")
+
+
+def test_flag_registry_matches_runtime():
+    """The AST-level registry the lint checks against == the runtime
+    registry flags.registry() exposes (the satellite contract)."""
+    import ast
+
+    from paddle_tpu import flags as F
+    from paddle_tpu.analysis.rules import FlagsHygiene
+
+    project = lint.Project(REPO)
+    rule = FlagsHygiene()
+    for path in lint.iter_py_files([os.path.join(REPO, "paddle_tpu")]):
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        rule.check_module(project, tree, "", rel)
+    assert set(project.flag_defs) == set(F.registry())
+
+
+# ---------------------------------------------------------------------------
+# synthetic violations: every family fires; suppressions/baseline work
+# ---------------------------------------------------------------------------
+_BAD_SERVING = textwrap.dedent("""\
+    import time
+    import jax
+    import numpy as np
+
+    def build():
+        def fn(x, flag):
+            if flag:                      # Python if on traced arg
+                return float(x)           # host sync on traced value
+            return x.item()
+        return jax.jit(fn, static_argnums=())
+
+    def stamp():
+        return time.time()
+
+    class Engine:
+        def tick(self):
+            if self._san is not None:         # sanitizer-bearing class
+                self._san.check_tick(self)
+
+        def spec_snapshot(self):              # no check_read hook
+            out = {}
+            for k, v in self.stats.items():   # un-copied iteration
+                out[k] = v
+            self.stats["reads"] += 1          # reader mutates state
+            return out
+    """)
+
+
+@pytest.fixture
+def tmp_repo(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    (tmp_path / "README.md").write_text("no flags documented\n")
+    pkg = tmp_path / "paddle_tpu" / "inference"
+    pkg.mkdir(parents=True)
+    return tmp_path
+
+
+def test_rules_fire_on_synthetic_module(tmp_repo):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
+    bad.write_text(_BAD_SERVING)
+    result = lint.scan([str(bad)], str(tmp_repo))
+    rules = {v.rule for v in result.violations}
+    assert {"TS001", "DT003", "CC001", "CC002", "CC003"} <= rules, rules
+    # TS001 fired for all three shapes: if-on-traced, float(), .item()
+    ts = [v for v in result.violations if v.rule == "TS001"]
+    assert len(ts) == 3, [(v.line, v.message) for v in ts]
+
+
+def test_inline_suppression_and_skip_file(tmp_repo):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
+    # the marker is assembled at runtime so scanning THIS test file
+    # doesn't count a suppression against the repo
+    marker = "# ptlint: " + "disable=DT003"
+    bad.write_text(
+        "import time\n"
+        "def stamp():\n"
+        f"    return time.time()  {marker}\n")
+    result = lint.scan([str(bad)], str(tmp_repo))
+    assert not result.violations
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "DT003"
+    bad.write_text(
+        "# ptlint: skip-file\nimport time\n"
+        "def stamp():\n    return time.time()\n")
+    result = lint.scan([str(bad)], str(tmp_repo))
+    assert not result.violations
+
+
+def test_baseline_allows_exactly_counted(tmp_repo):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def a():\n    return time.time()\n"
+        "def b():\n    return time.time()\n")
+    result = lint.scan([str(bad)], str(tmp_repo))
+    assert len(result.violations) == 2
+    baseline = {"paddle_tpu/inference/bad.py::DT003": 1}
+    new, accepted = lint.apply_baseline(result.violations, baseline)
+    assert len(new) == 1 and len(accepted) == 1
+
+
+def test_cli_exit_codes(tmp_repo, capsys):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
+    bad.write_text("import time\ndef a():\n    return time.time()\n")
+    rc = lint.main([str(bad), "--root", str(tmp_repo),
+                    "--no-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "DT003" in out
+    bad.write_text("x = 1\n")
+    rc = lint.main([str(bad), "--root", str(tmp_repo),
+                    "--no-baseline"])
+    assert rc == 0
+
+
+def test_cli_missing_path_is_an_error(tmp_repo, capsys):
+    """A typo'd path must not read as a vacuously clean scan."""
+    rc = lint.main(["definitely_not_a_dir",
+                    "--root", str(tmp_repo), "--no-baseline"])
+    assert rc == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+def test_malformed_baseline_is_a_clear_error(tmp_repo, capsys):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "ok.py"
+    bad.write_text("x = 1\n")
+    (tmp_repo / lint.BASELINE_NAME).write_text("{not json")
+    rc = lint.main([str(bad), "--root", str(tmp_repo)])
+    assert rc == 2
+    assert "invalid ptlint baseline" in capsys.readouterr().err
+    with pytest.raises(ValueError, match="invalid ptlint baseline"):
+        lint.load_baseline(str(tmp_repo / lint.BASELINE_NAME))
+
+
+def test_write_baseline_round_trip(tmp_repo):
+    bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
+    bad.write_text("import time\ndef a():\n    return time.time()\n")
+    rc = lint.main([str(bad), "--root", str(tmp_repo),
+                    "--write-baseline"])
+    assert rc == 0
+    data = json.loads(
+        (tmp_repo / lint.BASELINE_NAME).read_text())
+    assert data["entries"] == {
+        "paddle_tpu/inference/bad.py::DT003": 1}
+    rc = lint.main([str(bad), "--root", str(tmp_repo)])
+    assert rc == 0  # baselined -> clean exit

@@ -1,0 +1,247 @@
+"""Runtime invariant sanitizer (analysis/sanitizer.py + the engine's
+PT_FLAGS_sanitize hooks).
+
+Three claims under test:
+
+1. **Seeded corruptions are CAUGHT, named, and sited** — the PR 7
+   fault injector grew state-corruption sites (``leak_ref`` /
+   ``scale_desync`` / ``seq_shrink``) that mangle the engine's own
+   bookkeeping at the per-tick corruption seam; a sanitized engine
+   must raise :class:`SanitizerError` naming the violated invariant
+   class and the hook site, for every corruption class, in both cache
+   modes where the class applies.
+
+2. **Off = identity** — ``PT_FLAGS_sanitize=off`` (the default)
+   constructs NO sanitizer: every hook is a single ``is not None``
+   check (the telemetry=off pattern), greedy outputs are bit-identical
+   to a sanitized run, and sanitize-on compiles ZERO additional
+   programs (compile-count guard).
+
+3. **Thread ownership** — the first ticking thread owns the engine; a
+   foreign thread may call only the registered copy-on-read readers
+   (``SAFE_READS`` — the same list ptlint's CC rules keep honest), and
+   a second thread ticking the engine is flagged immediately.
+
+The whole module rides the chaos marker, so it runs sanitized via the
+conftest chaos-lane fixture — the same wiring that makes the PR 7
+storms in test_resilience/test_concurrency_soak run with the checker
+on.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+import serving_utils as su
+
+from paddle_tpu import flags as F
+from paddle_tpu.analysis.sanitizer import SAFE_READS, SanitizerError
+from paddle_tpu.inference.resilience import CORRUPT_SITES, FaultInjector
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def model():
+    m, cfg = su.tiny_model()
+    m._tiny_cfg = cfg
+    return m
+
+
+def _prompts(cfg, n=2):
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, cfg.vocab_size, 9) for _ in range(n)]
+
+
+def _engine(model, paged, rates=None, **ecfg_kw):
+    inj = FaultInjector(rates=rates) if rates else None
+    return ContinuousBatchingEngine(
+        model, su.tiny_ecfg(paged, **ecfg_kw), fault_injector=inj)
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded corruption classes are caught
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("paged", [True, False])
+def test_seq_shrink_caught(model, paged):
+    """A cache length falling behind the host token ledger (the replay
+    source of truth) trips the seq-len invariant at the same tick."""
+    eng = _engine(model, paged, rates={"seq_shrink": 1.0})
+    assert eng._san is not None  # chaos lane runs sanitized
+    eng.add_request(_prompts(model._tiny_cfg)[0], 8)
+    with pytest.raises(SanitizerError) as ei:
+        su.drain(eng, step=lambda: eng.step_chunk(4))
+    assert ei.value.invariant == "seq-len"
+    assert ei.value.site == "step_chunk"
+    assert "ledger" in str(ei.value)
+
+
+def test_leak_ref_caught_paged(model):
+    """A page refcount with no recounted owner (slot block tables +
+    prefix-store retains) is a leak: the page can never free."""
+    eng = _engine(model, paged=True, rates={"leak_ref": 1.0})
+    eng.add_request(_prompts(model._tiny_cfg)[0], 8)
+    with pytest.raises(SanitizerError) as ei:
+        su.drain(eng, step=lambda: eng.step_chunk(4))
+    assert ei.value.invariant == "page-conservation"
+    assert "owner" in str(ei.value)
+
+
+def test_leak_ref_contiguous_leaks_slot(model):
+    """Contiguous mode has no page pool: the same site leaks a slot
+    off the free heap instead — the slot-heap partition invariant."""
+    eng = _engine(model, paged=False, rates={"leak_ref": 1.0},
+                  max_slots=3)
+    eng.add_request(_prompts(model._tiny_cfg)[0], 8)
+    with pytest.raises(SanitizerError) as ei:
+        su.drain(eng, step=lambda: eng.step_chunk(4))
+    assert ei.value.invariant == "slot-heap"
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_scale_desync_caught_int8(model, paged, serving_flags):
+    """int8 pools: shearing a dequant-scale array off its payload pool
+    (adopt/COW/rebuild bookkeeping gone wrong) trips shape agreement."""
+    serving_flags({"kv_cache_dtype": "int8"})
+    eng = _engine(model, paged, rates={"scale_desync": 1.0},
+                  cache_dtype="int8")
+    eng.add_request(_prompts(model._tiny_cfg)[0], 8)
+    with pytest.raises(SanitizerError) as ei:
+        su.drain(eng, step=lambda: eng.step_chunk(4))
+    assert ei.value.invariant == "scale-pool"
+    assert "scale" in str(ei.value)
+
+
+def test_direct_corruption_without_injector(model):
+    """The checker judges STATE, not the injector: hand-corrupting the
+    pool is caught by an explicit check_tick call too."""
+    eng = _engine(model, paged=True)
+    eng.add_request(_prompts(model._tiny_cfg)[0], 6)
+    eng.step_chunk(4)
+    slot = next(iter(eng._slot_req))
+    page = eng.pool.pages_of[slot][0]
+    eng.pool.ref[page] += 1  # leak: one refcount, no owner
+    with pytest.raises(SanitizerError) as ei:
+        eng._san.check_tick(eng, "manual")
+    assert ei.value.invariant == "page-conservation"
+    assert ei.value.site == "manual"
+
+
+def test_corrupt_sites_are_appended_not_inserted():
+    """Corruption sites extend SITES at the END: per-site RNG streams
+    seed on the site INDEX, so appending preserves every pre-existing
+    chaos schedule (seeded storms stay reproducible across versions)."""
+    from paddle_tpu.inference.resilience import SITES
+
+    assert SITES[:4] == ("step", "nan", "latency", "pool")
+    assert tuple(SITES[4:]) == CORRUPT_SITES
+    # and a legacy spec still parses while new sites rate-limit to 0
+    inj = FaultInjector("step:0.5,seed:3")
+    assert all(inj.rates[s] == 0.0 for s in CORRUPT_SITES)
+
+
+# ---------------------------------------------------------------------------
+# 2. off = identity; on = zero new programs, identical outputs
+# ---------------------------------------------------------------------------
+def test_sanitize_off_is_identity_and_on_changes_nothing(
+        model, compile_counter):
+    """Flag off constructs NO sanitizer (hooks are one identity check);
+    flag on changes neither greedy outputs nor the compiled-program
+    set — the telemetry no-op contract, applied to the sanitizer."""
+    prompts = _prompts(model._tiny_cfg)
+    saved = F.flag("sanitize")
+    try:
+        F.set_flags({"sanitize": False})
+        eng_off = _engine(model, paged=True)
+        assert eng_off._san is None
+        outs_off = [r.output for r in eng_off.run(prompts, 12)]
+        base = compile_counter()
+        F.set_flags({"sanitize": True})
+        eng_on = _engine(model, paged=True)
+        assert eng_on._san is not None
+        outs_on = [r.output for r in eng_on.run(prompts, 12)]
+    finally:
+        F.set_flags({"sanitize": saved})
+    assert outs_on == outs_off
+    # each engine compiles its own closures: the sanitized engine's
+    # delta must be exactly the clean engine's program set again —
+    # the sanitizer adds ZERO compiled programs
+    after = compile_counter()
+    delta = {k: after[k] - base.get(k, 0) for k in after
+             if after[k] - base.get(k, 0)}
+    assert delta == base, (
+        f"sanitized engine's program set {delta} != clean set {base}")
+
+
+# ---------------------------------------------------------------------------
+# 3. thread ownership
+# ---------------------------------------------------------------------------
+def _run_in_thread(fn):
+    box = {}
+
+    def tgt():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001
+            box["exc"] = e
+
+    t = threading.Thread(target=tgt)
+    t.start()
+    t.join(10)
+    return box
+
+
+def test_foreign_thread_reads(model):
+    """Registered copy-on-read readers pass from a scrape thread;
+    an unregistered read of scheduler state is flagged, naming the
+    registration path."""
+    eng = _engine(model, paged=True)
+    eng.add_request(_prompts(model._tiny_cfg)[0], 6)
+    eng.step_chunk(2)  # records the owner thread
+    ok = _run_in_thread(lambda: (eng.backpressure(),
+                                 eng.metrics_snapshot(),
+                                 eng.slo_snapshot()))
+    assert "exc" not in ok, ok.get("exc")
+    bad = _run_in_thread(lambda: eng._san.check_read("raw_state_peek"))
+    assert isinstance(bad.get("exc"), SanitizerError)
+    assert bad["exc"].invariant == "thread-ownership"
+    assert "SAFE_READS" in str(bad["exc"])
+
+
+def test_second_thread_tick_flagged(model):
+    eng = _engine(model, paged=False)
+    eng.add_request(_prompts(model._tiny_cfg)[0], 6)
+    eng.step_chunk(2)
+    bad = _run_in_thread(lambda: eng.step_chunk(2))
+    assert isinstance(bad.get("exc"), SanitizerError)
+    assert bad["exc"].invariant == "scheduler-ownership"
+
+
+def test_safe_reads_exist_on_engine(model):
+    """SAFE_READS is a registry of real engine readers — a renamed
+    snapshot method must update the registration (and the ptlint CC
+    scope) with it."""
+    eng = _engine(model, paged=False)
+    for name in SAFE_READS:
+        assert callable(getattr(eng, name)), name
+
+
+# ---------------------------------------------------------------------------
+# sanitized chaos storm: recovery machinery keeps every invariant
+# ---------------------------------------------------------------------------
+def test_sanitized_chaos_storm_keeps_invariants(model):
+    """PR 7's quarantine/replay under a step+NaN storm, with the
+    checker on at every tick: recovery must leave conservation intact
+    each tick (this is the lane-level claim `pytest -m chaos` now
+    makes on every storm)."""
+    eng = _engine(model, paged=True)
+    assert eng._san is not None
+    eng._injector = FaultInjector("step:0.2,nan:0.1", seed=11)
+    for p in _prompts(model._tiny_cfg, 3):
+        eng.add_request(p, 8)
+    su.drain(eng, step=lambda: eng.step_chunk(4))
+    assert eng.resilience_stats["recoveries"] > 0
+    # post-storm: pool fully recovered (active slots drained)
+    eng._san.check_tick(eng, "post-storm")
+    assert not eng.active.any()
