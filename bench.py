@@ -562,6 +562,15 @@ def _compact_line(result):
                     k: qs.get(k) for k in
                     ("modeled_int8_w_x", "modeled_compound_x",
                      "outputs_match", "first_divergence")}
+            # replicated-serving scalars (serve7b): the failover count
+            # plus the outputs_match bit carry the fleet's determinism
+            # claim on the ledger with the storm's wall overhead
+            rf = (r.get("extra") or {}).get("replica_failover") or {}
+            if rf:
+                row["replica_failover"] = {
+                    k: rf.get(k) for k in
+                    ("failovers", "outputs_match",
+                     "failover_overhead_pct")}
             keep["secondary"][name] = row
     out["extra"] = keep
 
@@ -572,6 +581,7 @@ def _compact_line(result):
             row.pop("error", None)
             row.pop("goodput", None)
             row.pop("quant", None)
+            row.pop("replica_failover", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:
         # the capture pointer survives the final shed: a truncated CPU

@@ -918,6 +918,73 @@ def _fault_recovery_scenario(model, base_ecfg, tpu):
     return out
 
 
+def _replica_failover_scenario(model, base_ecfg, tpu):
+    """Replicated-serving chaos A/B (the fleet's recovery-overhead
+    capture): the same greedy workload runs through a 2-replica
+    ``EngineRouter`` clean and under a seeded replica-kill storm
+    (whole-replica crashes + hangs at the router's tick seam). The
+    storm arm reclaims each dead replica's in-flight requests from
+    the host token ledger and replays them through the survivor's
+    existing prefill program; reported are tok/s per arm, the
+    failover/reclaim/replay counts, breaker opens, the wall overhead,
+    and — the quality claim — whether the two arms' greedy outputs
+    were bit-identical (placement- and failover-invariant decoding).
+    The injector is attached AFTER warm-up so a crash never lands
+    inside a first-time compile and bills it as failover time; retry
+    bounds are raised so the A/B measures failover, not retry
+    exhaustion."""
+    import dataclasses
+
+    from paddle_tpu.inference.resilience import FaultInjector
+    from paddle_tpu.inference.router import EngineRouter
+
+    if tpu:
+        # two resident KV pools: halve the per-replica footprint so
+        # the fleet + int8 weights fit HBM next to each other
+        ecfg = dataclasses.replace(base_ecfg, max_slots=4,
+                                   max_len=512, max_retries=100)
+        n_requests, new_tokens, max_chunk = 8, 24, 8
+    else:
+        ecfg = dataclasses.replace(base_ecfg, max_retries=100)
+        n_requests, new_tokens, max_chunk = 4, 6, 2
+    rng = np.random.default_rng(29)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab, (int(rng.integers(8, 24)),))
+               for _ in range(n_requests)]
+    spec = "replica_crash:0.12,replica_hang:0.06,seed:23"
+    out = {"fault_spec": spec, "n_replicas": 2,
+           "n_requests": n_requests, "new_tokens": new_tokens}
+    outputs = {}
+    for arm in ("clean", "storm"):
+        router = EngineRouter(model, ecfg, n_replicas=2,
+                              breaker_cooldown=3, hang_ticks=2)
+        router.run(prompts[:2], max_new_tokens=2, max_chunk=max_chunk)
+        if arm == "storm":
+            router._injector = FaultInjector(spec)
+        t0 = time.perf_counter()
+        reqs = router.run(prompts, new_tokens, max_chunk=max_chunk)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        fs = router.fleet_snapshot()
+        outputs[arm] = [r.output for r in reqs]
+        out[arm] = {
+            "tokens_per_sec": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "failovers": fs["failovers"],
+            "reclaimed": fs["reclaimed"],
+            "replayed": fs["replayed"],
+            "breaker_opens": fs["breaker_opens"],
+            "held": fs["held"],
+        }
+        router = None  # drop this arm's KV pools before the next builds
+    out["outputs_match"] = outputs["clean"] == outputs["storm"]
+    out["failovers"] = out["storm"]["failovers"]
+    clean_w, storm_w = out["clean"]["wall_s"], out["storm"]["wall_s"]
+    out["failover_overhead_pct"] = round(
+        (storm_w / clean_w - 1.0) * 100.0, 1) if clean_w else None
+    return out
+
+
 def _quant_scenario(base_ecfg, tpu):
     """Quantized-serving A/B: the SAME greedy workload served three
     ways — bf16 weights (baseline), int8 weight streaming, and
@@ -1086,6 +1153,7 @@ def bench_serve7b(tpu_diags):
     spec_ngram = _spec_ngram_scenario(model, ecfg, tpu)
     goodput = _goodput_scenario(model, ecfg, tpu)
     fault_recovery = _fault_recovery_scenario(model, ecfg, tpu)
+    replica_failover = _replica_failover_scenario(model, ecfg, tpu)
     quant = _quant_scenario(ecfg, tpu)
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
@@ -1137,6 +1205,7 @@ def bench_serve7b(tpu_diags):
         "spec_ngram": spec_ngram,
         "goodput_under_slo": goodput,
         "fault_recovery": fault_recovery,
+        "replica_failover": replica_failover,
         "quant": quant,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,

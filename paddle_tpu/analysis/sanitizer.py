@@ -48,6 +48,9 @@ from typing import Optional
 SAFE_READS = frozenset({
     "metrics_snapshot", "prefix_snapshot", "spec_snapshot",
     "slo_snapshot", "resilience_snapshot", "backpressure", "_tel_state",
+    # multi-engine router readers (router.py) — same copy-on-read
+    # contract, same CC001/CC002/CC003 static coverage
+    "fleet_snapshot",
 })
 
 
@@ -70,6 +73,9 @@ class EngineSanitizer:
     def __init__(self, engine=None):
         del engine  # checks read the engine per-call; no cycle held
         self._owner: Optional[int] = None
+        # failover count at the last full owner-map sweep (fleet
+        # checks only; terminal-entry resolution is failover-gated)
+        self._fleet_failovers_swept = -1
 
     # ---------------- thread ownership ----------------
     def note_tick(self, site: str):
@@ -241,6 +247,145 @@ class EngineSanitizer:
                         "block-table", site,
                         f"slot {s} block_tables[{i}] = {int(row[i])} "
                         "past its allocation (expect the sink id 0)")
+
+    # ---------------- fleet invariants (router) ----------------
+    def check_fleet(self, router, site: str = "fleet-tick"):
+        """The ROUTER-level invariant cross-replica failover must
+        preserve: every request id is owned by EXACTLY one place —
+        the router's own admission queue, ONE replica's queue, or ONE
+        replica's active slot — and a finished rid is never
+        simultaneously live anywhere. Dual ownership is precisely
+        what a buggy failover produces (the dead replica keeps a rid
+        its reclaim also re-admitted elsewhere: two engines then
+        decode the same request and its ledger forks). Also checks
+        the router's owner map: every LIVE rid's entry points at the
+        replica that actually holds it (per tick, O(live)), and —
+        after any failover mutated the map — every TERMINAL entry
+        resolves to a finish registry on the replica it names."""
+        owners: dict = {}
+        held_by: dict = {}  # rid -> replica idx actually holding it
+
+        def note(rid, where, idx=None):
+            owners.setdefault(rid, []).append(where)
+            if idx is not None:
+                held_by[rid] = idx
+
+        for req in list(router._queue):
+            note(req.rid, "router-queue")
+        for rep in list(router._replicas):
+            eng = rep.engine
+            for req in list(eng._queue):
+                note(req.rid, f"replica{rep.idx}-queue", rep.idx)
+            for req in list(eng._slot_req.values()):
+                note(req.rid, f"replica{rep.idx}-slot", rep.idx)
+        for rid, places in sorted(owners.items()):
+            if len(places) > 1:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"rid {rid} is owned by {len(places)} places at "
+                    f"once: {places} — failover must MOVE a request, "
+                    "never copy it")
+        # finished-vs-live and owner-map resolution run as O(1) dict
+        # membership probes against the finish registries: rebuilding
+        # a set of every rid the fleet EVER finished would cost
+        # O(total completed) per tick — quadratic over a sanitized
+        # soak — to answer questions about the handful of live rids
+        replicas = list(router._replicas)
+
+        def finished_at(rid):
+            if rid in router._finished:
+                return "router"
+            for rep in replicas:
+                if rid in rep.engine._finished:
+                    return f"replica{rep.idx}"
+            return None
+
+        for rid, places in sorted(owners.items()):
+            where = finished_at(rid)
+            if where is not None:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"rid {rid} is finished ({where}) AND still live "
+                    f"({places}) — a finished request must have left "
+                    "every queue and slot")
+        # live owner agreement, O(live): a replica-held rid must have
+        # an owner entry pointing at the replica that holds it; a
+        # router-held rid must have NONE (queued rids are absent from
+        # the map by design — _reclaim pops before re-queueing)
+        for rid, holder in sorted(held_by.items()):
+            ridx = router._owner.get(rid)
+            if ridx is None:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"rid {rid} is held by replica {holder} but "
+                    "absent from the router owner map — "
+                    "result()/cancel() cannot find it")
+            if ridx != holder:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"router owner map routes rid {rid} to "
+                    f"replica {ridx} but replica {holder} holds "
+                    "it — result()/cancel() would misroute")
+        for rid in owners:
+            if rid not in held_by and rid in router._owner:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"rid {rid} sits in the router hold queue but the "
+                    f"owner map routes it to replica "
+                    f"{router._owner[rid]} — cancel() would misroute")
+        # full owner-map resolution sweep (every TERMINAL entry
+        # resolves to a finish registry on the replica the map names)
+        # only after a FAILOVER mutated the map: placement only
+        # appends live entries (vetted above) and finish registries
+        # never shrink, so between failovers the sweep is a no-op —
+        # running it per tick would cost O(total completed) per tick,
+        # quadratic over a sanitized soak
+        n_failovers = router.fleet_stats["failovers"]
+        if n_failovers == self._fleet_failovers_swept:
+            return
+        self._fleet_failovers_swept = n_failovers
+        for rid in list(router._finished):
+            fin = next((rep.idx for rep in replicas
+                        if rid in rep.engine._finished), None)
+            if fin is not None:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"rid {rid} is finished at the router AND on "
+                    f"replica {fin} — a request must reach exactly "
+                    "one terminal registry")
+        for rid, ridx in list(router._owner.items()):
+            if rid in held_by:
+                continue  # live: vetted against its holder above
+            if rid in replicas[ridx].engine._finished:
+                # terminal exactly where the map says — but it must
+                # be terminal exactly ONCE: a second registry holding
+                # the same rid is double accounting (a reclaim that
+                # timed a victim out on the dead replica while the
+                # survivor also finished its replay)
+                dup = next(
+                    (f"replica{rep.idx}" for rep in replicas
+                     if rep.idx != ridx and rid in rep.engine._finished),
+                    "router" if rid in router._finished else None)
+                if dup is not None:
+                    raise SanitizerError(
+                        "rid-ownership", site,
+                        f"rid {rid} is finished on replica {ridx} "
+                        f"AND on {dup} — a request must reach exactly "
+                        "one terminal registry")
+                continue
+            fin = next((rep.idx for rep in replicas
+                        if rid in rep.engine._finished), None)
+            if fin is None:
+                raise SanitizerError(
+                    "rid-ownership", site,
+                    f"router owner map routes rid {rid} to replica "
+                    f"{ridx}, but no replica holds or finished it — "
+                    "the request leaked out of the fleet")
+            raise SanitizerError(
+                "rid-ownership", site,
+                f"rid {rid} finished on replica {fin} but the "
+                f"owner map says replica {ridx} — result() would "
+                "return None forever")
 
     # -- int8 scale pools mirror their payload --
     def _check_scale_shapes(self, engine, site):

@@ -220,6 +220,30 @@ define_flag("sanitize", False,
             "telemetry=off pattern); `pytest -m chaos` runs with it "
             "on. Host bookkeeping only — zero compiled programs, "
             "zero device syncs")
+define_flag("router_breaker_window", 16,
+            "multi-engine router: sliding window (fleet ticks) the "
+            "per-replica circuit breaker counts faults over — "
+            "router_breaker_trip faults inside it open the breaker "
+            "(the replica stops receiving traffic and its in-flight "
+            "requests fail over to survivors)")
+define_flag("router_breaker_trip", 3,
+            "multi-engine router: replica faults (failed ticks, hung "
+            "health probes, flaky probe verdicts) within the breaker "
+            "window that OPEN a replica's circuit breaker; a whole-"
+            "replica crash opens it immediately regardless")
+define_flag("router_breaker_cooldown", 8,
+            "multi-engine router: base open-state duration (fleet "
+            "ticks) before an open breaker admits a half-open canary "
+            "probe; successive opens multiply it by the "
+            "router_retry_schedule entries plus a seeded jitter "
+            "(deterministic per router seed + replica)")
+define_flag("router_retry_schedule", "1,2,4",
+            "multi-engine router: comma-separated cooldown "
+            "multipliers for successive breaker opens (the last entry "
+            "repeats) — with cooldown 8 the default backs off "
+            "8/16/32/32/... ticks. Deterministic: the only randomness "
+            "is a per-replica jitter drawn from a stream seeded on "
+            "(router seed, replica index)")
 define_flag("flash_attention_block_q", 1024,
             "Pallas flash-attention q block length (rows of q each "
             "kernel grid step keeps in VMEM; clamped to the padded "

@@ -354,11 +354,17 @@ from .resilience import (  # noqa: E402,F401
     FaultInjector,
     InjectedFault,
 )
+from .router import (  # noqa: E402,F401
+    CircuitBreaker,
+    EngineRouter,
+)
 from .serving import (  # noqa: E402,F401
     ContinuousBatchingEngine,
     EngineConfig,
     MetricsServer,
     Request,
+    build_request,
+    request_ledger,
     start_metrics_server,
 )
 from .spec_decode import (  # noqa: E402,F401

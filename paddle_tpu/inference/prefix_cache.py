@@ -86,6 +86,19 @@ class PagedPrefixStore:
             pages.append(page)
         return pages
 
+    def match_len(self, hashes: List[bytes]) -> int:
+        """Read-only peek at the longest cached prefix length (in
+        blocks) — NO LRU refresh, so a router probing every replica's
+        store for prefix affinity perturbs none of their eviction
+        orders. GIL-atomic membership tests only: safe to call off
+        the scheduler thread."""
+        n = 0
+        for h in hashes:
+            if h not in self._blocks:
+                break
+            n += 1
+        return n
+
     def insert(self, digest: bytes, page: int, pool) -> bool:
         """Pin ``page`` under ``digest`` (no-op if already cached —
         the original stays authoritative)."""
@@ -152,6 +165,15 @@ class ContigPrefixStore:
             self._blocks.move_to_end(h)
             out.append(ent)
         return out
+
+    def match_len(self, hashes: List[bytes]) -> int:
+        """Read-only peek (see ``PagedPrefixStore.match_len``)."""
+        n = 0
+        for h in hashes:
+            if h not in self._blocks:
+                break
+            n += 1
+        return n
 
     def insert(self, digest: bytes, k, v) -> bool:
         if self.max_blocks == 0:

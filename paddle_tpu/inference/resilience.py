@@ -48,11 +48,26 @@ SITES = ("step", "nan", "latency", "pool",
          # the engine's own bookkeeping (leaked page ref / desynced
          # scale pool / shrunk seq_len) so PT_FLAGS_sanitize runs can
          # prove the invariant checker catches real damage
-         "leak_ref", "scale_desync", "seq_shrink")
+         "leak_ref", "scale_desync", "seq_shrink",
+         # replica-level sites (router chaos): consulted by the
+         # multi-engine router's per-replica tick seam, never by the
+         # engine itself — replica_crash kills the whole replica
+         # (device state untrusted: slots reclaimed for cross-replica
+         # failover, caches rebuilt), replica_hang stalls it (the
+         # breaker opens on repeated no-progress health probes),
+         # probe_flaky flips one health-probe verdict (a single flake
+         # must NOT flap the breaker)
+         "replica_crash", "replica_hang", "probe_flaky")
 
 # the subset above that corrupts engine state instead of failing a
 # dispatch (the engine's _corrupt_point consults exactly these)
 CORRUPT_SITES = ("leak_ref", "scale_desync", "seq_shrink")
+
+# the subset the multi-engine router consults at its per-replica tick
+# seam (router.py); the engine never draws from these streams, so a
+# fleet spec like "replica_crash:0.05" leaves every engine-level
+# schedule untouched
+ROUTER_SITES = ("replica_crash", "replica_hang", "probe_flaky")
 
 # exception classes "auto" recovery treats as device/runtime failures
 # (recoverable by quarantine + replay) as opposed to host logic bugs

@@ -25,6 +25,7 @@ from __future__ import annotations
 import bisect
 import collections
 import heapq
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -199,6 +200,18 @@ SLO_CLASSES: Dict[str, Dict[str, float]] = {
 }
 
 
+def new_slo_bucket() -> Dict[str, int]:
+    """One per-class SLO accounting bucket. Engine- and fleet-level
+    ``slo_stats`` share this shape (the router's ``slo_snapshot``
+    merges replica buckets key-by-key), so a key added here reaches
+    both sides at once."""
+    return {
+        "met": 0, "violated": 0, "cancelled": 0,
+        "ttft_violations": 0, "tpot_violations": 0,
+        "timeouts": 0, "met_tokens": 0, "total_tokens": 0,
+    }
+
+
 @dataclass
 class Request:
     rid: int
@@ -246,6 +259,127 @@ class Request:
     # and the engine's acceptance stats)
     _spec_proposed: int = 0
     _spec_accepted: int = 0
+
+
+def build_request(rid: int, prompt, max_new_tokens: int = 32,
+                  eos_token_id: Optional[int] = None,
+                  temperature: Optional[float] = None,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None,
+                  greedy: Optional[bool] = None,
+                  slo: Optional[str] = None,
+                  ttft_target_ms: Optional[float] = None,
+                  tpot_target_ms: Optional[float] = None,
+                  deadline_ms: Optional[float] = None,
+                  max_retries: Optional[int] = None,
+                  *, max_len: int) -> Request:
+    """Validate request arguments and construct a :class:`Request` —
+    THE admission validation, factored out of ``add_request`` so the
+    multi-engine router (``router.py``) applies the exact same checks
+    when it builds a request before picking a replica. ``rid`` is the
+    caller's: the engine passes its own counter, the router a
+    fleet-unique one."""
+    prompt = np.asarray(prompt).reshape(-1)
+    if prompt.size == 0:
+        # an empty prompt would "sample" from the last PADDED
+        # position (last_idx = -1) — garbage logits, not a request
+        raise ValueError("add_request needs a non-empty prompt")
+    if prompt.size + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt({prompt.size}) + max_new_tokens({max_new_tokens}) "
+            f"exceeds max_len={max_len}")
+    if temperature is not None and temperature <= 0:
+        raise ValueError(f"temperature must be > 0; got {temperature}")
+    if top_k is not None and top_k < 0:
+        raise ValueError(f"top_k must be >= 0; got {top_k}")
+    if top_p is not None and not 0 < top_p <= 1:
+        raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+    if slo is None and (ttft_target_ms is not None
+                        or tpot_target_ms is not None):
+        slo = "custom"  # explicit targets are an SLO by themselves
+    if slo is not None and slo != "custom" and slo not in SLO_CLASSES:
+        raise ValueError(
+            f"slo must be one of {sorted(SLO_CLASSES)} (or custom "
+            f"targets); got {slo!r}")
+    if slo == "custom" and ttft_target_ms is None \
+            and tpot_target_ms is None:
+        # a targetless "custom" request would trivially count as
+        # met every time — goodput inflation, not accounting
+        raise ValueError(
+            'slo="custom" needs ttft_target_ms and/or '
+            "tpot_target_ms")
+    for tname, t in (("ttft_target_ms", ttft_target_ms),
+                     ("tpot_target_ms", tpot_target_ms)):
+        if t is not None and t <= 0:
+            raise ValueError(f"{tname} must be > 0; got {t}")
+    defaults = SLO_CLASSES.get(slo, {})
+    if slo is not None:
+        if ttft_target_ms is None:
+            ttft_target_ms = defaults.get("ttft_target_ms")
+        if tpot_target_ms is None:
+            tpot_target_ms = defaults.get("tpot_target_ms")
+        if deadline_ms is None:
+            deadline_ms = defaults.get("deadline_ms")
+    if deadline_ms is not None:
+        if deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0; got {deadline_ms}")
+        if deadline_ms < 1.0:
+            raise ValueError(
+                f"deadline_ms={deadline_ms} is shorter than a "
+                f"single scheduler step can honor (deadlines are "
+                f"checked once per step; minimum 1 ms)")
+    if max_retries is not None and (
+            isinstance(max_retries, bool)
+            or not isinstance(max_retries, (int, np.integer))
+            or max_retries < 0):
+        raise ValueError(
+            f"max_retries must be a non-negative int; got "
+            f"{max_retries!r}")
+    req = Request(rid, prompt, max_new_tokens, eos_token_id,
+                  temperature=temperature, top_k=top_k, top_p=top_p,
+                  greedy=greedy, slo=slo,
+                  ttft_target_ms=ttft_target_ms,
+                  tpot_target_ms=tpot_target_ms,
+                  deadline_ms=deadline_ms, max_retries=max_retries,
+                  _submit_t=time.perf_counter())
+    if deadline_ms is not None:
+        req._deadline_t = req._submit_t + deadline_ms / 1e3
+    return req
+
+
+def request_ledger(req: Request) -> dict:
+    """Serialize a request's HOST TOKEN LEDGER — the replay source of
+    truth — into a plain dict another engine can re-admit via
+    ``admit_ledger``: prompt + every generated token, sampling params,
+    SLO targets and the ABSOLUTE deadline instant, plus the original
+    submit/admit timestamps and TTFT so SLO accounting on the new
+    engine stays the honest wall from FIRST submission. Timestamps are
+    ``perf_counter`` values: the handoff contract is in-process (the
+    router's replicas) or same-host."""
+    return {
+        "rid": int(req.rid),
+        "prompt": [int(t) for t in req.prompt],
+        "output": [int(t) for t in req.output],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": req.eos_token_id,
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "greedy": req.greedy,
+        "slo": req.slo,
+        "ttft_target_ms": req.ttft_target_ms,
+        "tpot_target_ms": req.tpot_target_ms,
+        # absolute instant (perf_counter seconds; None = no deadline):
+        # a handed-off request keeps its ORIGINAL budget — the move
+        # must not grant it a fresh clock
+        "deadline_t": req._deadline_t or None,
+        "max_retries": req.max_retries,
+        "retries": int(req._retries),
+        "ttft_ms": req.ttft_ms,
+        "submit_t": req._submit_t,
+        "admit_t": req._admit_t,
+    }
 
 
 class ContinuousBatchingEngine:
@@ -389,6 +523,11 @@ class ContinuousBatchingEngine:
         self._slot_req: Dict[int, Request] = {}
         self._queue: collections.deque = collections.deque()
         self._next_rid = 0
+        # rid mint/advance is a read-modify-write shared between
+        # producer-thread add_request callers and the scheduler's
+        # handoff paths — unlocked, two producers could mint the
+        # same rid and their finish records would collide
+        self._rid_lock = threading.Lock()
         self._finished: Dict[int, Request] = {}
         self._key = jax.random.PRNGKey(cfg.seed)
 
@@ -630,83 +769,108 @@ class ContinuousBatchingEngine:
         ``max_retries``: per-request bound on crash-recovery replay
         re-queues (default ``EngineConfig.max_retries``); past it the
         request finishes with ``finish_reason="failed"``."""
-        prompt = np.asarray(prompt).reshape(-1)
-        if prompt.size == 0:
-            # an empty prompt would "sample" from the last PADDED
-            # position (last_idx = -1) — garbage logits, not a request
-            raise ValueError("add_request needs a non-empty prompt")
-        if prompt.size + max_new_tokens > self.cfg.max_len:
-            raise ValueError(
-                f"prompt({prompt.size}) + max_new_tokens({max_new_tokens}) "
-                f"exceeds max_len={self.cfg.max_len}")
-        if temperature is not None and temperature <= 0:
-            raise ValueError(f"temperature must be > 0; got {temperature}")
-        if top_k is not None and top_k < 0:
-            raise ValueError(f"top_k must be >= 0; got {top_k}")
-        if top_p is not None and not 0 < top_p <= 1:
-            raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
-        if slo is None and (ttft_target_ms is not None
-                            or tpot_target_ms is not None):
-            slo = "custom"  # explicit targets are an SLO by themselves
-        if slo is not None and slo != "custom" and slo not in SLO_CLASSES:
-            raise ValueError(
-                f"slo must be one of {sorted(SLO_CLASSES)} (or custom "
-                f"targets); got {slo!r}")
-        if slo == "custom" and ttft_target_ms is None \
-                and tpot_target_ms is None:
-            # a targetless "custom" request would trivially count as
-            # met every time — goodput inflation, not accounting
-            raise ValueError(
-                'slo="custom" needs ttft_target_ms and/or '
-                "tpot_target_ms")
-        for tname, t in (("ttft_target_ms", ttft_target_ms),
-                         ("tpot_target_ms", tpot_target_ms)):
-            if t is not None and t <= 0:
-                raise ValueError(f"{tname} must be > 0; got {t}")
-        defaults = SLO_CLASSES.get(slo, {})
-        if slo is not None:
-            if ttft_target_ms is None:
-                ttft_target_ms = defaults.get("ttft_target_ms")
-            if tpot_target_ms is None:
-                tpot_target_ms = defaults.get("tpot_target_ms")
-            if deadline_ms is None:
-                deadline_ms = defaults.get("deadline_ms")
-        if deadline_ms is not None:
-            if deadline_ms <= 0:
-                raise ValueError(
-                    f"deadline_ms must be > 0; got {deadline_ms}")
-            if deadline_ms < 1.0:
-                raise ValueError(
-                    f"deadline_ms={deadline_ms} is shorter than a "
-                    f"single scheduler step can honor (deadlines are "
-                    f"checked once per step; minimum 1 ms)")
-        if max_retries is not None and (
-                isinstance(max_retries, bool)
-                or not isinstance(max_retries, (int, np.integer))
-                or max_retries < 0):
-            raise ValueError(
-                f"max_retries must be a non-negative int; got "
-                f"{max_retries!r}")
-        req = Request(self._next_rid, prompt, max_new_tokens, eos_token_id,
-                      temperature=temperature, top_k=top_k, top_p=top_p,
-                      greedy=greedy, slo=slo,
-                      ttft_target_ms=ttft_target_ms,
-                      tpot_target_ms=tpot_target_ms,
-                      deadline_ms=deadline_ms, max_retries=max_retries,
-                      _submit_t=time.perf_counter())
-        if deadline_ms is not None:
-            req._deadline_t = req._submit_t + deadline_ms / 1e3
-        self._next_rid += 1
+        req = build_request(
+            0, prompt, max_new_tokens, eos_token_id,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            greedy=greedy, slo=slo, ttft_target_ms=ttft_target_ms,
+            tpot_target_ms=tpot_target_ms, deadline_ms=deadline_ms,
+            max_retries=max_retries, max_len=self.cfg.max_len)
+        # mint AFTER validation (a rejected request burns no rid) and
+        # under the lock: concurrent producer threads reading the
+        # counter before either advanced it would share a rid
+        with self._rid_lock:
+            req.rid = self._next_rid
+            self._next_rid += 1
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> int:
+        """Enqueue an externally built, NEVER-RUN :class:`Request`
+        directly — the router's first-placement fast path (the caller
+        owns the rid space and already validated via
+        ``build_request``). Requests carrying history (failover
+        replay, drain handoff) move between engines via
+        ``admit_ledger`` instead, which rebuilds state from the token
+        ledger."""
+        with self._rid_lock:
+            self._next_rid = max(self._next_rid, req.rid + 1)
         self._queue.append(req)
         if self._tel is not None:
             self._tel.on_submit(len(self._queue))
         tr = self._tracer
         if tr is not None and tr.want_request(req.rid):
             tr.request(req.rid, "queued", t0=req._submit_t,
-                       prompt_tokens=int(prompt.size),
-                       max_new_tokens=int(max_new_tokens),
-                       slo=slo or "")
+                       prompt_tokens=int(req.prompt.size),
+                       max_new_tokens=int(req.max_new_tokens),
+                       slo=req.slo or "")
         return req.rid
+
+    def admit_ledger(self, ledger: dict) -> int:
+        """Re-admit a request handed off from ANOTHER engine — the
+        receiving half of the handoff API (``drain()['unfinished']`` /
+        the router's cross-replica failover). The ledger's generated
+        tokens are host-side truth, so admission replays
+        prompt+history through the existing ``[slots, C]`` chunked
+        prefill program (``_prefill_ids``) and greedy decoding
+        continues bit-identically; the ORIGINAL submit/admit instants,
+        TTFT and absolute deadline carry over, so SLO accounting never
+        resets across the move. The caller owns the rid space
+        (fleet-unique rids) — a rid this engine already knows is
+        rejected, the dual-ownership the fleet sanitizer forbids."""
+        rid = int(ledger["rid"])
+        known = rid in self._finished
+        if not known:
+            try:
+                known = any(
+                    r.rid == rid for r in list(self._queue)) \
+                    or any(r.rid == rid
+                           for r in list(self._slot_req.values()))
+            except RuntimeError:
+                # a producer-thread handoff racing the scheduler's own
+                # structure mutation: the uniqueness guard is
+                # best-effort off-thread — true dual ownership is
+                # still caught by the fleet sanitizer at the next tick
+                known = False
+        if known:
+            raise ValueError(
+                f"admit_ledger: rid {rid} is already owned by this "
+                "engine (queued, active, or finished) — a handoff "
+                "must MOVE a request, never copy it")
+        req = build_request(
+            rid, np.asarray(ledger["prompt"], np.int64),
+            int(ledger["max_new_tokens"]), ledger.get("eos_token_id"),
+            temperature=ledger.get("temperature"),
+            top_k=ledger.get("top_k"), top_p=ledger.get("top_p"),
+            greedy=ledger.get("greedy"), slo=ledger.get("slo"),
+            ttft_target_ms=ledger.get("ttft_target_ms"),
+            tpot_target_ms=ledger.get("tpot_target_ms"),
+            max_retries=ledger.get("max_retries"),
+            max_len=self.cfg.max_len)
+        req.output = [int(t) for t in ledger.get("output", ())]
+        req.ttft_ms = ledger.get("ttft_ms")
+        req._retries = int(ledger.get("retries", 0))
+        # original instants win over build_request's fresh stamps: the
+        # move must not shrink queue-wait out of TTFT or grant a fresh
+        # deadline clock
+        if ledger.get("submit_t"):
+            req._submit_t = float(ledger["submit_t"])
+        if ledger.get("admit_t"):
+            req._admit_t = float(ledger["admit_t"])
+        req._deadline_t = float(ledger.get("deadline_t") or 0.0)
+        # keep the local counter ahead of adopted rids so standalone
+        # add_request on this engine can never collide with a handoff
+        with self._rid_lock:
+            self._next_rid = max(self._next_rid, rid + 1)
+        self._queue.append(req)
+        if self._tel is not None:
+            self._tel.on_submit(len(self._queue))
+        tr = self._tracer
+        if tr is not None and tr.want_request(rid):
+            tr.request(rid, "queued", t0=req._submit_t,
+                       prompt_tokens=int(req.prompt.size),
+                       max_new_tokens=int(req.max_new_tokens),
+                       slo=req.slo or "", handoff=True,
+                       replayed_tokens=len(req.output))
+        return rid
 
     def _req_greedy(self, req: Request) -> bool:
         if req.greedy is not None:
@@ -1796,11 +1960,7 @@ class ContinuousBatchingEngine:
     def _slo_bucket(self, slo: str) -> Dict[str, int]:
         st = self.slo_stats.get(slo)
         if st is None:
-            st = self.slo_stats[slo] = {
-                "met": 0, "violated": 0, "cancelled": 0,
-                "ttft_violations": 0, "tpot_violations": 0,
-                "timeouts": 0, "met_tokens": 0, "total_tokens": 0,
-            }
+            st = self.slo_stats[slo] = new_slo_bucket()
         return st
 
     def _finish_accounting(self, req: Request, reason: str):
@@ -2193,8 +2353,17 @@ class ContinuousBatchingEngine:
         ``deadline_ms``, past which the stragglers finish with reason
         ``"timeout"`` and their slots/pages/prefix refs are provably
         freed. ``/healthz`` reports ``draining`` (503) for the
-        duration and after, until ``resume()``. Returns a summary
-        dict."""
+        duration and after, until ``resume()``.
+
+        Returns a summary dict whose ``"unfinished"`` entry carries
+        the HANDOFF PAYLOAD: one :func:`request_ledger` per request
+        that did not finish here — deadline-expired stragglers first
+        (ledger captured BEFORE their timeout teardown), then the
+        still-queued fresh requests in queue order. A caller (the
+        router's rebalance/failover path, or any operator script) can
+        re-admit each ledger elsewhere via ``admit_ledger`` and the
+        request continues bit-identically with its original TTFT/SLO
+        clock."""
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(
                 f"deadline_ms must be > 0; got {deadline_ms}")
@@ -2208,12 +2377,17 @@ class ContinuousBatchingEngine:
         t_end = (None if deadline_ms is None
                  else time.perf_counter() + deadline_ms / 1e3)
         expired = 0
+        unfinished: List[dict] = []
         while self.active.any() or self._drain_pending():
             if t_end is not None and time.perf_counter() >= t_end:
                 for slot in range(self.cfg.max_slots):
                     if not self.active[slot]:
                         continue
                     req = self._slot_req[slot]
+                    # ledger BEFORE teardown: the straggler times out
+                    # HERE, but its history survives in the payload so
+                    # a caller may still re-admit it elsewhere
+                    unfinished.append(request_ledger(req))
                     self._release_slot(slot)
                     self.resilience_stats["timeouts"] += 1
                     self._finish_request(req, "timeout")
@@ -2225,17 +2399,24 @@ class ContinuousBatchingEngine:
                         self._queue.remove(req)
                     except ValueError:
                         continue
+                    unfinished.append(request_ledger(req))
                     self.resilience_stats["timeouts"] += 1
                     self._finish_request(req, "timeout")
                     expired += 1
                 break
             self.step_chunk(max_chunk)
+        # fresh requests the closed admission gate kept queued: theirs
+        # is the other half of the handoff payload (they stay queued
+        # here too, for a resume() — re-admitting one elsewhere makes
+        # cancelling it here the caller's job)
+        unfinished.extend(request_ledger(r) for r in list(self._queue))
         if self._tracer is not None:
             self._tracer.engine_event(
                 "drain_end", expired=expired, queued=len(self._queue))
         return {"drained": True, "expired": expired,
                 "active": int(self.active.sum()),
-                "queued": len(self._queue)}
+                "queued": len(self._queue),
+                "unfinished": unfinished}
 
     def resume(self):
         """Leave the draining state: admission restarts on the next
@@ -2959,6 +3140,19 @@ class ContinuousBatchingEngine:
         if self._tel is not None:
             self._tel.window_reset()
 
+    def prefix_affinity_tokens(self, hashes: List[bytes]) -> int:
+        """Read-only prefix-affinity probe for the multi-engine
+        router: how many leading prompt tokens of the rolling
+        block-hash chain this engine's prefix store already holds.
+        Pure peek — no LRU refresh, no adoption, no device traffic —
+        so probing every replica before routing perturbs none of
+        them. 0 when the store is off or degradation disabled it
+        (min_service: adoption wouldn't happen anyway, so affinity
+        must not steer traffic at pages the replica won't share)."""
+        if self._prefix is None or self._prefix_disabled():
+            return 0
+        return self._prefix.match_len(hashes) * self._prefix_block
+
 
 # ---------------------------------------------------------------------------
 # /metrics + /healthz exposition (parity: FastDeploy-style serving
@@ -3009,7 +3203,14 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
     the engine is draining, so a router can drain the replica) and
     ``/trace`` (the engine's lifecycle tracer as Chrome trace-event
     JSON, Perfetto-loadable; 404 when tracing is off) on a daemon
-    thread. Returns a :class:`MetricsServer` handle; read
+    thread.
+
+    Also accepts an :class:`~paddle_tpu.inference.router.EngineRouter`
+    as ``engine``: the router exposes the same ``backpressure()`` /
+    ``metrics_snapshot()`` surface, so ``/healthz`` becomes the
+    FLEET-aggregate readiness (503 only when no replica can take
+    traffic) and ``/trace`` serves the router's route/failover/breaker
+    event stream. Returns a :class:`MetricsServer` handle; read
     ``handle.server_address`` for the bound port (``port=0`` picks a
     free one), call ``handle.shutdown()`` for a clean stop (thread
     joined, socket closed)."""
@@ -3045,8 +3246,12 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                         payload["engine"] = engine.metrics_snapshot()
                         # degraded is NOT a readiness failure: the
                         # replica still serves (shed/throttled) — a
-                        # router reads the bit to deprioritize it
+                        # router reads the bit to deprioritize it,
+                        # and the numeric RUNG to rank replicas (a
+                        # shed_batch replica beats a min_service one)
                         payload["degraded"] = bool(bp.get("degraded"))
+                        payload["degradation_level"] = int(
+                            bp.get("degradation_level", 0))
                         if bp.get("draining"):
                             # drain() in progress: in-flight requests
                             # still complete, but a router must stop

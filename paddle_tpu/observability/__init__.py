@@ -26,5 +26,5 @@ from .registry import (  # noqa: F401
     get_registry,
     global_registry,
 )
-from .serve import ServingTelemetry  # noqa: F401
+from .serve import RouterTelemetry, ServingTelemetry  # noqa: F401
 from .train import TrainTelemetry, record_scalars  # noqa: F401

@@ -363,3 +363,134 @@ class ServingTelemetry:
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)
         self._kv_peak.set(0.0, **lab)
+
+
+_ROUTER_SEQ = itertools.count()
+
+
+class RouterTelemetry:
+    """Fleet front-door telemetry for the multi-engine router
+    (``inference/router.py``): per-replica routing/failover counters
+    and breaker-state gauges, correlated to each replica engine's own
+    ``pt_serve_*`` series by the shared process registry. All hooks
+    are host bookkeeping the router already holds — zero device
+    traffic."""
+
+    def __init__(self):
+        reg = get_registry()
+        self.router_id = str(next(_ROUTER_SEQ))
+        L = ("router",)
+        LR = ("router", "replica")
+        self._routed = reg.counter(
+            "pt_router_requests_routed_total",
+            "requests placed on a replica by the front door", LR)
+        self._affinity = reg.counter(
+            "pt_router_affinity_routed_total",
+            "placements steered by prefix affinity (the chosen "
+            "replica's store already held >= 1 prompt block)", L)
+        self._sheds = reg.counter(
+            "pt_router_requests_held_total",
+            "admissions the router held in its own queue because no "
+            "replica was routable (all saturated, draining, or "
+            "breaker-open) — fleet-level shedding, deferral not drop",
+            L)
+        self._failovers = reg.counter(
+            "pt_router_failovers_total",
+            "whole-replica failure events (crash, hang-opened "
+            "breaker, fault-opened breaker) that triggered "
+            "cross-replica failover", LR)
+        self._reclaimed = reg.counter(
+            "pt_router_reclaimed_requests_total",
+            "in-flight + queued requests reclaimed from a failed "
+            "replica's host token ledger", LR)
+        self._replayed = reg.counter(
+            "pt_router_replayed_requests_total",
+            "reclaimed requests re-admitted onto a surviving replica "
+            "for deterministic ledger replay", L)
+        self._held_timeouts = reg.counter(
+            "pt_router_requests_timeout_total",
+            "router-held requests whose deadline expired before any "
+            "replica could take them (engine-side timeouts count "
+            "under pt_serve_requests_timeout_total)", L)
+        self._held_cancels = reg.counter(
+            "pt_router_requests_cancelled_total",
+            "router-held requests cancelled before placement "
+            "(engine-side cancels count under "
+            "pt_serve_requests_cancelled_total)", L)
+        self._breaker_opens = reg.counter(
+            "pt_router_breaker_opens_total",
+            "circuit-breaker open transitions per replica", LR)
+        self._breaker_state = reg.gauge(
+            "pt_router_breaker_state",
+            "per-replica breaker state: 0 closed, 1 open, 2 half-open "
+            "(canary)", LR)
+        self._routable = reg.gauge(
+            "pt_router_replicas_routable",
+            "replicas currently accepting new traffic (breaker "
+            "closed, not draining)", L)
+        self._qdepth = reg.gauge(
+            "pt_router_queue_depth",
+            "requests held at the router awaiting a routable replica",
+            L)
+
+    def _lab(self) -> dict:
+        return {"router": self.router_id}
+
+    def on_route(self, replica: int, affinity: bool):
+        self._routed.inc(router=self.router_id, replica=str(replica))
+        if affinity:
+            self._affinity.inc(**self._lab())
+
+    def on_hold(self, queue_depth: int):
+        self._sheds.inc(**self._lab())
+        self._qdepth.set(queue_depth, **self._lab())
+
+    def on_failover(self, replica: int, reclaimed: int):
+        lab = dict(self._lab(), replica=str(replica))
+        self._failovers.inc(**lab)
+        if reclaimed > 0:
+            self._reclaimed.inc(reclaimed, **lab)
+
+    def on_replay(self, n: int = 1):
+        self._replayed.inc(n, **self._lab())
+
+    def on_held_timeout(self):
+        self._held_timeouts.inc(**self._lab())
+
+    def on_held_cancel(self):
+        self._held_cancels.inc(**self._lab())
+
+    def on_breaker(self, replica: int, state: int, opened: bool):
+        lab = dict(self._lab(), replica=str(replica))
+        self._breaker_state.set(state, **lab)
+        if opened:
+            self._breaker_opens.inc(**lab)
+
+    def on_fleet_state(self, routable: int, queue_depth: int):
+        lab = self._lab()
+        self._routable.set(routable, **lab)
+        self._qdepth.set(queue_depth, **lab)
+
+    def _sum(self, metric) -> float:
+        """Total over this router's per-replica series (``series()``
+        copies under the registry lock — safe from any thread)."""
+        i = metric.label_names.index("router")
+        return sum(v for k, v in metric.series().items()
+                   if k[i] == self.router_id)
+
+    def snapshot(self) -> dict:
+        lab = self._lab()
+        return {
+            "router": self.router_id,
+            "routed": self._sum(self._routed),
+            "affinity_routed": self._affinity.value(**lab),
+            "held": self._sheds.value(**lab),
+            "failovers": self._sum(self._failovers),
+            "reclaimed": self._sum(self._reclaimed),
+            "replayed": self._replayed.value(**lab),
+            "held_timeouts": self._held_timeouts.value(**lab),
+            "held_cancels": self._held_cancels.value(**lab),
+            "breaker_opens": self._sum(self._breaker_opens),
+            "replicas_routable": self._routable.value(**lab),
+            "queue_depth": self._qdepth.value(**lab),
+        }

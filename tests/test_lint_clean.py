@@ -63,6 +63,44 @@ def test_core_is_suppression_free():
         f"{[(s.file, s.line) for s in inline]}")
 
 
+def test_router_joins_reader_hook_contract():
+    """The multi-engine router is part of the suppression-free core
+    (its directory is covered by CORE_PREFIXES, pinned here by name):
+    it lints clean with ZERO suppressions, AND ptlint's CC003 reader-
+    hook rule actually has teeth on it — the module is sanitizer-
+    bearing (references ``self._san``), every scrape reader carries
+    its ``check_read`` hook, and each hooked name is registered in
+    the sanitizer's SAFE_READS so the runtime check can fire."""
+    import ast
+
+    from paddle_tpu.analysis.sanitizer import SAFE_READS
+
+    path = os.path.join(REPO, "paddle_tpu", "inference", "router.py")
+    assert path.startswith(
+        tuple(os.path.join(REPO, p) for p in CORE_PREFIXES))
+    result = lint.scan([path], REPO)
+    assert not result.violations, [
+        (v.line, v.rule, v.message) for v in result.violations]
+    assert not result.suppressions
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    assert "self._san" in src  # CC003 applies (sanitizer-bearing)
+    tree = ast.parse(src)
+    hooked = {
+        n.args[0].value
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "check_read" and n.args
+        and isinstance(n.args[0], ast.Constant)}
+    assert {"backpressure", "metrics_snapshot",
+            "fleet_snapshot"} <= hooked
+    assert hooked <= SAFE_READS, (
+        f"router readers {sorted(hooked - SAFE_READS)} hook "
+        "check_read but are not registered in SAFE_READS — the "
+        "runtime ownership check would reject every scrape")
+
+
 def test_flag_registry_matches_runtime():
     """The AST-level registry the lint checks against == the runtime
     registry flags.registry() exposes (the satellite contract)."""

@@ -479,8 +479,12 @@ def test_drain_completes_inflight_stops_admission():
     rids = [eng.add_request(p, 5) for p in _prompts(cfg, n=4, seed=6)]
     eng.step_chunk(2)  # two admitted, two queued
     summary = eng.drain()
+    unfinished = summary.pop("unfinished")
     assert summary == {"drained": True, "expired": 0, "active": 0,
                        "queued": 2}
+    # the two still-queued fresh requests ARE the handoff payload
+    assert sorted(led["rid"] for led in unfinished) \
+        == [r for r in rids if r not in eng._finished]
     done = [r for r in rids if r in eng._finished]
     assert len(done) == 2
     for rid in done:
@@ -545,8 +549,75 @@ def test_drain_deadline_expires_stragglers():
     assert summary["expired"] == 1 and summary["active"] == 0
     req = eng._finished[rid]
     assert req.finish_reason == "timeout" and len(req.output) > 0
+    # the straggler timed out HERE, but its ledger is in the handoff
+    # payload (captured before teardown) so a caller can re-admit it
+    assert [led["rid"] for led in summary["unfinished"]] == [rid]
+    assert summary["unfinished"][0]["output"] == req.output
     eng._evict_pages(10 ** 9)
     assert eng.pool.free_pages == free0 and not eng.pool.ref
+
+
+def test_drain_ledger_payload_shape():
+    """Pin the handoff payload: drain()'s ``unfinished`` entries carry
+    the full host token ledger — prompt, generated tokens, sampling
+    params, SLO targets, deadline and timing state — exactly the
+    fields ``admit_ledger`` consumes."""
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, _ecfg(True, max_slots=1))
+    rid = eng.add_request(
+        np.arange(1, 11), 60, eos_token_id=7777, temperature=0.7,
+        top_k=5, top_p=0.9, greedy=True, slo="interactive",
+        max_retries=4)
+    eng.step_chunk(2)
+    led = eng.drain(deadline_ms=10.0, max_chunk=2)["unfinished"][0]
+    eng.resume()
+    assert set(led) == {
+        "rid", "prompt", "output", "max_new_tokens", "eos_token_id",
+        "temperature", "top_k", "top_p", "greedy", "slo",
+        "ttft_target_ms", "tpot_target_ms", "deadline_t",
+        "max_retries", "retries", "ttft_ms", "submit_t", "admit_t",
+    }
+    assert led["rid"] == rid
+    assert led["prompt"] == list(range(1, 11))
+    assert led["output"] and all(isinstance(t, int)
+                                 for t in led["output"])
+    assert led["max_new_tokens"] == 60 and led["eos_token_id"] == 7777
+    assert led["temperature"] == 0.7 and led["top_k"] == 5
+    assert led["top_p"] == 0.9 and led["greedy"] is True
+    assert led["slo"] == "interactive"
+    # class defaults were resolved at admission and travel explicitly
+    assert led["ttft_target_ms"] == 250.0
+    assert led["tpot_target_ms"] == 100.0
+    assert led["deadline_t"] and led["deadline_t"] > led["submit_t"]
+    assert led["max_retries"] == 4 and led["retries"] == 0
+    assert led["ttft_ms"] is not None and led["admit_t"] > 0
+    import json as _json
+
+    _json.dumps(led)  # the payload is wire-serializable
+
+
+def test_resume_after_drain_readmits_queued():
+    """resume() after a drain: the requests the closed admission gate
+    kept queued admit on the next tick and finish with their full
+    token count — and their TTFT keeps counting from the ORIGINAL
+    submission (the drain window is honest queue wait)."""
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, _ecfg(False, max_slots=1))
+    r0 = eng.add_request(np.arange(1, 9), 4)
+    r1 = eng.add_request(np.arange(1, 9), 5)  # waits behind r0
+    eng.step_chunk(2)
+    summary = eng.drain(max_chunk=2)
+    assert [led["rid"] for led in summary["unfinished"]] == [r1]
+    assert r1 not in eng._finished  # still queued, not expired
+    queued = next(r for r in eng._queue if r.rid == r1)
+    submit_t = queued._submit_t
+    eng.resume()
+    _drive(eng)
+    req = eng._finished[r1]
+    assert req.finish_reason == "max_new_tokens"
+    assert len(req.output) == 5
+    assert req._submit_t == submit_t
+    assert req.ttft_ms >= (req._admit_t - submit_t) * 1e3 * 0.99
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,833 @@
+"""Replicated serving: the multi-engine front door.
+
+Under test (``inference/router.py``):
+  - health-weighted routing: prefix-affinity (the rolling block-hash
+    chain lands shared-prefix traffic where its pages live) with
+    least-loaded fallback via ``backpressure()``; fleet-level shedding
+    (router holds requests when no replica is routable);
+  - the per-replica circuit breaker: closed → open on repeated faults
+    in a sliding window (immediately on a crash) → half-open canary →
+    closed; deterministic seeded backoff schedules;
+  - CROSS-REPLICA FAILOVER: a crashed/hung replica's in-flight and
+    queued requests are reclaimed from the host token ledger and
+    replayed through a survivor's existing prefill program — greedy
+    outputs bit-identical to a fault-free run in BOTH cache modes,
+    original admission timestamps preserved for SLO accounting, zero
+    leaked slots/pages/prefix refs, zero new compiled programs;
+  - cancel/deadline racing a failover: terminal rids are never
+    replayed; every rid is accounted exactly once (soak);
+  - the engine-side handoff API: ``drain()``'s ``unfinished`` ledger
+    payload and ``admit_ledger`` re-admission;
+  - the fleet sanitizer invariant (rid owned by exactly one replica
+    or queue) and the aggregate ``/healthz``.
+
+The whole module runs in the chaos lane (sanitized via the conftest
+autouse fixture), like ``test_resilience.py``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import serving_utils
+
+import paddle_tpu as pt
+from paddle_tpu import flags as F
+from paddle_tpu.inference.resilience import FaultInjector
+from paddle_tpu.inference.router import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    EngineRouter,
+)
+from paddle_tpu.inference.serving import (
+    ContinuousBatchingEngine,
+    EngineConfig,
+    request_ledger,
+    start_metrics_server,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _model(seed=0):
+    return serving_utils.tiny_model(seed)
+
+
+def _ecfg(paged, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("seq_buckets", (32,))
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("page_size", 8)
+    return EngineConfig(paged=paged, **kw)
+
+
+def _prompts(cfg, n=6, seed=3, lo=6, hi=20):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (int(rng.integers(lo, hi)),))
+            for _ in range(n)]
+
+
+class ScriptedInjector(FaultInjector):
+    """fire() hits at EXACT scripted consultation indices per site —
+    chaos scenarios that need a fault at one specific (tick, replica)
+    point rather than a seeded rate."""
+
+    def __init__(self, plan):
+        super().__init__("")
+        self._plan = {s: set(v) for s, v in plan.items()}
+
+    def fire(self, site):
+        n = self.draws[site]
+        self.draws[site] = n + 1
+        hit = n in self._plan.get(site, ())
+        if hit:
+            self.fires[site] += 1
+        return hit
+
+
+def _assert_fleet_no_leaks(router):
+    for rep in router._replicas:
+        eng = rep.engine
+        assert not eng.active.any(), f"replica {rep.idx} leaked a slot"
+        assert sorted(eng._free_heap) == list(range(eng.cfg.max_slots))
+        assert not eng._slot_req
+        if eng.cfg.paged:
+            eng._evict_pages(10 ** 9)
+            assert eng.pool.free_pages == eng.pool.n_pages - 1, \
+                f"replica {rep.idx} leaked pages"
+            assert not eng.pool.ref
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    rng = np.random.default_rng((0xB4EA, 0, 0))
+    br = CircuitBreaker(window=8, trip=3, cooldown=4,
+                        schedule="1,2,4", rng=rng)
+    assert br.state(1) == BREAKER_CLOSED
+    assert not br.note_fault(1)
+    assert not br.note_fault(2)
+    opened = br.note_fault(3)  # 3rd fault in window trips
+    assert opened and br.state(3) == BREAKER_OPEN
+    t_half = br.reopen_at
+    assert t_half >= 3 + 4  # cooldown * schedule[0] (+ jitter)
+    # read-only view half-opens at cooldown; advance() commits
+    assert br.state(t_half) == BREAKER_HALF_OPEN
+    assert br.advance(t_half) == BREAKER_HALF_OPEN
+    # canary failure reopens with the NEXT schedule entry (2x)
+    assert br.note_fault(t_half)
+    assert br.reopen_at >= t_half + 8
+    t2 = br.reopen_at
+    assert br.advance(t2) == BREAKER_HALF_OPEN
+    br.note_ok(t2)  # canary success: closed, backoff reset
+    assert br.state(t2) == BREAKER_CLOSED
+    assert br.snapshot()["attempt"] == 0
+    assert br.opens == 2
+
+
+def test_breaker_window_ages_out_faults():
+    br = CircuitBreaker(4, 3, 4, [1],
+                        np.random.default_rng((0xB4EA, 0, 1)))
+    assert not br.note_fault(1)
+    assert not br.note_fault(2)
+    # ticks 1, 2 aged out of the 4-tick window by tick 7: no trip
+    assert not br.note_fault(7)
+    assert br.state(7) == BREAKER_CLOSED
+
+
+def test_breaker_backoff_deterministic_per_seed():
+    def opens(seed, idx):
+        br = CircuitBreaker(
+            8, 1, 4, "1,2,4",
+            np.random.default_rng((0xB4EA, seed, idx)))
+        out = []
+        t = 0
+        for _ in range(4):
+            t += 1
+            br.note_fault(t)  # trip=1: every fault opens
+            out.append(br.reopen_at - t)
+            t = br.reopen_at
+            br.advance(t)
+        return out
+    assert opens(0, 0) == opens(0, 0)  # same stream → same schedule
+    durations = opens(0, 0)
+    # successive opens back off per the schedule (jitter < cooldown/2
+    # can never cancel a 2x multiplier step)
+    assert durations[1] > durations[0]
+    assert durations[2] > durations[1]
+
+
+def test_breaker_and_router_validation():
+    model, _ = _model()
+    with pytest.raises(ValueError, match="n_replicas"):
+        EngineRouter(model, _ecfg(False), n_replicas=0)
+    with pytest.raises(ValueError, match="hang_ticks"):
+        EngineRouter(model, _ecfg(False), hang_ticks=0)
+    with pytest.raises(ValueError, match="schedule"):
+        EngineRouter(model, _ecfg(False), retry_schedule="1,0")
+    with pytest.raises(ValueError, match="breaker"):
+        EngineRouter(model, _ecfg(False), breaker_trip=0)
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+def test_fleet_parity_and_spread():
+    """A fault-free fleet completes every request with outputs
+    bit-identical to a single engine, and balances load across
+    replicas (least-loaded fallback). Paged here; the contiguous
+    mode's fleet parity is covered by the crash-storm A/B below."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=5)
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        prompts, max_new_tokens=6)
+    router = EngineRouter(model, _ecfg(True), n_replicas=2)
+    reqs = router.run(prompts, max_new_tokens=6)
+    assert [r.output for r in reqs] == [r.output for r in ref]
+    owners = {router._owner[r.rid] for r in reqs}
+    assert owners == {0, 1}, "least-loaded routing never spread load"
+    _assert_fleet_no_leaks(router)
+
+
+def test_prefix_affinity_routes_to_warm_replica():
+    """Shared-prefix traffic lands where its pages already live: after
+    the first request publishes its blocks on one replica, later
+    requests with the same prefix route there (affinity beats
+    least-loaded), while unrelated prompts still balance away."""
+    model, cfg = _model()
+    rng = np.random.default_rng(11)
+    shared = rng.integers(1, cfg.vocab_size, 24)  # 3 hash blocks
+    router = EngineRouter(model, _ecfg(True, max_slots=2),
+                          n_replicas=2)
+    r0 = router.add_request(
+        np.concatenate([shared, rng.integers(1, cfg.vocab_size, 4)]), 4)
+    while router.step(2):
+        pass
+    warm = router._owner[r0]
+    assert router.result(r0) is not None
+    # the warm replica's store answers the affinity probe; cold doesn't
+    hashes = router._affinity_hashes(
+        router.result(r0))
+    assert router._replicas[warm].engine.prefix_affinity_tokens(
+        hashes) >= 24
+    followers = [
+        router.add_request(
+            np.concatenate([shared,
+                            rng.integers(1, cfg.vocab_size, 5)]), 4)
+        for _ in range(3)]
+    unrelated = router.add_request(
+        rng.integers(1, cfg.vocab_size, 16), 4)
+    assert all(router._owner[rid] == warm for rid in followers)
+    assert router._owner[unrelated] != warm
+    assert router.fleet_stats["affinity_routed"] >= 3
+    while router.step(2):
+        pass
+    _assert_fleet_no_leaks(router)
+
+
+def test_fleet_shed_holds_when_all_saturated():
+    """No routable un-saturated replica → the router HOLDS the request
+    in its own queue (fleet-level shed: deferral, never drop) and
+    places it as soon as a finisher frees capacity."""
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False, max_slots=1),
+                          n_replicas=2)
+    rng = np.random.default_rng(5)
+    first = [router.add_request(rng.integers(1, cfg.vocab_size, 8), 12)
+             for _ in range(2)]
+    router.step(2)  # both replicas occupied
+    # queue one request per replica: both become saturated
+    second = [router.add_request(rng.integers(1, cfg.vocab_size, 8), 4)
+              for _ in range(2)]
+    router.step(2)
+    held = router.add_request(rng.integers(1, cfg.vocab_size, 8), 4)
+    assert held not in router._owner
+    assert any(r.rid == held for r in router._queue)
+    assert router.fleet_stats["held"] >= 1
+    assert router.backpressure()["saturated"]
+    while router.step(2):
+        pass
+    for rid in first + second + [held]:
+        req = router.result(rid)
+        assert req is not None and req.done
+        assert len(req.output) == req.max_new_tokens
+    _assert_fleet_no_leaks(router)
+
+
+# ---------------------------------------------------------------------------
+# cross-replica failover
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_crash_storm_outputs_bit_identical(paged, compile_counter):
+    """THE acceptance bar: under a seeded replica-crash storm, greedy
+    outputs across the fleet are bit-identical to a fault-free run,
+    surviving replicas leak nothing, and zero new programs compile
+    beyond the post-warmup set."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=6)
+    # the fault-free reference is a single engine: fleet placement is
+    # output-invariant (pinned by test_fleet_parity_and_spread), so
+    # one engine's greedy chains ARE the fault-free fleet's
+    ref = ContinuousBatchingEngine(model, _ecfg(paged)).run(
+        prompts, max_new_tokens=8, max_chunk=2)
+    assert len(ref) == len(prompts)
+
+    inj = FaultInjector("replica_crash:0.25,seed:5")
+    router = EngineRouter(model, _ecfg(paged, max_retries=50),
+                          n_replicas=2, fault_injector=inj,
+                          breaker_cooldown=3)
+    # warm-up: compile EVERY replica's programs outside the guard (two
+    # prompts spread over both replicas; least-loaded guarantees it) —
+    # twice, so the second pass HITS each prefix store and compiles
+    # the lazy hit-path programs too (contig insert/read, paged COW
+    # copy). Warmup prompts span >= 2 hash blocks so the store
+    # publish/read paths definitely trace on BOTH replicas.
+    wrng = np.random.default_rng(99)
+    warm = [wrng.integers(1, cfg.vocab_size, 20) for _ in range(2)]
+    router.run(warm, max_new_tokens=2, max_chunk=2)
+    router.run(warm, max_new_tokens=2, max_chunk=2)
+    base = compile_counter()
+    reqs = router.run(prompts, max_new_tokens=8, max_chunk=2)
+    fs = router.fleet_snapshot()
+    assert fs["failovers"] >= 1, "storm never killed a replica"
+    assert fs["replayed"] + fs["held"] >= 1
+    assert [r.output for r in reqs] == [r.output for r in ref]
+    assert all(r.finish_reason == "max_new_tokens" for r in reqs)
+    # failover replays ride the EXISTING prefill/decode programs:
+    # zero new compiled programs per replica (rebuilds keep shapes)
+    compile_counter.assert_programs(set(base))
+    assert compile_counter() == base
+    _assert_fleet_no_leaks(router)
+
+
+def test_single_crash_preserves_admission_timestamps():
+    """A scripted crash mid-generation: the victims' original
+    TTFT/admit instants survive the move (SLO accounting keeps the
+    honest wall from FIRST admission), ownership transfers to the
+    survivor, and outputs stay exact."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=4, seed=9)
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        prompts, max_new_tokens=8, max_chunk=2)
+    # 2 replicas, both closed: crash consultation 4 = tick 3, replica 0
+    inj = ScriptedInjector({"replica_crash": {4}})
+    router = EngineRouter(model, _ecfg(True), n_replicas=2,
+                          fault_injector=inj)
+    rids = [router.add_request(p, 8, slo="interactive")
+            for p in prompts]
+    router.step(2)
+    router.step(2)
+    stamped = {
+        req.rid: (req.ttft_ms, req._admit_t, req._submit_t)
+        for rep in router._replicas
+        for req in rep.engine._slot_req.values()}
+    victims = [req.rid for req
+               in router._replicas[0].engine._slot_req.values()]
+    assert victims, "replica 0 held nothing — scenario is vacuous"
+    while router.step(2):
+        pass
+    assert router.fleet_stats["failovers"] == 1
+    assert inj.fires["replica_crash"] == 1
+    for i, rid in enumerate(rids):
+        req = router.result(rid)
+        assert req is not None
+        assert req.output == ref[i].output
+    for rid, (ttft, admit, submit) in stamped.items():
+        req = router.result(rid)
+        assert req.ttft_ms == ttft, "TTFT rewritten by failover"
+        assert req._admit_t == admit
+        assert req._submit_t == submit
+    for rid in victims:
+        assert router._owner[rid] == 1, "victim not moved to survivor"
+        assert router._replicas[1].engine._finished[rid].slo_met \
+            is not None  # SLO accounted on the survivor
+    _assert_fleet_no_leaks(router)
+
+
+def test_hang_opens_breaker_then_canary_recovers():
+    """A hung replica (no-progress health probes) opens its breaker
+    after `trip` stalled ticks and fails its work over; once the hang
+    passes and the cooldown elapses, the half-open canary closes the
+    breaker and the replica serves again."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=4, seed=7)
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        prompts, max_new_tokens=6, max_chunk=2)
+    inj = ScriptedInjector({"replica_hang": {2}})  # tick 2, replica 0
+    router = EngineRouter(model, _ecfg(True), n_replicas=2,
+                          fault_injector=inj, breaker_trip=2,
+                          breaker_cooldown=2, hang_ticks=4)
+    rids = [router.add_request(p, 6) for p in prompts]
+    while router.step(2):
+        pass
+    fs = router.fleet_snapshot()
+    assert fs["breaker_opens"] >= 1
+    assert fs["failovers"] >= 1
+    assert [router.result(r).output for r in rids] \
+        == [r.output for r in ref]
+    # idle fleet ticks: the hang passes, the cooldown elapses, and the
+    # half-open canary probe closes the breaker again
+    for _ in range(8):
+        router.step(2)
+    fs = router.fleet_snapshot()
+    assert all(b["name"] == "closed" for b in fs["breakers"])
+    # …and the recovered replica takes traffic again
+    more = router.run(_prompts(cfg, n=3, seed=8), max_new_tokens=4,
+                      max_chunk=2)
+    assert len(more) == 3
+    assert {router._owner[r.rid] for r in more} == {0, 1}
+    _assert_fleet_no_leaks(router)
+
+
+def test_flaky_probe_does_not_flap_breaker():
+    """Isolated flaky health-probe verdicts stay UNDER the breaker's
+    trip threshold: no open, no failover — the sliding window is the
+    flap damping."""
+    model, cfg = _model()
+    # two flakes, far apart (well outside the 4-tick window)
+    inj = ScriptedInjector({"probe_flaky": {1, 40}})
+    router = EngineRouter(model, _ecfg(False), n_replicas=2,
+                          fault_injector=inj, breaker_window=4,
+                          breaker_trip=2)
+    reqs = router.run(_prompts(cfg, n=4), max_new_tokens=6,
+                      max_chunk=2)
+    assert len(reqs) == 4
+    fs = router.fleet_snapshot()
+    assert fs["breaker_opens"] == 0
+    assert fs["failovers"] == 0
+    assert inj.fires["probe_flaky"] >= 1
+
+
+def test_cancel_and_deadline_expiry_never_replay():
+    """The failover race the satellite pins: a cancelled rid and a
+    deadline-expired rid caught in a replica crash must NOT be
+    replayed onto the survivor — each is accounted exactly once, in
+    exactly one terminal registry."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=4, seed=13, lo=8, hi=12)
+    inj = ScriptedInjector({"replica_crash": {4}})  # tick 3, replica 0
+    router = EngineRouter(model, _ecfg(True, max_slots=2),
+                          n_replicas=2, fault_injector=inj)
+    rids = [router.add_request(p, 40) for p in prompts]
+    doomed = router.add_request(prompts[0], 40, deadline_ms=1.0)
+    router.step(2)
+    router.step(2)
+    # cancel one request currently ACTIVE on replica 0 (the replica
+    # the scripted crash will hit next tick)
+    vic = next(iter(
+        router._replicas[0].engine._slot_req.values())).rid
+    assert router.cancel(vic)
+    time.sleep(0.005)  # the doomed deadline expires
+    while router.step(2):
+        pass
+    assert router.fleet_stats["failovers"] == 1
+    cancelled = router.result(vic)
+    assert cancelled.cancelled and cancelled.finish_reason == "cancel"
+    expired = router.result(doomed)
+    assert expired.finish_reason == "timeout"
+    # neither lives anywhere in the fleet
+    for rep in router._replicas:
+        assert vic not in [r.rid for r in rep.engine._queue]
+        assert vic not in [r.rid for r
+                           in rep.engine._slot_req.values()]
+    # every rid accounted EXACTLY once across all finish registries
+    regs = [router._finished] + [rep.engine._finished
+                                 for rep in router._replicas]
+    for rid in rids + [doomed]:
+        places = sum(1 for reg in regs if rid in reg)
+        assert places == 1, (rid, places)
+    _assert_fleet_no_leaks(router)
+
+
+def test_hard_runtime_error_opens_breaker_immediately():
+    """A REAL runtime error escaping the engine's own recovery may
+    have consumed donated device buffers: the router must open the
+    breaker and rebuild NOW — never keep stepping an untrusted
+    replica while a fault window fills."""
+    from paddle_tpu.inference.resilience import RUNTIME_ERRORS
+
+    if not RUNTIME_ERRORS:
+        pytest.skip("no XLA runtime error class in this jaxlib")
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(True), n_replicas=2)
+    rids = [router.add_request(p, 6) for p in _prompts(cfg, n=3)]
+    router.step(2)
+    victim = router._replicas[0].engine
+    real_step = victim.step_chunk
+
+    def boom(max_chunk=8):
+        victim.step_chunk = real_step  # fail exactly once
+        raise RUNTIME_ERRORS[0]("donated buffer consumed")
+
+    victim.step_chunk = boom
+    router.step(2)  # the failing tick
+    fs = router.fleet_snapshot()
+    assert fs["breaker_opens"] == 1 and fs["failovers"] == 1
+    assert fs["breakers"][0]["name"] == "open"
+    assert victim.resilience_stats["rebuilds"] == 1
+    while router.step(2):
+        pass
+    for rid in rids:
+        req = router.result(rid)
+        assert req is not None and len(req.output) == 6
+    _assert_fleet_no_leaks(router)
+
+
+def test_fresh_arrivals_queue_behind_held_requests():
+    """FIFO fairness: while older requests sit held at the router, a
+    fresh arrival must not steal capacity a finisher frees — held
+    requests place first (admission order is completion order on a
+    1-slot fleet)."""
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False, max_slots=1),
+                          n_replicas=1)
+    rng = np.random.default_rng(4)
+    base = [router.add_request(rng.integers(1, cfg.vocab_size, 8), 6)
+            for _ in range(2)]  # slot + replica queue: saturated
+    router.step(2)
+    held_a = router.add_request(rng.integers(1, cfg.vocab_size, 8), 4)
+    assert any(r.rid == held_a for r in router._queue)
+    router.step(2)
+    fresh_b = router.add_request(rng.integers(1, cfg.vocab_size, 8), 4)
+    # B arrived while A was held: it must queue BEHIND A, even if a
+    # slot frees between the submissions
+    assert [r.rid for r in router._queue
+            if r.rid in (held_a, fresh_b)] == [held_a, fresh_b]
+    while router.step(2):
+        pass
+    a, b = router.result(held_a), router.result(fresh_b)
+    assert a._admit_t < b._admit_t, "fresh arrival jumped the line"
+    for rid in base + [held_a, fresh_b]:
+        assert len(router.result(rid).output) \
+            == router.result(rid).max_new_tokens
+    _assert_fleet_no_leaks(router)
+
+
+def test_held_expiry_counts_against_fleet_slo():
+    """An SLO-tracked request that expires while HELD at the router
+    is a real violation: it must land in the fleet slo_snapshot
+    (goodput must not be inflated by requests that never reached an
+    engine), and a held cancel counts as cancelled, not violated."""
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False, max_slots=1),
+                          n_replicas=1)
+    rng = np.random.default_rng(8)
+    for _ in range(2):  # saturate the 1-slot fleet
+        router.add_request(rng.integers(1, cfg.vocab_size, 8), 20,
+                           slo="interactive")
+    router.step(2)
+    doomed = router.add_request(rng.integers(1, cfg.vocab_size, 8), 4,
+                                slo="interactive", deadline_ms=1.0)
+    cancelled = router.add_request(
+        rng.integers(1, cfg.vocab_size, 8), 4, slo="interactive")
+    assert any(r.rid == doomed for r in router._queue)
+    assert router.cancel(cancelled)
+    time.sleep(0.005)
+    router.step(2)
+    assert router.result(doomed).finish_reason == "timeout"
+    st = router.slo_snapshot()["classes"]["interactive"]
+    assert st["timeouts"] == 1 and st["violated"] == 1
+    assert st["cancelled"] == 1
+    while router.step(2):
+        pass
+    snap = router.slo_snapshot()
+    cls = snap["classes"]["interactive"]
+    # the two served requests met-or-violated on their engine; the
+    # held timeout stays merged in — fleet goodput sees all three
+    assert cls["met"] + cls["violated"] == 3
+    assert cls["violated"] >= 1
+    assert snap["goodput"] is not None and snap["goodput"] < 1.0
+
+
+def test_fleet_sanitizer_catches_dual_ownership():
+    """PT_FLAGS_sanitize (on for the chaos lane): a rid present on two
+    replicas at once — the bug class failover exists to avoid — trips
+    the fleet invariant at the next router tick."""
+    from paddle_tpu.analysis.sanitizer import SanitizerError
+
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False), n_replicas=2)
+    rid = router.add_request(np.arange(1, 9), 16)
+    owner = router._owner[rid]
+    other = router._replicas[1 - owner].engine
+    req = next(
+        (r for r in router._replicas[owner].engine._queue
+         if r.rid == rid), None) \
+        or router._replicas[owner].engine._slot_req.get(0)
+    other._queue.append(req)  # the corruption: same rid, two owners
+    with pytest.raises(SanitizerError, match="rid-ownership"):
+        router.step(2)
+
+
+# ---------------------------------------------------------------------------
+# handoff API (drain ledgers -> admit_ledger)
+# ---------------------------------------------------------------------------
+
+def test_admit_ledger_continues_bit_identically():
+    """Mid-generation handoff: drain a single engine, re-admit its
+    unfinished ledgers on a FRESH engine — the continuation is the
+    same greedy chain, token for token, with the original TTFT."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, n=2, seed=21)
+    ref = ContinuousBatchingEngine(model, _ecfg(True)).run(
+        prompts, max_new_tokens=10)
+    src = ContinuousBatchingEngine(model, _ecfg(True))
+    for p in prompts:
+        src.add_request(p, 10)
+    src.step_chunk(3)  # admit + a few tokens
+    summary = src.drain(deadline_ms=1.0, max_chunk=2)
+    assert summary["expired"] == 2
+    ledgers = summary["unfinished"]
+    assert len(ledgers) == 2
+    assert all(0 < len(led["output"]) < 10 for led in ledgers)
+    dst = ContinuousBatchingEngine(model, _ecfg(True))
+    for led in ledgers:
+        assert dst.admit_ledger(led) == led["rid"]
+    while dst.step_chunk(3) or dst._queue or dst.active.any():
+        pass
+    for led, r in zip(ledgers, ref):
+        got = dst._finished[led["rid"]]
+        assert got.output == r.output
+        assert got.ttft_ms == led["ttft_ms"]  # first admission's TTFT
+        assert got.finish_reason == "max_new_tokens"
+
+
+def test_admit_ledger_rejects_known_rid():
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    rid = eng.add_request(np.arange(1, 9), 4)
+    led = request_ledger(list(eng._queue)[0])
+    with pytest.raises(ValueError, match="already owned"):
+        eng.admit_ledger(led)
+    # and rids adopted from a ledger keep the local counter ahead
+    eng2 = ContinuousBatchingEngine(model, _ecfg(False))
+    eng2.admit_ledger(led)
+    assert eng2.add_request(np.arange(1, 9), 4) == rid + 1
+
+
+def test_router_drain_returns_fleet_handoff_payload():
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False, max_slots=1),
+                          n_replicas=2)
+    rng = np.random.default_rng(2)
+    rids = [router.add_request(rng.integers(1, cfg.vocab_size, 8), 30)
+            for _ in range(3)]
+    router.step(2)
+    summary = router.drain(deadline_ms=10.0, max_chunk=2)
+    assert summary["drained"] and summary["expired"] >= 1
+    got = {led["rid"] for led in summary["unfinished"]}
+    done = {rid for rid in rids if router.result(rid) is not None
+            and router.result(rid).finish_reason == "max_new_tokens"}
+    assert got == set(rids) - done
+    assert router.backpressure()["draining"]
+    router.resume()
+    assert not router.backpressure()["draining"]
+
+
+# ---------------------------------------------------------------------------
+# aggregate healthz + snapshots
+# ---------------------------------------------------------------------------
+
+def test_router_aggregate_healthz():
+    model, cfg = _model()
+    router = EngineRouter(model, _ecfg(False), n_replicas=2)
+    router.run(_prompts(cfg, n=2), max_new_tokens=3)
+    srv = start_metrics_server(router, port=0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.status == 200
+            hz = json.loads(r.read())
+        assert hz["status"] == "ok"
+        assert hz["degradation_level"] == 0
+        bp = hz["backpressure"]
+        assert bp["routable_replicas"] == 2
+        assert len(bp["replicas"]) == 2
+        assert all(rep["breaker"] == "closed"
+                   for rep in bp["replicas"])
+        assert len(hz["engine"]["replicas"]) == 2
+        # fleet drain → aggregate healthz fails readiness
+        router.drain(deadline_ms=5.0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "draining"
+        router.resume()
+    finally:
+        srv.shutdown()
+
+
+def test_fleet_snapshot_and_metrics_always_present():
+    """Host-side fleet counters survive telemetry=off (the session
+    default), and the unified snapshot carries router + replicas."""
+    model, cfg = _model()
+    inj = FaultInjector("replica_crash:0.3,seed:2")
+    router = EngineRouter(model, _ecfg(True, max_retries=50),
+                          n_replicas=2, fault_injector=inj,
+                          breaker_cooldown=2)
+    assert router._tel is None  # telemetry off in the test session
+    router.run(_prompts(cfg, n=4), max_new_tokens=6, max_chunk=2)
+    snap = router.metrics_snapshot()
+    assert snap["telemetry"] == "off"
+    fs = snap["fleet"]
+    assert fs["failovers"] >= 1 and fs["routed"] >= 4
+    assert len(fs["breakers"]) == 2
+    assert fs["injector"]["enabled"]
+    assert len(snap["replicas"]) == 2
+    for rsnap in snap["replicas"]:
+        assert "resilience" in rsnap and "slots" in rsnap
+
+
+def test_router_telemetry_counters():
+    """With telemetry ON: routed/failover/breaker series land in the
+    registry under the router's label and the tracer records
+    route/failover events."""
+    saved = {k: F.flag(k) for k in ("telemetry",)}
+    F.set_flags({"telemetry": True})
+    try:
+        from paddle_tpu import observability as obs
+
+        model, cfg = _model()
+        inj = ScriptedInjector({"replica_crash": {4}})
+        router = EngineRouter(model, _ecfg(True), n_replicas=2,
+                              fault_injector=inj)
+        assert router._tel is not None
+        router.run(_prompts(cfg, n=4), max_new_tokens=6, max_chunk=2)
+        snap = router._tel.snapshot()
+        assert snap["routed"] >= 4
+        assert snap["failovers"] == 1
+        assert snap["breaker_opens"] == 1
+        text = obs.get_registry().prometheus_text()
+        assert "pt_router_requests_routed_total" in text
+        assert "pt_router_failovers_total" in text
+        assert "pt_router_breaker_state" in text
+        events = [e["name"] for e in router._tracer.events()]
+        assert "route" in events and "failover" in events
+        assert "breaker_open" in events
+        # idle ticks advance the open breaker's cooldown; once the
+        # canary runs, the open->half_open commit must be visible
+        # (gauge encoding 2 reachable; /metrics agrees with /healthz)
+        for _ in range(64):
+            router.step(2)
+            if all(r.breaker._state == BREAKER_CLOSED
+                   for r in router._replicas):
+                break
+        events = [e["name"] for e in router._tracer.events()]
+        assert "breaker_half_open" in events and "breaker_close" in events
+        # held terminals: saturate the fleet, then let one held
+        # request expire and cancel another — the pt_router_* twins
+        # of the engine-side timeout/cancel counters must fire (a
+        # dashboard watching only pt_serve_* would miss these)
+        rng = np.random.default_rng(4)
+        for _ in range(6):  # 2 active + 1 queued per replica
+            router.add_request(rng.integers(1, cfg.vocab_size, 8), 12)
+        router.step(2)
+        doomed = router.add_request(
+            rng.integers(1, cfg.vocab_size, 8), 4, deadline_ms=1.0)
+        gone = router.add_request(rng.integers(1, cfg.vocab_size, 8), 4)
+        assert any(r.rid == gone for r in router._queue)
+        assert router.cancel(gone)
+        time.sleep(0.005)
+        while router.step(2):
+            pass
+        assert router.result(doomed).finish_reason == "timeout"
+        snap = router._tel.snapshot()
+        assert snap["held_timeouts"] == 1
+        assert snap["held_cancels"] == 1
+        text = obs.get_registry().prometheus_text()
+        assert "pt_router_requests_timeout_total" in text
+        assert "pt_router_requests_cancelled_total" in text
+        events = [e["name"] for e in router._tracer.events()]
+        assert "held_timeout" in events and "held_cancel" in events
+    finally:
+        F.set_flags(saved)
+
+
+# ---------------------------------------------------------------------------
+# replica-kill storm soak
+# ---------------------------------------------------------------------------
+
+def test_fleet_kill_storm_soak():
+    """The replica-kill storm: producer-thread arrivals × seeded
+    crash/hang/flaky storm × a cancel storm, sanitized (fleet
+    rid-ownership invariant checked every tick). Afterwards: every
+    rid is accounted EXACTLY once across the fleet's finish
+    registries, survivors carry their exact token counts, every pool
+    recovers, and the fleet still serves."""
+    model, cfg = _model()
+    inj = FaultInjector(
+        "replica_crash:0.06,replica_hang:0.05,probe_flaky:0.08,seed:19")
+    router = EngineRouter(model, _ecfg(True, max_slots=2,
+                                       max_retries=100),
+                          n_replicas=3, fault_injector=inj,
+                          breaker_cooldown=2, hang_ticks=2)
+    n_requests, new_tokens = 13, 6
+    rng = np.random.default_rng(3)
+    shared = rng.integers(1, cfg.vocab_size, 16)
+    prompts = [np.concatenate(
+        [shared, rng.integers(1, cfg.vocab_size,
+                              (int(rng.integers(2, 10)),))])
+        for _ in range(n_requests)]
+    ids = []
+    errs = []
+    prng = np.random.default_rng(7)
+
+    def producer():
+        try:
+            for p in prompts:
+                ids.append(router.add_request(p, new_tokens))
+                time.sleep(float(prng.uniform(0.0, 0.01)))
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    cancelled = set()
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        busy = router.step(4)
+        for rid in list(ids):
+            if rid % 4 == 0 and rid not in cancelled \
+                    and router.cancel(rid):
+                cancelled.add(rid)
+        if not t.is_alive() and not busy:
+            done = sum(1 for rid in ids
+                       if router.result(rid) is not None)
+            if done >= n_requests:
+                break
+    t.join(timeout=10)
+    assert not errs, errs
+    assert router.fleet_stats["failovers"] >= 1, "storm was vacuous"
+    assert cancelled
+    regs = [router._finished] + [rep.engine._finished
+                                 for rep in router._replicas]
+    for rid in ids:
+        places = sum(1 for reg in regs if rid in reg)
+        assert places == 1, \
+            f"rid {rid} accounted {places} times (must be exactly 1)"
+        req = router.result(rid)
+        if rid in cancelled:
+            assert req.cancelled
+        elif req.finish_reason == "max_new_tokens":
+            assert len(req.output) == new_tokens
+        else:
+            assert req.finish_reason in ("timeout", "failed")
+    _assert_fleet_no_leaks(router)
+    # the fleet still serves after the storm
+    router._injector = None
+    out = router.run([prompts[0]], max_new_tokens=4, max_chunk=2)
+    assert len(out) == 1 and len(out[0].output) == 4

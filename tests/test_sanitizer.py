@@ -132,13 +132,17 @@ def test_corrupt_sites_are_appended_not_inserted():
     """Corruption sites extend SITES at the END: per-site RNG streams
     seed on the site INDEX, so appending preserves every pre-existing
     chaos schedule (seeded storms stay reproducible across versions)."""
-    from paddle_tpu.inference.resilience import SITES
+    from paddle_tpu.inference.resilience import ROUTER_SITES, SITES
 
     assert SITES[:4] == ("step", "nan", "latency", "pool")
-    assert tuple(SITES[4:]) == CORRUPT_SITES
+    assert tuple(SITES[4:7]) == CORRUPT_SITES
+    # PR 11's replica-level router sites append AFTER the corruption
+    # sites — same index-seeded-stream reasoning, same pin
+    assert tuple(SITES[7:]) == ROUTER_SITES
     # and a legacy spec still parses while new sites rate-limit to 0
     inj = FaultInjector("step:0.5,seed:3")
-    assert all(inj.rates[s] == 0.0 for s in CORRUPT_SITES)
+    assert all(inj.rates[s] == 0.0
+               for s in CORRUPT_SITES + ROUTER_SITES)
 
 
 # ---------------------------------------------------------------------------
@@ -219,12 +223,19 @@ def test_second_thread_tick_flagged(model):
 
 
 def test_safe_reads_exist_on_engine(model):
-    """SAFE_READS is a registry of real engine readers — a renamed
-    snapshot method must update the registration (and the ptlint CC
-    scope) with it."""
+    """SAFE_READS is a registry of real readers — a renamed snapshot
+    method must update the registration (and the ptlint CC scope)
+    with it. Engine readers live on the engine; the router-only
+    readers (PR 11) live on ``EngineRouter``."""
+    from paddle_tpu.inference.router import EngineRouter
+
+    router_only = {"fleet_snapshot"}
     eng = _engine(model, paged=False)
-    for name in SAFE_READS:
+    for name in SAFE_READS - router_only:
         assert callable(getattr(eng, name)), name
+    # class-level: the contract needs no replica engines built
+    for name in router_only | {"backpressure", "metrics_snapshot"}:
+        assert callable(getattr(EngineRouter, name)), name
 
 
 # ---------------------------------------------------------------------------

@@ -430,6 +430,10 @@ def test_healthz_backpressure_and_trace_endpoint(obs_flags):
         hz = json.loads(ei.value.read())
         assert hz["status"] == "saturated"
         assert hz["backpressure"]["queue_depth"] == 1
+        # routers need the RUNG, not just the flag: the payload
+        # carries the numeric ladder level alongside the degraded bit
+        assert hz["degraded"] is False
+        assert hz["degradation_level"] == 0
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/trace", timeout=10) as r:
             doc = json.loads(r.read())
