@@ -571,6 +571,23 @@ def _compact_line(result):
                     k: rf.get(k) for k in
                     ("failovers", "outputs_match",
                      "failover_overhead_pct")}
+            # measured-vs-modeled step breakdown (serve7b): the
+            # decode-chunk measured p50 beside its HBM floor, plus
+            # the recompile-watchdog verdict, ride the ledger so the
+            # driver sees MEASUREMENTS next to the models
+            sb = (r.get("extra") or {}).get("step_breakdown") or {}
+            sb_rows = {x.get("program"): x for x in sb.get("rows", [])}
+            dc = sb_rows.get("decode_chunk")
+            if dc:
+                row["step_breakdown"] = {
+                    "decode_chunk_ms": dc.get("measured_p50_ms"),
+                    "decode_floor_ms": dc.get("modeled_floor_ms"),
+                    "prefill_chunk_ms": (sb_rows.get("prefill_chunk")
+                                         or {}).get("measured_p50_ms"),
+                    "recompiles": sum(
+                        (sb.get("recompiles_post_seal") or {})
+                        .values()),
+                }
             keep["secondary"][name] = row
     out["extra"] = keep
 
@@ -582,6 +599,7 @@ def _compact_line(result):
             row.pop("goodput", None)
             row.pop("quant", None)
             row.pop("replica_failover", None)
+            row.pop("step_breakdown", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:
         # the capture pointer survives the final shed: a truncated CPU

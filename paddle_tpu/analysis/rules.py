@@ -74,6 +74,12 @@ class Project:
         # flag name -> [(file, line)] of set_flags writes
         self.flag_writes: Dict[str, List[Tuple[str, int]]] = {}
         self.saw_registry_module = False
+        # OBS001: TRACE_COUNTS program name -> (file, line) of its
+        # first compile-counter bump, and the PROGRAM_LABELS literal
+        # keys from observability/profiling.py
+        self.trace_programs: Dict[str, Tuple[str, int]] = {}
+        self.program_labels: Set[str] = set()
+        self.saw_profiling_module = False
 
     def readme_text(self) -> str:
         path = os.path.join(self.root, "README.md")
@@ -596,6 +602,75 @@ class FlagsHygiene(Rule):
 
 
 # ---------------------------------------------------------------------------
+# OBS — observability completeness
+# ---------------------------------------------------------------------------
+class OBS001ProgramLabelCompleteness(Rule):
+    """Collector + one project-level verdict: every compiled serving
+    program registered in a ``TRACE_COUNTS`` compile counter must also
+    carry a timing label in
+    ``observability/profiling.PROGRAM_LABELS`` — the attribution
+    registry the per-program device-time profiler and the recompile
+    watchdog report against. A new jitted program that bumps a
+    compile counter (TS002 forces that) but skips the label registry
+    would compile, count and recompile INVISIBLY to the measurement
+    layer; this closes the loop statically, like FL003 does for the
+    README flags tables."""
+
+    id = "OBS001"
+    doc = ("every TRACE_COUNTS-registered program name must carry a "
+           "timing label in observability/profiling.PROGRAM_LABELS")
+
+    _PROFILING = "paddle_tpu/observability/profiling.py"
+
+    def applies(self, relpath):
+        return _in(relpath, "paddle_tpu")
+
+    def check_module(self, project, tree, src, relpath):
+        del src
+        if relpath == self._PROFILING:
+            project.saw_profiling_module = True
+            for node in ast.walk(tree):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target]
+                           if isinstance(node, ast.AnnAssign) else [])
+                if any(isinstance(t, ast.Name)
+                       and t.id == "PROGRAM_LABELS" for t in targets) \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        s = _const_str(k)
+                        if s is not None:
+                            project.program_labels.add(s)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.AugAssign)
+                    and isinstance(node.target, ast.Subscript)
+                    and isinstance(node.target.value, ast.Name)
+                    and node.target.value.id == "TRACE_COUNTS"):
+                name = _const_str(node.target.slice)
+                if name is not None \
+                        and name not in project.trace_programs:
+                    project.trace_programs[name] = (relpath,
+                                                    node.lineno)
+        return []
+
+    def check_project(self, project):
+        if not project.saw_profiling_module:
+            # partial scan (e.g. `lint tests/`): without the label
+            # registry in view every program would read unlabeled
+            return []
+        out: List[Violation] = []
+        for name, (f, ln) in sorted(project.trace_programs.items()):
+            if name not in project.program_labels:
+                out.append(Violation(
+                    f, ln, "OBS001",
+                    f"compiled program {name!r} bumps TRACE_COUNTS "
+                    "but has no timing label in observability/"
+                    "profiling.PROGRAM_LABELS — the per-program "
+                    "profiler and the recompile watchdog cannot "
+                    "attribute it"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # CC — concurrency: copy-on-read snapshots, scheduler-owned mutation
 # ---------------------------------------------------------------------------
 _FRESH, _SHALLOW, _TAINTED = 0, 1, 2
@@ -843,6 +918,7 @@ ALL_RULES: Sequence[Rule] = (
     DT002GlobalNumpyRandom(),
     DT003WallClock(),
     FlagsHygiene(),
+    OBS001ProgramLabelCompleteness(),
     CC001CopyOnRead(),
 )
 
@@ -856,6 +932,7 @@ RULE_DOCS: Dict[str, str] = {
     "FL001": "flag reads/writes must resolve in the canonical registry",
     "FL002": "defined flags must be read somewhere outside tests/",
     "FL003": "defined flags must appear in README's flags tables",
+    "OBS001": OBS001ProgramLabelCompleteness.doc,
     "CC001": "scrape-thread readers iterate copies (list(...)-wrapped)",
     "CC002": "scrape-thread readers never mutate scheduler-owned state",
     "CC003": ("readers on sanitizer-bearing classes carry their "

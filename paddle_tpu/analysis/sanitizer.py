@@ -51,6 +51,10 @@ SAFE_READS = frozenset({
     # multi-engine router readers (router.py) — same copy-on-read
     # contract, same CC001/CC002/CC003 static coverage
     "fleet_snapshot",
+    # program-time attribution readers (PR 12): profiler stats,
+    # recompile-watchdog state and HBM residency are copy-on-read
+    # host metadata
+    "profile_snapshot", "recompile_snapshot", "hbm_snapshot",
 })
 
 

@@ -220,6 +220,47 @@ define_flag("sanitize", False,
             "telemetry=off pattern); `pytest -m chaos` runs with it "
             "on. Host bookkeeping only — zero compiled programs, "
             "zero device syncs")
+define_flag("profile_programs", False,
+            "serving per-program device-time profiler "
+            "(observability/profiling.py): cadence-sampled "
+            "block-until-ready timing around every compiled serving "
+            "dispatch (prefill_chunk/prefill_bucket/decode_step/"
+            "decode_chunk/spec_verify/page_copy). Sampled dispatches "
+            "record MEASURED device ms into "
+            "pt_serve_program_ms{engine,program} plus a host-schedule/"
+            "dispatch/device decomposition on the tracer's step "
+            "events; unsampled dispatches stay fully async (no host "
+            "sync — the PR-2 cadence discipline). off = the engine "
+            "holds no profiler, one identity check per seam, zero new "
+            "compiled programs")
+define_flag("profile_sample_every", 16,
+            "profile_programs sample cadence: measure every Nth "
+            "dispatch of each program (per-program counters, "
+            "deterministic). 1 = measure every dispatch — full "
+            "attribution at the cost of one device sync per dispatch; "
+            "note a program's FIRST dispatch (its compile) is only "
+            "sampled at cadence 1")
+define_flag("recompile_watchdog", True,
+            "runtime recompile watchdog: after "
+            "recompile_warmup_ticks scheduler ticks (or an explicit "
+            "engine.seal_programs()) the engine's expected "
+            "compiled-program set is SEALED; any later TRACE_COUNTS "
+            "growth during one of this engine's own ticks counts "
+            "pt_serve_recompiles_total{engine,program} and (telemetry "
+            "on) dumps a FlightRecorder artifact carrying the "
+            "offending specialization's arg shapes — the production "
+            "complement to ptlint TS003 and the test-only "
+            "compile-count guards. A program whose FIRST legitimate "
+            "use lands after the seal (e.g. page_copy on the first "
+            "copy-on-write) counts once — size the warmup, or seal "
+            "explicitly after real warmup traffic. One artifact per "
+            "program per engine; counters keep counting. Never "
+            "raises; off = no watchdog, one identity check per tick")
+define_flag("recompile_warmup_ticks", 64,
+            "scheduler ticks before the recompile watchdog auto-seals "
+            "the program set (warmup compiles are expected; "
+            "engine.seal_programs() seals immediately, e.g. right "
+            "after a bench warmup)")
 define_flag("router_breaker_window", 16,
             "multi-engine router: sliding window (fleet ticks) the "
             "per-replica circuit breaker counts faults over — "

@@ -348,6 +348,9 @@ class EngineRouter:
             from ..analysis.sanitizer import EngineSanitizer
 
             self._san = EngineSanitizer(self)
+        # process-wide fleet registry (weak): `dump --fleet` and the
+        # merged-trace exports find this router without a handle
+        observability.tracing.register_fleet(self)
 
     # ---------------- admission / routing ----------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -953,6 +956,15 @@ class EngineRouter:
         tracked = met + violated
         return {"classes": classes, "met": met, "violated": violated,
                 "goodput": met / tracked if tracked else None}
+
+    def fleet_chrome_trace(self) -> dict:
+        """ONE merged Perfetto-loadable trace for the whole fleet:
+        the router's route/failover/breaker event stream plus every
+        replica's request+step tracks, with a failed-over rid's spans
+        on BOTH replicas joined by flow events
+        (``observability.tracing.fleet_chrome_trace``). Served at
+        ``/trace?fleet=1`` on the fleet metrics server."""
+        return observability.tracing.fleet_chrome_trace(self)
 
     def metrics_snapshot(self) -> dict:
         """ONE fleet document: router registry aggregates (when

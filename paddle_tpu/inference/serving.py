@@ -58,6 +58,21 @@ from .spec_decode import Drafter, NgramDrafter
 # assert chunked prefill never re-specializes across prompt lengths
 TRACE_COUNTS: collections.Counter = collections.Counter()
 
+# trace-time shape notes, one per program: the MOST RECENT
+# specialization's key arg shapes, recorded next to the compile-count
+# bump (python runs at trace time only, so this is free at dispatch
+# time). The runtime recompile watchdog attaches this to its
+# FlightRecorder artifact — a post-seal recompile dump names the
+# offending shapes, not just the program
+TRACE_SHAPES: Dict[str, dict] = {}
+
+
+def _shape_note(program: str, **args):
+    """Record the traced args' shapes for ``program`` (called from
+    inside jitted bodies, at trace time only)."""
+    TRACE_SHAPES[program] = {
+        k: tuple(getattr(v, "shape", ())) for k, v in args.items()}
+
 
 @dataclass
 class EngineConfig:
@@ -677,6 +692,40 @@ class ContinuousBatchingEngine:
 
             self._san = EngineSanitizer(self)
 
+        # ---------------- program profiler + recompile watchdog ------
+        # PT_FLAGS_profile_programs (observability/profiling.py):
+        # cadence-sampled block-until-ready timing around every
+        # compiled dispatch — sampled dispatches record MEASURED
+        # device ms (pt_serve_program_ms) + the schedule/dispatch/
+        # device decomposition; unsampled dispatches stay fully async.
+        # Off = None: one identity check per seam, zero new compiled
+        # programs, outputs bit-identical (pinned by test).
+        self._prof = None
+        if bool(flags.flag("profile_programs")):
+            self._prof = observability.ProgramProfiler(
+                engine_id=(self._tel.engine_id
+                           if self._tel is not None else None))
+        # PT_FLAGS_recompile_watchdog: seal the expected program set
+        # after warmup (tick budget, or engine.seal_programs()) and
+        # count + flight-record any post-seal TRACE_COUNTS growth in
+        # one of THIS engine's own ticks — the production complement
+        # to ptlint TS003 and the test-only compile-count guards
+        self._watchdog = None
+        if bool(flags.flag("recompile_watchdog")):
+            self._watchdog = observability.RecompileWatchdog(
+                TRACE_COUNTS, TRACE_SHAPES,
+                engine_id=(self._tel.engine_id
+                           if self._tel is not None
+                           else (self._prof.engine_id
+                                 if self._prof is not None else "-")))
+        # live HBM residency gauges (host metadata only): the weight
+        # components are immutable after init — computed ONCE here so
+        # profiler-sampled refreshes only re-walk the (small) dynamic
+        # parts; baseline the gauges now that the pools exist
+        self._hbm_weights = observability.profiling \
+            .weight_bytes_by_dtype(self.params, self.buffers)
+        self._hbm_update()
+
     def _init_cache_state(self):
         """(Re)build the KV-cache device arrays and the page-pool
         bookkeeping — called at init and by hard crash recovery
@@ -961,6 +1010,7 @@ class ContinuousBatchingEngine:
         if self._prefill_c is None:
             def fn(pb, ids, caches, last_idx, key, samp, use_samp):
                 TRACE_COUNTS["prefill_bucket"] += 1
+                _shape_note("prefill_bucket", ids=ids)
                 pos = jnp.broadcast_to(
                     jnp.arange(ids.shape[1])[None, :], ids.shape)
                 logits, filled = functional_call(
@@ -986,6 +1036,7 @@ class ContinuousBatchingEngine:
         if self._insert_c is None:
             def fn(global_caches, one_caches, slot):
                 TRACE_COUNTS["prefill_insert"] += 1
+                _shape_note("prefill_insert", one_k=one_caches[0][0])
                 out = []
                 for (gk, gv), (ok, ov) in zip(global_caches, one_caches):
                     pad = gk.shape[1] - ok.shape[1]
@@ -1009,6 +1060,7 @@ class ContinuousBatchingEngine:
 
             def fn(layer_caches, one_caches, bt_row):
                 TRACE_COUNTS["prefill_scatter"] += 1
+                _shape_note("prefill_scatter", one_k=one_caches[0][0], bt_row=bt_row)
                 out = []
                 for cache, (ok, ov) in zip(layer_caches, one_caches):
                     n_used = ok.shape[1] // ps
@@ -1055,6 +1107,7 @@ class ContinuousBatchingEngine:
             def fn(pb, ids, caches, bt, start, last_idx, key, samp,
                    use_samp):
                 TRACE_COUNTS["prefill_chunk"] += 1
+                _shape_note("prefill_chunk", ids=ids, start=start)
                 pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)
                 if paged:
                     state = PagedState(block_tables=bt, seq_lens=start)
@@ -1100,6 +1153,7 @@ class ContinuousBatchingEngine:
 
             def fn(global_caches, kblk, vblk, slot, start):
                 TRACE_COUNTS["prefix_insert"] += 1
+                _shape_note("prefix_insert", kblk=kblk, vblk=vblk)
                 out = []
                 for i, (gk, gv) in enumerate(global_caches):
                     out.append((ins(gk, kblk, i, slot, start),
@@ -1140,6 +1194,7 @@ class ContinuousBatchingEngine:
 
             def fn(global_caches, slot, start):
                 TRACE_COUNTS["prefix_read"] += 1
+                _shape_note("prefix_read", k0=global_caches[0][0])
                 ks, vs = [], []
                 for gk, gv in global_caches:
                     ks.append(rd(gk, slot, start))
@@ -1161,6 +1216,7 @@ class ContinuousBatchingEngine:
 
             def fn(layer_caches, src, dst):
                 TRACE_COUNTS["page_copy"] += 1
+                _shape_note("page_copy", k_pages=layer_caches[0].k_pages)
                 out = []
                 for c in layer_caches:
                     rep = {"k_pages": copy1(c.k_pages, src, dst),
@@ -1183,6 +1239,7 @@ class ContinuousBatchingEngine:
                 # only `caches` (arg 2) is donated; the per-slot lengths /
                 # block tables must NOT alias it (f(donate(a), a) trap)
                 TRACE_COUNTS["decode_step"] += 1
+                _shape_note("decode_step", toks=toks)
                 if paged:
                     state = state_or_lens
                     seq_lens = state.seq_lens
@@ -1221,6 +1278,7 @@ class ContinuousBatchingEngine:
             def fn(pb, toks, caches, lens, active, budget, bt, key, samp,
                    K, use_samp):
                 TRACE_COUNTS["decode_chunk"] += 1
+                _shape_note("decode_chunk", toks=toks, budget=budget)
 
                 def one(carry, k):
                     toks, caches, lens = carry
@@ -1295,6 +1353,7 @@ class ContinuousBatchingEngine:
             def fn(pb, ids, caches, bt, start, n_draft, key, samp,
                    use_samp):
                 TRACE_COUNTS["spec_verify"] += 1
+                _shape_note("spec_verify", ids=ids, n_draft=n_draft)
                 pos = start[:, None] + jnp.arange(S, dtype=jnp.int32)
                 if paged:
                     state = PagedState(block_tables=bt, seq_lens=start)
@@ -1412,9 +1471,16 @@ class ContinuousBatchingEngine:
         new = self.pool.cow(slot, block_idx)
         if new is None:
             return False
+        prof = self._prof
+        p_want = prof is not None and prof.want("page_copy")
+        t0 = time.perf_counter()
         with self._ctx():
             self.layer_caches = self._copy_page()(
                 self.layer_caches, old, new)
+        if p_want:
+            # t_call == t0: the COW has no host scheduling stage
+            prof.observe("page_copy", t0, t0, time.perf_counter(),
+                         self.layer_caches[0].k_pages)
         self.prefix_stats["cow_copies"] += 1
         tr = self._tracer
         if tr is not None:
@@ -1768,6 +1834,10 @@ class ContinuousBatchingEngine:
                                tokens=int(take), slot=slot)
             self._key, sub = jax.random.split(self._key)
             caches = self.layer_caches if cfg.paged else self.caches
+            prof = self._prof
+            p_want = prof is not None and prof.want("prefill_chunk")
+            p_dec = None
+            t_call = time.perf_counter()
             with self._ctx():
                 toks, caches = self._prefill_chunked()(
                     self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
@@ -1777,10 +1847,17 @@ class ContinuousBatchingEngine:
                 self.layer_caches = caches
             else:
                 self.caches = caches
+            if p_want:
+                # sampled: measure the chunk program itself (its
+                # device time otherwise surfaces only inside the NEXT
+                # decode/verify step's sync window)
+                p_dec = prof.observe("prefill_chunk", t0, t_call,
+                                     time.perf_counter(), toks)
             if tr is not None:
-                # dispatch-only span: the chunk program is async — its
-                # device time surfaces in the NEXT decode/verify step's
-                # sync window, so only host dispatch wall is honest here
+                # unsampled dispatches stay a dispatch-only span: the
+                # chunk program is async — its device time surfaces in
+                # the NEXT decode/verify step's sync window, so only
+                # host dispatch wall is honest without the profiler
                 seq = tr.next_step()
                 if tr.want_step(seq):
                     tr.step(seq, "prefill_chunk", t0,
@@ -1790,7 +1867,9 @@ class ContinuousBatchingEngine:
                             chunk_budget_spent=packed,
                             occupancy=float(self.active.sum())
                             / cfg.max_slots,
-                            rids=[int(j[0].rid) for j in remaining])
+                            rids=[int(j[0].rid) for j in remaining],
+                            **(dict(p_dec, profiled=True)
+                               if p_dec is not None else {}))
             for job in finishing:
                 pending.append((job[0], job[1], job[6].size,
                                 toks[job[1]]))
@@ -1852,10 +1931,19 @@ class ContinuousBatchingEngine:
                     jnp.asarray([req.top_k or 0], jnp.int32),
                     jnp.asarray([req.top_p if req.top_p is not None
                                  else 1.0], jnp.float32))
+                prof = self._prof
+                p_want = prof is not None \
+                    and prof.want("prefill_bucket")
+                p_dec = None
+                t_call = time.perf_counter()
                 with self._ctx():
                     first_dev, filled = self._prefill()(
                         self._pb, jnp.asarray(padded, jnp.int32),
                         one_caches, n - 1, sub, samp, use_samp)
+                    if p_want:
+                        p_dec = prof.observe(
+                            "prefill_bucket", t0, t_call,
+                            time.perf_counter(), (first_dev, filled))
                     if self.cfg.paged:
                         self.layer_caches = self._scatter_paged()(
                             self.layer_caches, filled,
@@ -1892,7 +1980,9 @@ class ContinuousBatchingEngine:
                             time.perf_counter(), rid=int(req.rid),
                             bucket=int(bucket), prompt_tokens=int(n),
                             occupancy=float(self.active.sum())
-                            / self.cfg.max_slots)
+                            / self.cfg.max_slots,
+                            **(dict(p_dec, profiled=True)
+                               if p_dec is not None else {}))
         return pending
 
     def _admit_integrate(self, pending):
@@ -2456,7 +2546,12 @@ class ContinuousBatchingEngine:
         san = self._san
         if san is not None:
             san.note_tick("step")
+        wd = self._watchdog
+        if wd is not None:
+            wd.tick_begin()
         out = self._step_impl()
+        if wd is not None:
+            wd.tick_end()
         if self._injector is not None:
             self._corrupt_point()
         if san is not None:
@@ -2487,6 +2582,7 @@ class ContinuousBatchingEngine:
         adv = {} if tr is not None and tr.want_step(seq) else None
         occ = float(self.active.sum()) / self.cfg.max_slots
         participants = self.active.copy()
+        p_dec = None
         try:
             self._fault_point("decode")
             self._cow_for_decode(1)
@@ -2494,6 +2590,9 @@ class ContinuousBatchingEngine:
             self._key, sub = jax.random.split(self._key)
             toks = jnp.asarray(self.last_tok[:, None], jnp.int32)
             lens = jnp.asarray(self.seq_lens, jnp.int32)
+            prof = self._prof
+            p_want = prof is not None and prof.want("decode_step")
+            t_call = time.perf_counter()
             with self._ctx():
                 if self.cfg.paged:
                     state = PagedState(
@@ -2507,6 +2606,13 @@ class ContinuousBatchingEngine:
                         self._pb, toks, self.caches, lens, sub, samp,
                         use_samp)
             t_disp = time.perf_counter()
+            if p_want:
+                # sampled dispatch: MEASURED schedule/dispatch/device
+                # decomposition (block_until_ready on the program's
+                # own outputs — the sync below was due anyway)
+                p_dec = prof.observe("decode_step", t0, t_call,
+                                     t_disp, nxt)
+                self._hbm_update()
             nxt = np.asarray(nxt)
         except BaseException as e:
             if not self._recoverable(e):
@@ -2528,11 +2634,20 @@ class ContinuousBatchingEngine:
                 adv[req.rid] = 1
             self._maybe_finish(slot, tok)
         if adv is not None:
+            # sampled dispatches report the MEASURED decomposition
+            # (schedule_ms/dispatch_ms/device_ms, profiled=True);
+            # unsampled keep the SAME schedule/dispatch windows (the
+            # stamps cost nothing) plus the honest fallback:
+            # sync_wall_ms is the HOST wall from dispatch-done to
+            # token sync — an upper bound on device time, not a
+            # measurement (the field PR 6 called device_wall_ms_est)
+            timing = (dict(p_dec, profiled=True) if p_dec is not None
+                      else {"schedule_ms": (t_call - t0) * 1e3,
+                            "dispatch_ms": (t_disp - t_call) * 1e3,
+                            "sync_wall_ms": (t_sync - t_disp) * 1e3})
             tr.step(seq, "decode", t0, time.perf_counter(),
                     occupancy=occ, tokens_advanced=emitted,
-                    chunk_budget_spent=1, advanced=adv,
-                    dispatch_ms=(t_disp - t0) * 1e3,
-                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
+                    chunk_budget_spent=1, advanced=adv, **timing)
         if self._tel is not None:
             self._tel.on_tokens(emitted,
                                 (time.perf_counter() - t0) * 1e3)
@@ -2611,6 +2726,7 @@ class ContinuousBatchingEngine:
         spec_by_rid = {} if adv is not None else None
         occ = float(self.active.sum()) / cfg.max_slots
         chunk_slots = self.active.copy()
+        p_dec = None
         try:
             self._fault_point("verify")
             self._cow_for_decode(S)
@@ -2632,6 +2748,9 @@ class ContinuousBatchingEngine:
             bt = (jnp.asarray(self.pool.block_tables) if cfg.paged
                   else jnp.zeros((1,), jnp.int32))
             caches = self.layer_caches if cfg.paged else self.caches
+            prof = self._prof
+            p_want = prof is not None and prof.want("spec_verify")
+            t_call = time.perf_counter()
             with self._ctx():
                 preds, accepted, caches = self._verify()(
                     self._pb, jnp.asarray(ids, jnp.int32), caches, bt,
@@ -2642,6 +2761,16 @@ class ContinuousBatchingEngine:
             else:
                 self.caches = caches
             t_disp = time.perf_counter()
+            t_admit0 = t_disp
+            if p_want:
+                # measured device wall of the verify program itself —
+                # blocks BEFORE the overlapped admission dispatch, so
+                # the sample is the program, not the overlap window
+                p_dec = prof.observe("spec_verify", t0, t_call, t_disp,
+                                     (preds, accepted))
+                self._hbm_update()
+                # admit_dispatch_ms windows the admission work only
+                t_admit0 = time.perf_counter()
             # admission dispatches behind the in-flight verify (stream
             # order, exactly like step_chunk's decode-chunk overlap)
             pending = self._admit_dispatch()
@@ -2688,18 +2817,25 @@ class ContinuousBatchingEngine:
         self.spec_stats["accepted"] += accepted_tot
         self.spec_stats["emitted"] += emitted
         if adv is not None:
-            # device_wall_ms_est spans dispatch-done -> token sync; the
-            # overlapped admission host work inside that window is
+            # sampled: measured schedule/dispatch/device decomposition
+            # (the profiler blocked on the verify outputs BEFORE the
+            # admission overlap). Unsampled fallback: same schedule/
+            # dispatch windows, plus sync_wall_ms spanning
+            # dispatch-done -> token sync — a HOST-wall upper bound
+            # that conflates the overlapped admission work, which is
             # reported separately so a reader can subtract it when a
             # first-time prefill compile (host side) dominates
+            timing = (dict(p_dec, profiled=True) if p_dec is not None
+                      else {"schedule_ms": (t_call - t0) * 1e3,
+                            "dispatch_ms": (t_disp - t_call) * 1e3,
+                            "sync_wall_ms": (t_sync - t_disp) * 1e3})
             tr.step(seq, "verify", t0, time.perf_counter(),
                     occupancy=occ, tokens_advanced=emitted,
                     chunk_budget_spent=S, advanced=adv,
                     proposed=proposed_tot, accepted=accepted_tot,
                     spec=spec_by_rid,
-                    dispatch_ms=(t_disp - t0) * 1e3,
-                    admit_dispatch_ms=(t_admit - t_disp) * 1e3,
-                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
+                    admit_dispatch_ms=(t_admit - t_admit0) * 1e3,
+                    **timing)
         self._integrate_guarded(pending, "verify_integrate")
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
@@ -2730,7 +2866,12 @@ class ContinuousBatchingEngine:
         san = self._san
         if san is not None:
             san.note_tick("step_chunk")
+        wd = self._watchdog
+        if wd is not None:
+            wd.tick_begin()
         out = self._step_chunk_impl(max_chunk)
+        if wd is not None:
+            wd.tick_end()
         if self._injector is not None:
             self._corrupt_point()
         if san is not None:
@@ -2854,6 +2995,7 @@ class ContinuousBatchingEngine:
         # slots must not decode mid-chunk (their lengths land at
         # integrate)
         chunk_slots = self.active.copy()
+        p_dec = None
         try:
             self._fault_point("decode_chunk")
             self._cow_for_decode(K)
@@ -2866,6 +3008,9 @@ class ContinuousBatchingEngine:
             bt = (jnp.asarray(self.pool.block_tables) if self.cfg.paged
                   else jnp.zeros((1,), jnp.int32))
             caches = self.layer_caches if self.cfg.paged else self.caches
+            prof = self._prof
+            p_want = prof is not None and prof.want("decode_chunk")
+            t_call = time.perf_counter()
             with self._ctx():
                 toks_all, caches, _ = self._decode_n()(
                     self._pb, toks, caches, lens, act,
@@ -2875,6 +3020,18 @@ class ContinuousBatchingEngine:
             else:
                 self.caches = caches
             t_disp = time.perf_counter()
+            t_admit0 = t_disp
+            if p_want:
+                # measured device wall of the chunk itself: blocks on
+                # the chunk's outputs BEFORE the overlapped admission
+                # dispatch, so the sample is the program, not the
+                # dispatch-to-token-sync window sync_wall_ms estimates
+                p_dec = prof.observe("decode_chunk", t0, t_call,
+                                     t_disp, toks_all)
+                self._hbm_update()
+                # admit_dispatch_ms must window the ADMISSION work
+                # only — the measured device wait above is not it
+                t_admit0 = time.perf_counter()
             # admission dispatches behind the in-flight chunk (stream
             # order: chunk → prefills → inserts into the chunk's
             # output caches)
@@ -2913,15 +3070,21 @@ class ContinuousBatchingEngine:
                     adv[req.rid] = adv.get(req.rid, 0) + 1
                 self._maybe_finish(slot, tok)
         if adv is not None:
-            # admit_dispatch_ms: host admission work OVERLAPPING the
-            # dispatch->sync window — subtract it from the device-wall
-            # estimate when a first-time compile lands in admission
+            # sampled: measured decomposition. Unsampled fallback:
+            # same schedule/dispatch windows, plus sync_wall_ms
+            # (dispatch-done -> token sync HOST wall) with
+            # admit_dispatch_ms reported separately — host admission
+            # work OVERLAPPING that window, subtractable when a
+            # first-time compile lands in admission
+            timing = (dict(p_dec, profiled=True) if p_dec is not None
+                      else {"schedule_ms": (t_call - t0) * 1e3,
+                            "dispatch_ms": (t_disp - t_call) * 1e3,
+                            "sync_wall_ms": (t_sync - t_disp) * 1e3})
             tr.step(seq, "decode_chunk", t0, time.perf_counter(),
                     occupancy=occ, tokens_advanced=emitted,
                     chunk_budget_spent=K, advanced=adv,
-                    dispatch_ms=(t_disp - t0) * 1e3,
-                    admit_dispatch_ms=(t_admit - t_disp) * 1e3,
-                    device_wall_ms_est=(t_sync - t_disp) * 1e3)
+                    admit_dispatch_ms=(t_admit - t_admit0) * 1e3,
+                    **timing)
         self._integrate_guarded(pending, "chunk_integrate")
         if self._tel is not None:
             self._tel.on_tokens(emitted, (t_sync - t0) * 1e3)
@@ -3037,6 +3200,16 @@ class ContinuousBatchingEngine:
         snap["spec_decode"] = self.spec_snapshot()
         snap["slo"] = self.slo_snapshot()
         snap["resilience"] = self.resilience_snapshot()
+        # program-time attribution (PR 12): measured per-program
+        # device ms, watchdog state and HBM residency ride the one
+        # unified document too. ONE hbm_accounting walk feeds both
+        # the gauges and the snapshot sub-doc.
+        hbm = observability.hbm_accounting(self)
+        if self._tel is not None:
+            self._tel.on_hbm(hbm)
+        snap["programs"] = self.profile_snapshot()
+        snap["recompile"] = self.recompile_snapshot()
+        snap["hbm"] = dict(hbm, total=sum(list(hbm.values())))
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -3139,6 +3312,61 @@ class ContinuousBatchingEngine:
         sweep."""
         if self._tel is not None:
             self._tel.window_reset()
+
+    # ---------------- program-time attribution ----------------
+    def _hbm_update(self):
+        """Refresh the HBM residency gauges + watermarks from the
+        pools the engine owns (array nbytes metadata — no device
+        traffic). Called at init, on profiler-sampled steps and from
+        metrics_snapshot; host-side numbers via ``hbm_snapshot``."""
+        if self._tel is not None:
+            self._tel.on_hbm(observability.hbm_accounting(self))
+
+    def hbm_snapshot(self) -> dict:
+        """Live HBM residency by component (kv_pool, kv_scales,
+        weights_<dtype>, prefix_store) — plain host metadata,
+        available even with PT_FLAGS_telemetry=off."""
+        if self._san is not None:
+            self._san.check_read("hbm_snapshot")
+        st = observability.hbm_accounting(self)
+        st["total"] = sum(list(st.values()))
+        return st
+
+    def profile_snapshot(self) -> dict:
+        """Measured per-program device-time stats (PT_FLAGS_
+        profile_programs; ``{"enabled": False}`` when off). Host
+        counters — available even with PT_FLAGS_telemetry=off."""
+        if self._san is not None:
+            self._san.check_read("profile_snapshot")
+        if self._prof is None:
+            return {"enabled": False}
+        st = self._prof.snapshot()
+        st["enabled"] = True
+        return st
+
+    def recompile_snapshot(self) -> dict:
+        """Recompile-watchdog state (sealed bit, per-program post-seal
+        recompile counts; ``{"enabled": False}`` when off)."""
+        if self._san is not None:
+            self._san.check_read("recompile_snapshot")
+        if self._watchdog is None:
+            return {"enabled": False}
+        return self._watchdog.snapshot()
+
+    def profile_window_reset(self):
+        """Zero the profiler's host-side stats — one measurement
+        window per bench sweep (registry histogram totals keep
+        running, like metrics_window_reset)."""
+        if self._prof is not None:
+            self._prof.window_reset()
+
+    def seal_programs(self):
+        """Seal the recompile watchdog's expected program set NOW
+        (e.g. right after a bench warmup) instead of waiting out
+        PT_FLAGS_recompile_warmup_ticks. No-op when the watchdog is
+        off."""
+        if self._watchdog is not None:
+            self._watchdog.seal()
 
     def prefix_affinity_tokens(self, hashes: List[bytes]) -> int:
         """Read-only prefix-affinity probe for the multi-engine
@@ -3268,8 +3496,21 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                         code, json.dumps(payload, default=str).encode(),
                         "application/json")
                 elif path == "/trace":
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    want_fleet = q.get("fleet", ["0"])[0] in ("1", "true")
                     tracer = getattr(engine, "_tracer", None)
-                    if tracer is None:
+                    if want_fleet and hasattr(engine, "_replicas"):
+                        # /trace?fleet=1 on a router: ONE merged
+                        # Perfetto document — router + every replica
+                        # tracer, failed-over rids joined by flow
+                        # events (tracing.fleet_chrome_trace)
+                        body = json.dumps(
+                            observability.tracing.fleet_chrome_trace(
+                                engine), default=str).encode()
+                        self._send(200, body, "application/json")
+                    elif tracer is None:
                         self._send(404, b"tracing disabled (telemetry "
                                    b"off or trace_sample=0)",
                                    "text/plain")

@@ -10,8 +10,14 @@ and a one-shot dump CLI (``python -m paddle_tpu.observability.dump``).
 no-op (shared null objects, no dict churn). See README "Observability".
 """
 
-from . import tracing  # noqa: F401
+from . import profiling, tracing  # noqa: F401
 from .comm import comm_log, record as record_collective, reset_comm_log  # noqa: F401
+from .profiling import (  # noqa: F401
+    PROGRAM_LABELS,
+    ProgramProfiler,
+    RecompileWatchdog,
+    hbm_accounting,
+)
 from .recorder import AnomalyWatchdog, FlightRecorder  # noqa: F401
 from .tracing import Tracer  # noqa: F401
 from .registry import (  # noqa: F401

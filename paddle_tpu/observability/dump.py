@@ -16,6 +16,11 @@ Options:
   --trace        emit the in-process lifecycle tracers as Chrome
                  trace-event JSON (load in Perfetto / chrome://tracing)
   --trace-jsonl  emit the raw tracer events as JSON-lines instead
+  --fleet        fleet export: for every in-process EngineRouter, its
+                 host-side fleet snapshot plus the MERGED router +
+                 replica Chrome trace (failed-over rids joined by flow
+                 events). For a *running* fleet server, point --url at
+                 /trace?fleet=1 instead
 """
 
 from __future__ import annotations
@@ -59,6 +64,9 @@ def main(argv=None) -> int:
                          "lifecycle tracers (Perfetto-loadable)")
     ap.add_argument("--trace-jsonl", action="store_true",
                     help="raw tracer events as JSON-lines")
+    ap.add_argument("--fleet", action="store_true",
+                    help="per-fleet snapshot + merged router+replica "
+                         "Chrome trace (flow-correlated failovers)")
     args = ap.parse_args(argv)
 
     if args.url:
@@ -70,6 +78,22 @@ def main(argv=None) -> int:
 
     from . import comm, registry, tracing
 
+    if args.fleet:
+        out = []
+        for fleet in tracing.fleets():
+            out.append({
+                # host counters — available with telemetry off
+                "fleet_snapshot": fleet.fleet_snapshot(),
+                "trace": tracing.fleet_chrome_trace(fleet),
+            })
+        json.dump(out, sys.stdout, default=str)
+        sys.stdout.write("\n")
+        if not out:
+            print("dump --fleet: no in-process EngineRouter "
+                  "registered (use --url http://host:port/trace?"
+                  "fleet=1 for a running fleet server)",
+                  file=sys.stderr)
+        return 0
     if args.trace:
         json.dump(tracing.chrome_trace(), sys.stdout, default=str)
         sys.stdout.write("\n")

@@ -1,0 +1,432 @@
+"""Measured per-program device-time attribution for the serving engine.
+
+Every serving perf claim since the fused-decode PR has been verified by
+MODELED HBM bytes and compile counts; this module is the measurement
+layer that lets those models be laid against reality:
+
+* :class:`ProgramProfiler` — cadence-sampled ``block_until_ready``
+  timing around every compiled serving dispatch. A SAMPLED dispatch
+  records the measured three-way decomposition
+
+      schedule_ms  host work before the jit call (COW checks, sampling
+                   vectors, array staging)
+      dispatch_ms  the jit call itself (cache lookup + async dispatch;
+                   a compile lands here)
+      device_ms    dispatch-done → ``block_until_ready`` on the
+                   program's own outputs — MEASURED device wall, not
+                   the dispatch-to-token-sync estimate the tracer's
+                   ``sync_wall_ms`` field falls back to
+
+  into ``pt_serve_program_ms{engine,program}`` (plus dispatch/schedule
+  histograms) and host-side stats that survive telemetry=off.
+  UNSAMPLED dispatches stay fully async: the engine's seams consult
+  ``want()`` (one int increment) and never sync — the PR-2 cadence
+  discipline. With ``PT_FLAGS_profile_programs`` off the engine holds
+  no profiler at all (one identity check per seam, zero new compiled
+  programs — pinned by test).
+
+* :class:`RecompileWatchdog` — seals the expected compiled-program set
+  after warmup and, on any post-seal ``TRACE_COUNTS`` growth during
+  one of the OWNING engine's own ticks, counts
+  ``pt_serve_recompiles_total{engine,program}`` and dumps a
+  FlightRecorder artifact carrying the offending specialization's arg
+  shapes (``TRACE_SHAPES``, recorded at trace time). The production
+  complement to ptlint TS003 (jit-wrapper-in-loop) and the test-only
+  ``compile_counter`` guards: those catch recompiles in CI workloads,
+  this catches them in live traffic. Tick-scoped diffs keep engines in
+  one process from blaming each other's warmup compiles.
+
+* :func:`hbm_accounting` — live HBM residency derived from the pools
+  the engine already owns (array ``nbytes`` metadata — no device
+  traffic): KV pool bytes including int8 scale rows, weight/buffer
+  bytes by dtype, contiguous prefix-store bytes.
+
+``PROGRAM_LABELS`` is the attribution registry ptlint's OBS001 rule
+checks for completeness: every ``TRACE_COUNTS``-registered program name
+must carry a timing label here, so a new compiled program cannot ship
+without joining the attribution surface.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .. import flags
+from .registry import exp_buckets, get_registry
+
+# ---------------------------------------------------------------------------
+# the attribution registry (ptlint OBS001: every TRACE_COUNTS program
+# name must appear here — keep the keys in sync with the compile
+# counters in inference/serving.py; the runtime twin of the static rule
+# lives in tests/test_profiling.py)
+# ---------------------------------------------------------------------------
+PROGRAM_LABELS: Dict[str, str] = {
+    "prefill_chunk": "fixed [slots, C] chunked prefill (THE prefill "
+                     "program; one call per suffix chunk of a wave)",
+    "prefill_bucket": "legacy per-bucket whole-prompt prefill (the "
+                      "parity oracle; one specialization per bucket)",
+    "prefill_insert": "legacy contiguous prefill cache insert "
+                      "(dynamic_update_slice into the slot rows)",
+    "prefill_scatter": "legacy paged prefill page scatter (bucket "
+                       "cache -> the slot's pages)",
+    "prefix_insert": "contiguous prefix-cache block insert (cached "
+                     "K/V block -> a slot's rows)",
+    "prefix_read": "contiguous prefix-cache block read (a slot's "
+                   "rows -> the store's materialized block)",
+    "page_copy": "copy-on-write page duplication across every "
+                 "layer's pool (src -> dst, scales ride along)",
+    "decode_step": "one-token decode over all slots ([slots, 1])",
+    "decode_chunk": "K-step fused decode chunk (lax.scan; one host "
+                    "sync per K tokens)",
+    "spec_verify": "speculative [slots, spec_k+1] multi-token verify "
+                   "pass with in-jit greedy acceptance",
+}
+
+# dispatch seams the engine actually times (the rest of the labels are
+# attribution-only: trace-count registered, priced by kernelbench, but
+# dispatched rarely enough that their wall rides the seams above)
+TIMED_PROGRAMS = frozenset({
+    "prefill_chunk", "prefill_bucket", "decode_step", "decode_chunk",
+    "spec_verify", "page_copy",
+})
+
+
+class ProgramProfiler:
+    """Per-engine cadence-sampled program timer.
+
+    ``want(program)`` increments that program's dispatch counter and
+    returns True on the sampling cadence (every Nth dispatch per
+    program — deterministic, like the tracer's request thinning). The
+    engine then brackets the dispatch with ``t0``/``t_call``/``t_disp``
+    stamps and calls :meth:`observe`, which blocks until the program's
+    own outputs are ready and records the measured decomposition.
+
+    Host-side stats (:meth:`snapshot`) survive ``PT_FLAGS_telemetry=
+    off``; the registry histograms no-op through the null registry
+    then, same contract as every other serving counter.
+    """
+
+    _SEQ = 0  # fallback engine ids when telemetry is off
+
+    def __init__(self, engine_id: Optional[str] = None,
+                 sample_every: Optional[int] = None,
+                 window: int = 256):
+        if engine_id is None:
+            engine_id = f"p{ProgramProfiler._SEQ}"
+            ProgramProfiler._SEQ += 1
+        self.engine_id = str(engine_id)
+        if sample_every is None:
+            sample_every = int(flags.flag("profile_sample_every"))
+        self.sample_every = max(int(sample_every), 1)
+        self._window = max(int(window), 1)
+        # program -> {"dispatches", "sampled", totals, deques}
+        self._stats: Dict[str, dict] = {}
+        reg = get_registry()
+        L = ("engine", "program")
+        self._h_device = reg.histogram(
+            "pt_serve_program_ms",
+            "MEASURED device wall per sampled compiled-serving-program "
+            "dispatch (block_until_ready on the program's own outputs "
+            "— not the dispatch-to-token-sync estimate)",
+            labels=L, buckets=exp_buckets(0.05, 2.0, 20))
+        self._h_dispatch = reg.histogram(
+            "pt_serve_program_dispatch_ms",
+            "host dispatch wall per sampled dispatch (jit cache "
+            "lookup + async dispatch; compiles land here)",
+            labels=L, buckets=exp_buckets(0.05, 2.0, 18))
+        self._h_schedule = reg.histogram(
+            "pt_serve_program_schedule_ms",
+            "host scheduling wall before the jit call per sampled "
+            "dispatch (COW checks, sampling vectors, array staging)",
+            labels=L, buckets=exp_buckets(0.05, 2.0, 18))
+
+    def _prog(self, program: str) -> dict:
+        st = self._stats.get(program)
+        if st is None:
+            if program not in PROGRAM_LABELS:
+                raise ValueError(
+                    f"unknown program {program!r} — register a timing "
+                    "label in observability.profiling.PROGRAM_LABELS "
+                    "(ptlint OBS001 keeps this registry complete)")
+            st = self._stats[program] = {
+                "dispatches": 0, "sampled": 0,
+                "device_ms_total": 0.0, "device_ms_max": 0.0,
+                "dispatch_ms_total": 0.0, "schedule_ms_total": 0.0,
+                "win": deque(maxlen=self._window),
+            }
+        return st
+
+    # ---------------- sampling ----------------
+    def want(self, program: str) -> bool:
+        """One dispatch of ``program``; True when THIS dispatch is on
+        the sampling cadence. Cadence N samples dispatches N, 2N, ...
+        — a program's first dispatch (its compile) is only sampled at
+        cadence 1, so steady-state windows stay compile-free."""
+        st = self._prog(program)
+        st["dispatches"] += 1
+        return st["dispatches"] % self.sample_every == 0
+
+    # ---------------- measurement ----------------
+    def observe(self, program: str, t0: float, t_call: float,
+                t_disp: float, out) -> dict:
+        """Block until ``out`` (the program's own outputs) is ready and
+        record the measured decomposition. Returns the decomposition
+        dict so the caller can embed it in the tracer's step event."""
+        import jax
+
+        jax.block_until_ready(out)
+        t_dev = time.perf_counter()
+        dec = {
+            "schedule_ms": (t_call - t0) * 1e3,
+            "dispatch_ms": (t_disp - t_call) * 1e3,
+            "device_ms": (t_dev - t_disp) * 1e3,
+        }
+        st = self._prog(program)
+        st["sampled"] += 1
+        st["device_ms_total"] += dec["device_ms"]
+        st["device_ms_max"] = max(st["device_ms_max"], dec["device_ms"])
+        st["dispatch_ms_total"] += dec["dispatch_ms"]
+        st["schedule_ms_total"] += dec["schedule_ms"]
+        st["win"].append(dec["device_ms"])
+        lab = {"engine": self.engine_id, "program": program}
+        self._h_device.observe(dec["device_ms"], **lab)
+        self._h_dispatch.observe(dec["dispatch_ms"], **lab)
+        self._h_schedule.observe(dec["schedule_ms"], **lab)
+        return dec
+
+    # ---------------- read side ----------------
+    def snapshot(self) -> dict:
+        """Per-program measured stats (copy-on-read: the scrape thread
+        calls this through ``engine.profile_snapshot()``)."""
+        programs = {}
+        for name, st in list(self._stats.items()):
+            win = sorted(st["win"])  # deque snapshot -> new list
+            sampled = st["sampled"]
+            programs[name] = {
+                "dispatches": st["dispatches"],
+                "sampled": sampled,
+                "device_ms_p50": (win[len(win) // 2] if win else None),
+                "device_ms_mean": (st["device_ms_total"] / sampled
+                                   if sampled else None),
+                "device_ms_max": (st["device_ms_max"] if sampled
+                                  else None),
+                "dispatch_ms_mean": (st["dispatch_ms_total"] / sampled
+                                     if sampled else None),
+                "schedule_ms_mean": (st["schedule_ms_total"] / sampled
+                                     if sampled else None),
+            }
+        return {
+            "engine": self.engine_id,
+            "sample_every": self.sample_every,
+            "programs": programs,
+        }
+
+    def window_reset(self):
+        """Zero the host-side stats — one measurement window per bench
+        sweep (registry histogram totals keep running, same contract
+        as ``metrics_window_reset``)."""
+        self._stats = {}
+
+
+class RecompileWatchdog:
+    """Seal-then-watch guard over the trace-time compile counters.
+
+    The owning engine calls ``tick_begin()``/``tick_end()`` around each
+    scheduler tick. Pre-seal, ticks just count toward
+    ``warmup_ticks`` (compiles are expected while programs warm up);
+    once sealed — by the tick budget or an explicit :meth:`seal` —
+    every tick snapshots the counters at entry and diffs at exit, so
+    growth is attributed to THIS engine's own tick (two engines in one
+    process never blame each other's warmup). A detected recompile
+    increments host + registry counters and (telemetry on) dumps a
+    FlightRecorder artifact with the offending program's trace-time
+    arg shapes. It never raises: production keeps serving; the strict
+    fail-on-recompile contract stays with the test-only
+    ``compile_counter`` guards.
+    """
+
+    def __init__(self, counts, shapes, engine_id: str = "0",
+                 warmup_ticks: Optional[int] = None,
+                 dump: bool = True):
+        """``counts``/``shapes``: the serving module's ``TRACE_COUNTS``
+        / ``TRACE_SHAPES`` mappings (passed in — observability must not
+        import the inference package)."""
+        self._counts = counts
+        self._shapes = shapes
+        self.engine_id = str(engine_id)
+        if warmup_ticks is None:
+            warmup_ticks = int(flags.flag("recompile_warmup_ticks"))
+        self.warmup_ticks = max(int(warmup_ticks), 0)
+        self._dump = bool(dump)
+        self._ticks = 0
+        self.sealed = False
+        self._base: Optional[Dict[str, int]] = None
+        self.recompiles: Dict[str, int] = {}
+        self._recorder = None
+        self._counter = get_registry().counter(
+            "pt_serve_recompiles_total",
+            "post-seal jit re-specializations of a compiled serving "
+            "program detected by the runtime recompile watchdog "
+            "(TRACE_COUNTS growth during one of the owning engine's "
+            "own ticks) — each one also leaves a FlightRecorder "
+            "artifact naming the offending arg shapes",
+            ("engine", "program"))
+
+    def seal(self):
+        """Seal the expected program set NOW (e.g. right after a bench
+        warmup) — later compiles are recompiles."""
+        self.sealed = True
+
+    # ---------------- tick hooks ----------------
+    def tick_begin(self):
+        if not self.sealed:
+            self._ticks += 1
+            if self._ticks >= self.warmup_ticks:
+                self.sealed = True
+            return
+        self._base = dict(self._counts)
+
+    def tick_end(self) -> List[str]:
+        """Diff this tick's compile counters; returns the programs
+        that re-specialized (empty pre-seal)."""
+        base = self._base
+        if base is None:
+            return []
+        self._base = None
+        grown = {k: v - base.get(k, 0)
+                 for k, v in list(self._counts.items())
+                 if v > base.get(k, 0)}
+        for program, n in grown.items():
+            # count by the DELTA: one tick can re-specialize a
+            # program several times (e.g. two never-seen buckets in
+            # one admission wave). The shape artifact names the most
+            # recent specialization only — TRACE_SHAPES holds one
+            # note per program by design.
+            first = program not in self.recompiles
+            self.recompiles[program] = \
+                self.recompiles.get(program, 0) + n
+            self._counter.inc(n, engine=self.engine_id,
+                              program=program)
+            if first:
+                # ONE artifact per program per watchdog: counters keep
+                # counting, but sustained legitimate specialization
+                # after an undersized warmup (e.g. legacy bucketed
+                # prefill meeting a new bucket, the first COW
+                # compiling page_copy late) must not fill the dump
+                # dir with a file per tick
+                self._dump_artifact(program)
+        return list(grown)
+
+    def _dump_artifact(self, program: str):
+        """FlightRecorder postmortem: which program re-specialized,
+        with the arg shapes its trace-time shape note recorded — the
+        evidence a shape-drift bug needs. Telemetry off = counters
+        only (same gate as the engine's NaN dumps)."""
+        from .registry import enabled
+
+        if not self._dump or not enabled():
+            return
+        if self._recorder is None:
+            from .recorder import FlightRecorder
+
+            self._recorder = FlightRecorder(
+                capacity=int(flags.flag("telemetry_flight_window")),
+                dump_dir=str(flags.flag("telemetry_dump_dir")))
+        self._recorder.record(
+            kind="serve_recompile", program=program,
+            engine=self.engine_id,
+            count=int(self._counts.get(program, 0)),
+            arg_shapes=dict(self._shapes.get(program) or {}))
+        self._recorder.dump(
+            f"post-seal recompile of serving program {program!r} "
+            f"(engine {self.engine_id}) — arg shapes attached")
+
+    # ---------------- read side ----------------
+    def snapshot(self) -> dict:
+        return {
+            "enabled": True,
+            "sealed": self.sealed,
+            "warmup_ticks": self.warmup_ticks,
+            "ticks": self._ticks,
+            "recompiles": {k: v for k, v
+                           in list(self.recompiles.items())},
+        }
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting
+# ---------------------------------------------------------------------------
+def _nbytes(arr) -> int:
+    nb = getattr(arr, "nbytes", None)
+    return int(nb) if nb is not None else 0
+
+
+def weight_bytes_by_dtype(*sources) -> Dict[str, int]:
+    """``weights_<dtype>`` → bytes over param/buffer mappings. The
+    model tree is immutable after engine init, so the engine computes
+    this ONCE and caches it (``engine._hbm_weights``) — a profiler-
+    sampled dispatch must not re-walk hundreds of leaves per sample."""
+    out: Dict[str, int] = {}
+    for src in sources:
+        for v in list(src.values()):
+            dt = str(getattr(v, "dtype", "unknown"))
+            key = f"weights_{dt}"
+            out[key] = out.get(key, 0) + _nbytes(v)
+    return out
+
+
+def hbm_accounting(engine) -> Dict[str, int]:
+    """Component → bytes for the device memory the engine owns, from
+    array ``nbytes`` METADATA only (no device traffic, scrape-thread
+    safe):
+
+      * ``kv_pool`` — the KV cache payload (paged pools or contiguous
+        caches; int8 quantized payloads count at their int8 width);
+      * ``kv_scales`` — the int8 pools' per-row f32 dequant scales
+        (0 for float caches);
+      * ``weights_<dtype>`` — model params + buffers grouped by dtype
+        (int8/int4 qweights and their f32 group scales land in their
+        own rows — the quantized-serving residency split);
+      * ``prefix_store`` — the CONTIGUOUS prefix store's materialized
+        blocks (real device memory on top of the engine's own cache;
+        the paged store refcounts pool pages and owns no extra bytes).
+    """
+    from ..inference.paged import QuantizedKV
+
+    out: Dict[str, int] = {"kv_pool": 0, "kv_scales": 0,
+                           "prefix_store": 0}
+
+    def kv_leaf(x):
+        if isinstance(x, QuantizedKV):
+            out["kv_pool"] += _nbytes(x.q)
+            out["kv_scales"] += _nbytes(x.scale)
+        else:
+            out["kv_pool"] += _nbytes(x)
+
+    if engine.cfg.paged:
+        for c in list(engine.layer_caches):
+            out["kv_pool"] += _nbytes(c.k_pages) + _nbytes(c.v_pages)
+            if getattr(c, "k_scale", None) is not None:
+                out["kv_scales"] += _nbytes(c.k_scale)
+                out["kv_scales"] += _nbytes(c.v_scale)
+    else:
+        for k, v in list(engine.caches):
+            kv_leaf(k)
+            kv_leaf(v)
+        store = engine._prefix
+        if store is not None:
+            for kb, vb in list(getattr(store, "_blocks", {}).values()):
+                kv = 0
+                for blk in (kb, vb):
+                    if isinstance(blk, QuantizedKV):
+                        kv += _nbytes(blk.q) + _nbytes(blk.scale)
+                    else:
+                        kv += _nbytes(blk)
+                out["prefix_store"] += kv
+    static = getattr(engine, "_hbm_weights", None)
+    if static is None:
+        static = weight_bytes_by_dtype(engine.params, engine.buffers)
+    out.update(static)
+    return out

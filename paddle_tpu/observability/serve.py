@@ -152,6 +152,21 @@ class ServingTelemetry:
             "pt_serve_draining",
             "1 while the engine drains (admission stopped, in-flight "
             "running to completion)", L)
+        self._hbm = reg.gauge(
+            "pt_serve_hbm_bytes",
+            "live HBM residency by component, from array-metadata "
+            "nbytes (kv_pool, kv_scales [int8 dequant rows], "
+            "weights_<dtype>, prefix_store [contiguous materialized "
+            "blocks]) — observability/profiling.hbm_accounting",
+            ("engine", "component"))
+        self._hbm_peak = reg.gauge(
+            "pt_serve_hbm_bytes_peak",
+            "high-watermark of pt_serve_hbm_bytes per component this "
+            "window", ("engine", "component"))
+        # component labels seen so far — window_reset must zero each
+        # peak series this engine created (labels aren't enumerable
+        # from the gauge side)
+        self._hbm_components: set = set()
         LS = ("engine", "slo")
         self._slo_met = reg.counter(
             "pt_serve_slo_met_total",
@@ -243,6 +258,15 @@ class ServingTelemetry:
             # keep the residency gauge honest between admissions —
             # evictions under pure decode pressure must show up too
             self._pfx_cached.set(cached_blocks, **lab)
+
+    def on_hbm(self, components: dict):
+        """Refresh the HBM residency gauges + watermarks (component →
+        bytes, from ``profiling.hbm_accounting``)."""
+        for comp, nbytes in list(components.items()):
+            lab = dict(self._lab(), component=comp)
+            self._hbm.set(nbytes, **lab)
+            self._hbm_peak.set_max(nbytes, **lab)
+            self._hbm_components.add(comp)
 
     def on_spec_slot(self, proposed: int, accepted: int):
         """One slot's outcome in one verify pass — feeds the
@@ -363,6 +387,8 @@ class ServingTelemetry:
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)
         self._kv_peak.set(0.0, **lab)
+        for comp in list(self._hbm_components):
+            self._hbm_peak.set(0, component=comp, **lab)
 
 
 _ROUTER_SEQ = itertools.count()

@@ -47,6 +47,22 @@ from .. import flags
 # the dump CLI and the flight recorder read the process-wide view
 _TRACERS: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
 
+# live fleets (EngineRouter registers itself at construction; weak so a
+# dropped router drops here too) — `dump --fleet` and the merged-trace
+# export read the process-wide view
+_FLEETS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_fleet(fleet):
+    """Record a multi-engine front door (``inference/router.py``'s
+    ``EngineRouter``) for process-wide fleet exports: ``dump --fleet``
+    and :func:`fleet_chrome_trace`."""
+    _FLEETS.add(fleet)
+
+
+def fleets() -> List[object]:
+    return list(_FLEETS)
+
 
 def sample_period(rate: float) -> int:
     """rate → keep-every-Nth period: 1.0 → 1, 0.5 → 2, 0.1 → 10."""
@@ -216,6 +232,115 @@ def chrome_trace(tracers: Optional[List[Tracer]] = None) -> dict:
     """Perfetto/chrome://tracing-loadable document."""
     return {"traceEvents": chrome_events(tracers),
             "displayTimeUnit": "ms"}
+
+
+def _rid_hops(tracers: List[Tracer]):
+    """Per-tracer per-rid request activity: ``[(tracer, {rid: {first,
+    last, spans}})]`` — the raw material for cross-replica flow
+    correlation. Spans are (t0, t1) pairs; instants only move the
+    first/last stamps."""
+    per = []
+    for tr in tracers:
+        rids: dict = {}
+        for ev in tr.events():
+            if ev["kind"] != "request":
+                continue
+            d = rids.setdefault(
+                ev["rid"], {"first": ev["t0"], "last": ev["t0"],
+                            "spans": []})
+            d["first"] = min(d["first"], ev["t0"])
+            d["last"] = max(d["last"], ev["t0"])
+            if ev["t1"] is not None:
+                d["spans"].append((ev["t0"], ev["t1"]))
+        per.append((tr, rids))
+    return per
+
+
+def _flow_anchor(d: dict, last: bool):
+    """(ts_us inside an X slice, synthesized_event_or_None) for one
+    hop end. Flow events bind to the slice ENCLOSING their ts on that
+    pid/tid, so when the hop's rid has no span there (all instants — a
+    reclaimed victim that re-queued but never finished, say) a 1 µs
+    ``handoff`` slice is synthesized at the boundary instant."""
+    if d["spans"]:
+        spans = sorted(d["spans"])
+        t0, t1 = spans[-1] if last else spans[0]
+        return (t0 + max(t1 - t0, 0) / 2) * 1e6, None
+    t = (d["last"] if last else d["first"]) * 1e6
+    return t + 0.5, {"name": "handoff", "ph": "X", "ts": t, "dur": 1.0,
+                     "cat": "request"}
+
+
+def fleet_flow_events(tracers: List[Tracer]) -> List[dict]:
+    """Chrome flow events (``ph`` ``s``/``f``, ``id`` = rid) joining a
+    request's spans across every tracer it visited — the line Perfetto
+    draws from a failed-over rid's life on the dead replica to its
+    replayed life on the survivor. Consecutive hops are ordered by the
+    rid's first event time per tracer."""
+    per = _rid_hops(tracers)
+    all_rids = set()
+    for _tr, rids in per:
+        all_rids.update(rids)
+    out: List[dict] = []
+    # a span-less MIDDLE hop of a 3+ hop chain anchors both its
+    # incoming flow finish and its outgoing flow start — synthesize
+    # its handoff slice once, not per adjacent pair
+    seen_syn = set()
+    for rid in sorted(all_rids):
+        hops = sorted(
+            ((d[rid]["first"], tr, d[rid]) for tr, d in per
+             if rid in d), key=lambda h: h[0])
+        if len(hops) < 2:
+            continue
+        for (_, tr_a, d_a), (_, tr_b, d_b) in zip(hops, hops[1:]):
+            ts_a, syn_a = _flow_anchor(d_a, last=True)
+            ts_b, syn_b = _flow_anchor(d_b, last=False)
+            pid_a, pid_b = _pid(tr_a), _pid(tr_b)
+            tid = rid + 1
+            for syn, pid in ((syn_a, pid_a), (syn_b, pid_b)):
+                if syn is not None:
+                    key = (pid, tid, syn["ts"])
+                    if key not in seen_syn:
+                        seen_syn.add(key)
+                        out.append(dict(syn, pid=pid, tid=tid,
+                                        args={"rid": rid}))
+            flow = {"name": f"rid {rid}", "cat": "failover",
+                    "id": int(rid), "tid": tid}
+            out.append(dict(flow, ph="s", ts=ts_a, pid=pid_a))
+            # bp:"e" binds the finish to its ENCLOSING slice (the
+            # replayed life's first span), not the next slice to start
+            out.append(dict(flow, ph="f", bp="e", ts=ts_b, pid=pid_b))
+    return out
+
+
+def fleet_chrome_trace(fleet=None) -> dict:
+    """ONE Perfetto-loadable document for a whole fleet: the router's
+    tracer and every replica engine's tracer merged, with a
+    failed-over rid's spans appearing on BOTH replicas' request tracks
+    joined by flow events (:func:`fleet_flow_events`). ``fleet`` is an
+    ``EngineRouter`` (duck-typed: ``_tracer`` + ``_replicas``); None
+    merges every live tracer in the process — the ``dump --fleet`` /
+    ``/trace?fleet=1`` export path."""
+    if fleet is None:
+        tracers = all_tracers()
+        # deterministic merge order regardless of weakset iteration
+        tracers.sort(key=lambda t: t.engine_id)
+        flow_from = tracers
+    else:
+        tracers = []
+        rt = getattr(fleet, "_tracer", None)
+        if rt is not None:
+            tracers.append(rt)
+        flow_from = []
+        for rep in list(getattr(fleet, "_replicas", ())):
+            tr = getattr(rep.engine, "_tracer", None)
+            if tr is not None:
+                tracers.append(tr)
+                flow_from.append(tr)
+    events = chrome_events(tracers)
+    events.extend(fleet_flow_events(flow_from))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def jsonl(tracers: Optional[List[Tracer]] = None) -> str:

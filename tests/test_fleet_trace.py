@@ -1,0 +1,205 @@
+"""Fleet-correlated tracing: ONE merged Perfetto document for the
+whole fleet (``observability.tracing.fleet_chrome_trace``).
+
+Under test:
+  - a scripted 2-replica crash-failover produces a merged trace that
+    validates against the Chrome trace-event schema AND shows each
+    victim rid's spans on BOTH replicas' request tracks, joined by
+    flow events (``ph: s``/``f`` with ``id == rid``) — the acceptance
+    pin: a failed-over request's journey is ONE timeline;
+  - ``/trace?fleet=1`` on the fleet metrics server serves the merged
+    document (plain ``/trace`` keeps serving the router's own event
+    stream);
+  - ``python -m paddle_tpu.observability.dump --fleet`` exports the
+    fleet snapshot + merged trace for every in-process router;
+  - telemetry off: no tracers exist, the merged export degrades to an
+    empty event list without error (the fleet snapshot stays
+    host-side).
+"""
+
+import io
+import contextlib
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+import serving_utils
+
+from paddle_tpu import flags as F
+from paddle_tpu.inference.resilience import FaultInjector
+from paddle_tpu.inference.router import EngineRouter
+from paddle_tpu.inference.serving import start_metrics_server
+from paddle_tpu.observability import dump as dump_cli
+from paddle_tpu.observability import tracing
+
+pytestmark = pytest.mark.chaos
+
+
+def _model(seed=0):
+    return serving_utils.tiny_model(seed)
+
+
+def _ecfg(paged=True, **kw):
+    return serving_utils.tiny_ecfg(paged, **kw)
+
+
+class ScriptedInjector(FaultInjector):
+    """fire() hits at EXACT scripted consultation indices per site
+    (same idiom as test_router's scripted scenarios)."""
+
+    def __init__(self, plan):
+        super().__init__("")
+        self._plan = {s: set(v) for s, v in plan.items()}
+
+    def fire(self, site):
+        n = self.draws[site]
+        self.draws[site] = n + 1
+        hit = n in self._plan.get(site, ())
+        if hit:
+            self.fires[site] += 1
+        return hit
+
+
+@pytest.fixture
+def obs_flags():
+    keys = ("telemetry", "trace_sample")
+    saved = {k: F.flag(k) for k in keys}
+    yield F.set_flags
+    F.set_flags(saved)
+
+
+def _validate_chrome(doc):
+    """Chrome trace-event schema incl. flow events (the shape
+    Perfetto loads): X/i/M as in test_tracing, plus s/f flows with a
+    numeric id."""
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    json.loads(json.dumps(doc, default=str))
+    for e in doc["traceEvents"]:
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["ph"] in ("X", "i", "M", "s", "f")
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], float) and e["ts"] >= 0.0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0.0
+        if e["ph"] == "i":
+            assert e["s"] in ("t", "p", "g")
+        if e["ph"] in ("s", "f"):
+            assert isinstance(e["id"], int)
+        if e["ph"] == "f":
+            assert e["bp"] == "e"  # bind to the ENCLOSING slice
+
+
+def _scripted_crash_fleet(obs_flags, seed=0):
+    """2-replica fleet, replica 0 crashed mid-flight at tick 3 —
+    returns (router, victim rids)."""
+    obs_flags({"telemetry": True, "trace_sample": 1.0})
+    model, cfg = _model(seed)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            (int(rng.integers(6, 20)),))
+               for _ in range(4)]
+    inj = ScriptedInjector({"replica_crash": {4}})
+    router = EngineRouter(model, _ecfg(), n_replicas=2,
+                          fault_injector=inj)
+    for p in prompts:
+        router.add_request(p, 8)
+    router.step(2)
+    router.step(2)
+    victims = [r.rid for r
+               in router._replicas[0].engine._slot_req.values()]
+    assert victims, "replica 0 held nothing — scenario is vacuous"
+    while router.step(2):
+        pass
+    assert router.fleet_stats["failovers"] == 1
+    return router, victims
+
+
+def test_fleet_trace_crash_failover_flow_correlation(obs_flags):
+    """THE acceptance pin: the merged trace validates against the
+    Chrome schema and shows each victim rid's spans on BOTH replicas,
+    connected by an s→f flow pair with id == rid."""
+    router, victims = _scripted_crash_fleet(obs_flags)
+    doc = router.fleet_chrome_trace()
+    _validate_chrome(doc)
+    evs = doc["traceEvents"]
+    replica_pids = {tracing._pid(rep.engine._tracer)
+                    for rep in router._replicas}
+    assert len(replica_pids) == 2
+    for rid in victims:
+        # spans on BOTH replicas' request tracks
+        span_pids = {e["pid"] for e in evs if e["ph"] == "X"
+                     and e.get("args", {}).get("rid") == rid}
+        assert span_pids >= replica_pids, (
+            f"rid {rid} spans missing on a replica: {span_pids}")
+        # ...joined by a flow: start on the dead replica, finish on
+        # the survivor, same id, request tid on both sides
+        starts = [e for e in evs if e["ph"] == "s" and e["id"] == rid]
+        ends = [e for e in evs if e["ph"] == "f" and e["id"] == rid]
+        assert len(starts) == 1 and len(ends) == 1
+        assert starts[0]["pid"] != ends[0]["pid"]
+        assert {starts[0]["pid"], ends[0]["pid"]} == replica_pids
+        assert starts[0]["tid"] == ends[0]["tid"] == rid + 1
+        assert starts[0]["ts"] <= ends[0]["ts"]
+    # non-victims never grew a flow
+    flows = {e["id"] for e in evs if e["ph"] in ("s", "f")}
+    assert flows == set(victims)
+    # the router's own control-plane stream rides the same document
+    names = {e["name"] for e in evs}
+    assert "failover" in names and "route" in names
+
+
+def test_fleet_trace_server_endpoint(obs_flags):
+    """/trace?fleet=1 serves the merged document; plain /trace keeps
+    the router-tracer-only view (backwards compatible)."""
+    router, victims = _scripted_crash_fleet(obs_flags, seed=1)
+    srv = start_metrics_server(router)
+    try:
+        host, port = srv.server_address[:2]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/trace?fleet=1") as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+        _validate_chrome(doc)
+        assert any(e["ph"] in ("s", "f") for e in doc["traceEvents"])
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/trace") as resp:
+            assert resp.status == 200
+            solo = json.loads(resp.read())
+        # router-only view: control-plane instants, no request spans
+        assert not any(e.get("cat") == "request"
+                       for e in solo["traceEvents"])
+    finally:
+        srv.shutdown()
+
+
+def test_dump_fleet_cli(obs_flags):
+    router, victims = _scripted_crash_fleet(obs_flags, seed=2)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = dump_cli.main(["--fleet"])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    mine = next(o for o in out
+                if o["fleet_snapshot"]["failovers"] == 1
+                and o["fleet_snapshot"]["n_replicas"] == 2)
+    _validate_chrome(mine["trace"])
+    assert router in tracing.fleets()
+
+
+def test_fleet_trace_telemetry_off_degrades_cleanly():
+    """conftest default (telemetry off): no tracers exist anywhere —
+    the merged export is an empty event list, the fleet snapshot
+    stays available, nothing raises."""
+    model, cfg = _model(3)
+    router = EngineRouter(model, _ecfg(), n_replicas=2)
+    assert router._tracer is None
+    doc = router.fleet_chrome_trace()
+    assert doc["traceEvents"] == []
+    assert router.fleet_snapshot()["n_replicas"] == 2
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert dump_cli.main(["--fleet"]) == 0
+    assert isinstance(json.loads(buf.getvalue()), list)

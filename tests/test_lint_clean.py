@@ -171,6 +171,34 @@ def test_rules_fire_on_synthetic_module(tmp_repo):
     assert len(ts) == 3, [(v.line, v.message) for v in ts]
 
 
+def test_obs001_fires_on_unlabeled_program(tmp_repo):
+    """OBS001: a TRACE_COUNTS program name with no PROGRAM_LABELS
+    timing label is a completeness violation; labeled names pass.
+    Without the profiling module in the scan (partial scan) the rule
+    stays silent, like FL001 without the flag registry."""
+    prof = tmp_repo / "paddle_tpu" / "observability"
+    prof.mkdir(parents=True)
+    prof_py = prof / "profiling.py"
+    prof_py.write_text(
+        'PROGRAM_LABELS = {"known": "a labeled program"}\n')
+    srv = tmp_repo / "paddle_tpu" / "inference" / "srv.py"
+    srv.write_text(
+        "import collections\n"
+        "TRACE_COUNTS = collections.Counter()\n"
+        "def a():\n"
+        '    TRACE_COUNTS["known"] += 1\n'
+        "def b():\n"
+        '    TRACE_COUNTS["mystery"] += 1\n')
+    result = lint.scan([str(tmp_repo / "paddle_tpu")], str(tmp_repo))
+    obs = [v for v in result.violations if v.rule == "OBS001"]
+    assert len(obs) == 1, obs
+    assert "mystery" in obs[0].message
+    assert obs[0].file.endswith("srv.py")
+    # partial scan without the label registry: silent, not noisy
+    result = lint.scan([str(srv)], str(tmp_repo))
+    assert not [v for v in result.violations if v.rule == "OBS001"]
+
+
 def test_inline_suppression_and_skip_file(tmp_repo):
     bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
     # the marker is assembled at runtime so scanning THIS test file

@@ -1,0 +1,319 @@
+"""Measured per-program device-time attribution + runtime recompile /
+HBM watchdogs (``observability/profiling.py`` + the serving engine's
+dispatch-seam hooks).
+
+Under test:
+  - **off == identity**: ``PT_FLAGS_profile_programs`` off leaves the
+    engine with no profiler (one identity check per seam); on, the
+    compiled-program set is UNCHANGED (compile_counter equality) and
+    greedy outputs are bit-identical — the profiler only measures;
+  - sampled dispatches record the MEASURED schedule/dispatch/device
+    decomposition (host stats + ``pt_serve_program_ms`` histograms +
+    ``profiled=True`` tracer step events); unsampled dispatches keep
+    the honest ``sync_wall_ms`` fallback;
+  - the sampling cadence is deterministic per program;
+  - the recompile watchdog seals after warmup (tick budget or
+    ``seal_programs()``) and fires on a deliberately shape-busting
+    dispatch: host counters, the registry counter, and a
+    FlightRecorder artifact carrying the offending arg shapes;
+  - HBM accounting: kv_pool / kv_scales (int8) / weights_<dtype> /
+    prefix_store components from array metadata only;
+  - ``PROGRAM_LABELS`` covers every TRACE_COUNTS program name — the
+    runtime twin of ptlint's OBS001 static rule.
+"""
+
+import ast
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import serving_utils
+
+from paddle_tpu import flags as F
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import serving
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.observability.profiling import (
+    PROGRAM_LABELS,
+    ProgramProfiler,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def _model(seed=0):
+    return serving_utils.tiny_model(seed)
+
+
+def _ecfg(paged, **kw):
+    return serving_utils.tiny_ecfg(paged, **kw)
+
+
+def _prompts(cfg, n=3, seed=5, lo=6, hi=20):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (int(rng.integers(lo, hi)),))
+            for _ in range(n)]
+
+
+@pytest.fixture
+def prof_flags():
+    keys = ("profile_programs", "profile_sample_every",
+            "recompile_watchdog", "recompile_warmup_ticks",
+            "telemetry", "trace_sample", "telemetry_dump_dir",
+            "spec_decode")
+    saved = {k: F.flag(k) for k in keys}
+    yield F.set_flags
+    F.set_flags(saved)
+
+
+# ---------------- off == identity ----------------
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_profiler_off_identity_on_changes_nothing(paged, prof_flags,
+                                                  compile_counter):
+    """Flag off: no profiler object. Flag on (every dispatch
+    sampled): same compiled-program set, bit-identical outputs — the
+    profiler measures, it never participates."""
+    model, cfg = _model(0)
+    prompts = _prompts(cfg)
+    eng_off = ContinuousBatchingEngine(model, _ecfg(paged))
+    assert eng_off._prof is None
+    out_off = [r.output for r in eng_off.run(prompts, 8, max_chunk=2)]
+    base = compile_counter()
+
+    prof_flags({"profile_programs": True, "profile_sample_every": 1})
+    eng_on = ContinuousBatchingEngine(model, _ecfg(paged))
+    assert eng_on._prof is not None
+    out_on = [r.output for r in eng_on.run(prompts, 8, max_chunk=2)]
+    assert out_on == out_off
+    # zero NEW compiled programs vs the unprofiled run's set
+    after = compile_counter()
+    grown = {k: v - base.get(k, 0) for k, v in after.items()
+             if v - base.get(k, 0)}
+    assert set(grown) <= set(base), (
+        f"profiler added compiled programs: {grown}")
+    snap = eng_on.profile_snapshot()
+    assert snap["enabled"] and snap["programs"]["decode_chunk"][
+        "sampled"] > 0
+    assert eng_off.profile_snapshot() == {"enabled": False}
+
+
+def test_profiler_cadence_deterministic(prof_flags):
+    """sample_every=3 measures every 3rd dispatch of each program —
+    and the unsampled dispatches never pay a block_until_ready."""
+    model, cfg = _model(1)
+    prof_flags({"profile_programs": True, "profile_sample_every": 3})
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    eng.run(_prompts(cfg, n=4), 10, max_chunk=2)
+    st = eng.profile_snapshot()["programs"]["decode_chunk"]
+    assert st["sampled"] == st["dispatches"] // 3
+
+
+def test_unknown_program_name_rejected():
+    prof = ProgramProfiler(engine_id="t")
+    with pytest.raises(ValueError, match="PROGRAM_LABELS"):
+        prof.want("not_a_program")
+
+
+# ---------------- measured decomposition ----------------
+
+def test_sampled_steps_carry_measured_decomposition(prof_flags):
+    """Telemetry + profiler on, every dispatch sampled: tracer step
+    events report the measured schedule/dispatch/device split
+    (profiled=True, no sync_wall_ms estimate), the host snapshot
+    accumulates the same numbers, and the registry histogram holds
+    one observation per sampled dispatch."""
+    model, cfg = _model(2)
+    prof_flags({"telemetry": True, "trace_sample": 1.0,
+                "profile_programs": True, "profile_sample_every": 1})
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    eng.run(_prompts(cfg), 6, max_chunk=2)
+    steps = [e for e in eng._tracer.events() if e["kind"] == "step"
+             and e["name"] in ("decode", "decode_chunk", "verify")]
+    assert steps
+    for e in steps:
+        assert e["args"]["profiled"] is True
+        assert e["args"]["device_ms"] >= 0
+        assert e["args"]["schedule_ms"] >= 0
+        assert e["args"]["dispatch_ms"] >= 0
+        assert "sync_wall_ms" not in e["args"]
+    snap = eng.profile_snapshot()
+    st = snap["programs"]["decode_chunk"]
+    assert st["device_ms_p50"] >= 0 and st["device_ms_max"] >= \
+        st["device_ms_p50"] >= 0
+    hist = obs.global_registry().get("pt_serve_program_ms")
+    lab = {"engine": eng._prof.engine_id, "program": "decode_chunk"}
+    assert hist.window_len(**lab) == st["sampled"]
+
+
+def test_unsampled_steps_keep_sync_wall_fallback(prof_flags):
+    """A cadence that never fires within the run leaves every step on
+    the renamed honest estimate — and no host sync is charged to the
+    profiler (sampled == 0)."""
+    model, cfg = _model(3)
+    prof_flags({"telemetry": True, "trace_sample": 1.0,
+                "profile_programs": True,
+                "profile_sample_every": 10_000})
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    eng.run(_prompts(cfg, n=2), 6, max_chunk=2)
+    steps = [e for e in eng._tracer.events() if e["kind"] == "step"
+             and e["name"] in ("decode", "decode_chunk")]
+    assert steps
+    for e in steps:
+        assert "profiled" not in e["args"]
+        assert e["args"]["sync_wall_ms"] >= 0
+    st = eng.profile_snapshot()["programs"]["decode_chunk"]
+    assert st["sampled"] == 0 and st["dispatches"] > 0
+
+
+# ---------------- recompile watchdog ----------------
+
+def test_watchdog_fires_on_shape_busting_dispatch(prof_flags,
+                                                  tmp_path):
+    """Seal after warmup, then deliberately shape-bust the chunked
+    prefill (new chunk length + a fresh jit wrapper — the TS003
+    hazard at runtime): the watchdog counts the recompile, the
+    registry counter increments, and a FlightRecorder artifact names
+    the offending arg shapes."""
+    model, cfg = _model(4)
+    prof_flags({"telemetry": True, "trace_sample": 0.0,
+                "telemetry_dump_dir": str(tmp_path)})
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    prompts = _prompts(cfg, n=2, seed=11)
+    eng.run(prompts, 4, max_chunk=2)
+    assert not eng.recompile_snapshot()["sealed"]
+    eng.seal_programs()
+    assert eng.recompile_snapshot()["sealed"]
+
+    eng._chunk_len = 5  # shape drift mid-life
+    eng._prefill_chunk_c = None  # fresh wrapper: retrace guaranteed
+    eng.add_request(prompts[0], 4)
+    while eng.step_chunk(2):
+        pass
+    snap = eng.recompile_snapshot()
+    assert snap["recompiles"].get("prefill_chunk", 0) >= 1
+    ctr = obs.global_registry().get("pt_serve_recompiles_total")
+    assert ctr.value(engine=eng._tel.engine_id,
+                     program="prefill_chunk") >= 1
+    dumps = glob.glob(os.path.join(str(tmp_path), "flight_*.json"))
+    assert dumps, "no FlightRecorder artifact written"
+    with open(sorted(dumps)[-1]) as f:
+        payload = json.load(f)
+    assert "recompile" in payload["reason"]
+    rec = next(r for r in payload["records"]
+               if r.get("kind") == "serve_recompile")
+    assert rec["program"] == "prefill_chunk"
+    shapes = rec["arg_shapes"]["ids"]
+    # TRACE_SHAPES records the offending specialization: [slots, C']
+    assert list(shapes)[-1] == 5
+
+
+def test_watchdog_auto_seals_and_stays_quiet(prof_flags):
+    """The tick budget seals without an explicit call, and a
+    steady-shape workload records ZERO post-seal recompiles."""
+    model, cfg = _model(5)
+    prof_flags({"recompile_warmup_ticks": 3})
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    eng.run(_prompts(cfg, n=4), 10, max_chunk=2)
+    snap = eng.recompile_snapshot()
+    assert snap["sealed"] and snap["ticks"] >= 3
+    assert snap["recompiles"] == {}
+
+
+def test_watchdog_off_is_identity(prof_flags):
+    model, cfg = _model(6)
+    prompts = _prompts(cfg)
+    ref = [r.output for r in ContinuousBatchingEngine(
+        model, _ecfg(False)).run(prompts, 6, max_chunk=2)]
+    prof_flags({"recompile_watchdog": False})
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    assert eng._watchdog is None
+    assert eng.recompile_snapshot() == {"enabled": False}
+    assert [r.output for r in eng.run(prompts, 6, max_chunk=2)] == ref
+
+
+# ---------------- HBM accounting ----------------
+
+def test_hbm_components_paged_int8():
+    """int8 KV pools report scale rows as their own component; weight
+    bytes split by dtype; totals are consistent."""
+    model, cfg = _model(7)
+    eng = ContinuousBatchingEngine(
+        model, _ecfg(True, cache_dtype="int8"))
+    hbm = eng.hbm_snapshot()
+    assert hbm["kv_pool"] > 0 and hbm["kv_scales"] > 0
+    assert any(k.startswith("weights_") for k in hbm)
+    assert hbm["total"] == sum(v for k, v in hbm.items()
+                               if k != "total")
+    # int8 payload + f32 per-row scales: scales are d/4 the payload
+    # footprint per row (1 f32 per kvh*page row vs d int8 payload)
+    assert hbm["kv_scales"] < hbm["kv_pool"]
+
+
+def test_hbm_prefix_store_bytes_grow_contiguous():
+    """The contiguous prefix store is REAL device memory on top of
+    the engine's own cache — its bytes appear once blocks publish."""
+    model, cfg = _model(8)
+    eng = ContinuousBatchingEngine(model, _ecfg(False))
+    assert eng.hbm_snapshot()["prefix_store"] == 0
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, cfg.vocab_size, (24,))  # 3 hash blocks
+    eng.run([prompt], 4, max_chunk=2)
+    assert eng._prefix is not None and len(eng._prefix) > 0
+    assert eng.hbm_snapshot()["prefix_store"] > 0
+
+
+def test_hbm_gauges_in_registry(prof_flags):
+    model, cfg = _model(9)
+    prof_flags({"telemetry": True, "trace_sample": 0.0})
+    eng = ContinuousBatchingEngine(model, _ecfg(True))
+    eng.metrics_snapshot()
+    g = obs.global_registry().get("pt_serve_hbm_bytes")
+    val = g.value(engine=eng._tel.engine_id, component="kv_pool")
+    assert val == eng.hbm_snapshot()["kv_pool"] > 0
+    peak = obs.global_registry().get("pt_serve_hbm_bytes_peak")
+    lab = {"engine": eng._tel.engine_id, "component": "kv_pool"}
+    assert peak.value(**lab) >= val
+    # the watermark is per-WINDOW, like every other peak gauge
+    eng.metrics_window_reset()
+    assert peak.value(**lab) == 0
+    eng.metrics_snapshot()
+    assert peak.value(**lab) == val
+
+
+# ---------------- label registry completeness (runtime twin) -------
+
+def test_program_labels_cover_trace_counts():
+    """Every TRACE_COUNTS program name in serving.py carries a timing
+    label — the runtime twin of ptlint's OBS001 static rule (same
+    AST walk the rule does, against the live PROGRAM_LABELS)."""
+    src = open(serving.__file__, encoding="utf-8").read()
+    tree = ast.parse(src)
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.target, ast.Subscript)
+                and isinstance(node.target.value, ast.Name)
+                and node.target.value.id == "TRACE_COUNTS"
+                and isinstance(node.target.slice, ast.Constant)):
+            names.add(node.target.slice.value)
+    assert names, "no TRACE_COUNTS bumps found — walker broken?"
+    missing = names - set(PROGRAM_LABELS)
+    assert not missing, (
+        f"programs without a timing label: {missing} — add them to "
+        "observability.profiling.PROGRAM_LABELS")
+    # shape notes ride along with every bump: a recompile dump can
+    # name arg shapes for any program the watchdog reports
+    noted = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_shape_note" and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            noted.add(node.args[0].value)
+    assert noted == names, (
+        f"TRACE_COUNTS programs without a _shape_note: "
+        f"{names - noted}")
