@@ -551,7 +551,18 @@ def _compact_line(result):
                 mid = sweep[(len(sweep) - 1) // 2]
                 row["goodput"] = {
                     k: mid.get(k) for k in
-                    ("qps", "goodput", "p99_ttft_ms", "p99_tpot_ms")}
+                    ("qps", "goodput", "p99_ttft_ms", "p99_tpot_ms",
+                     "burn_rate")}
+            # flight-data scalars (serve7b): peak SLO burn across the
+            # sweep, p50 attributed request device-ms, alert firings —
+            # the trend-shaped numbers the ledger trajectory
+            # accumulates (shed-path included below)
+            fl = gp.get("flight") or {}
+            if fl:
+                row["flight"] = {
+                    k: fl.get(k) for k in
+                    ("burn_rate_peak", "req_device_ms_p50",
+                     "alerts_fired")}
             # quantized-serving scalars (serve7b): the MODELED compound
             # ×-factor names the expected win on the ledger before the
             # TPU window, and outputs_match/first_divergence carry the
@@ -597,6 +608,7 @@ def _compact_line(result):
         for row in keep["secondary"].values():
             row.pop("error", None)
             row.pop("goodput", None)
+            row.pop("flight", None)
             row.pop("quant", None)
             row.pop("replica_failover", None)
             row.pop("step_breakdown", None)

@@ -755,6 +755,25 @@ def _goodput_scenario(model, base_ecfg, tpu):
     suite's telemetry-off default too. Targets are generous on the CPU
     smoke (dispatch dominates); the TPU row's 200/50 ms is the
     interactive envelope BASELINE.md tracks."""
+    from paddle_tpu import flags as F
+
+    # flight data rides the sweep: the time-series store + burn-rate
+    # detectors give each QPS step a BURN column (is attainment eating
+    # budget at this load?) and cost attribution prices each request
+    # in device-ms — the trend-shaped numbers the ledger accumulates.
+    # Short windows: the CPU smoke runs only a handful of ticks/step.
+    saved_fl = {k: F.flag(k) for k in
+                ("timeseries", "timeseries_cadence", "alerts",
+                 "cost_attribution")}
+    F.set_flags({"timeseries": True, "timeseries_cadence": 2,
+                 "alerts": True, "cost_attribution": True})
+    try:
+        return _goodput_sweep(model, base_ecfg, tpu)
+    finally:
+        F.set_flags(saved_fl)
+
+
+def _goodput_sweep(model, base_ecfg, tpu):
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
 
     qps_steps = (2.0, 4.0, 8.0) if tpu else (8.0, 25.0)
@@ -778,6 +797,7 @@ def _goodput_scenario(model, base_ecfg, tpu):
         eng._finished.clear()
         eng.metrics_window_reset()
         eng.slo_window_reset()
+        eng.alerts_window_reset()  # per-step burn-rate peak
         t_start = time.perf_counter()
         submitted = 0
         next_arrival = t_start
@@ -825,6 +845,17 @@ def _goodput_scenario(model, base_ecfg, tpu):
             "slo_met": slo["met"],
             "slo_violated": slo["violated"],
         }
+        # flight-data columns: peak SLO burn (violation ratio over
+        # error budget, min of fast/slow windows — the alert rule's
+        # own scalar) and mean attributed device-ms per request at
+        # this QPS — trend-shaped numbers the ledger accumulates
+        asn = eng.alerts_snapshot()
+        if asn.get("enabled"):
+            row["burn_rate"] = round(
+                asn["rules"]["slo_burn_rate"]["peak"], 3)
+        costs = [r.device_ms for r in reqs]
+        row["mean_req_device_ms"] = (
+            round(float(np.mean(costs)), 3) if costs else None)
         snap = eng.metrics_snapshot()
         ttft = snap.get("ttft_ms") or {}
         if ttft.get("p99") is not None:
@@ -854,6 +885,8 @@ def _goodput_scenario(model, base_ecfg, tpu):
                 all(acts[r.rid]["tokens"] == len(r.output)
                     for r in checked) if checked else None)
         rows.append(row)
+    cost = eng.cost_snapshot()
+    asn = eng.alerts_snapshot()
     return {
         "slo_class": "interactive",
         "ttft_target_ms": ttft_target,
@@ -862,6 +895,20 @@ def _goodput_scenario(model, base_ecfg, tpu):
         "new_tokens": new_tokens,
         "max_chunk": max_chunk,
         "sweep": rows,
+        # compact flight summary for the bench ledger (shed-path
+        # included): peak burn across the sweep, p50 attributed
+        # request device-ms, total alert firings
+        "flight": {
+            "burn_rate_peak": max(
+                (r["burn_rate"] for r in rows
+                 if r.get("burn_rate") is not None), default=None),
+            "req_device_ms_p50": (
+                round(cost["request_device_ms_p50"], 3)
+                if cost.get("request_device_ms_p50") is not None
+                else None),
+            "alerts_fired": (asn.get("fired_total")
+                             if asn.get("enabled") else None),
+        },
     }
 
 

@@ -80,6 +80,12 @@ class Project:
         self.trace_programs: Dict[str, Tuple[str, int]] = {}
         self.program_labels: Set[str] = set()
         self.saw_profiling_module = False
+        # OBS002: AlertRule implementations (class-level
+        # ``name = "..."``) and the canonical ALERT_RULES literal keys
+        # from observability/alerts.py
+        self.alert_impls: Dict[str, Tuple[str, int]] = {}
+        self.alert_rules: Set[str] = set()
+        self.saw_alerts_module = False
 
     def readme_text(self) -> str:
         path = os.path.join(self.root, "README.md")
@@ -670,6 +676,93 @@ class OBS001ProgramLabelCompleteness(Rule):
         return out
 
 
+class OBS002AlertRuleRegistry(Rule):
+    """Collector + one project-level verdict: every alert-rule
+    implementation in ``observability/alerts.py`` (a class deriving
+    from ``AlertRule`` with a class-level ``name = "..."``) must
+    appear in the canonical ``ALERT_RULES`` registry AND in the README
+    alerts table. A detector that skips the registry fails at
+    ``AlertManager`` construction anyway (the runtime twin), but one
+    that skips the README would fire alerts no operator runbook
+    names — the FL003 shape, applied to alerting."""
+
+    id = "OBS002"
+    doc = ("every AlertRule implementation must appear in "
+           "observability/alerts.ALERT_RULES and in the README "
+           "alerts table")
+
+    def applies(self, relpath):
+        # any alerts.py under an observability/ dir: the real module
+        # plus synthetic tmp-repo twins the rule tests plant
+        return relpath.endswith("observability/alerts.py")
+
+    def check_module(self, project, tree, src, relpath):
+        del src
+        project.saw_alerts_module = True
+        for node in ast.walk(tree):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target]
+                       if isinstance(node, ast.AnnAssign) else [])
+            if any(isinstance(t, ast.Name) and t.id == "ALERT_RULES"
+                   for t in targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    s = _const_str(k)
+                    if s is not None:
+                        project.alert_rules.add(s)
+        # module-level ClassDefs in source order, tracking the
+        # transitive AlertRule hierarchy (an intermediate shape class
+        # like _RatioCollapse makes its children rules too)
+        known_bases = {"AlertRule"}
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {getattr(b, "id", None) or getattr(b, "attr", None)
+                     for b in node.bases}
+            if not bases & known_bases:
+                continue
+            known_bases.add(node.name)
+            for stmt in node.body:
+                # both spellings count: name = "x" and name: str = "x"
+                # (the module-level ALERT_RULES scan above handles
+                # AnnAssign the same way)
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    targets = [stmt.target]
+                else:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == "name":
+                        s = _const_str(stmt.value)
+                        if s:  # the base's name = "" is not a rule
+                            project.alert_impls.setdefault(
+                                s, (relpath, stmt.lineno))
+        return []
+
+    def check_project(self, project):
+        if not project.saw_alerts_module:
+            # partial scan: without the registry module in view every
+            # rule would read unregistered — silent, like FL001
+            return []
+        readme = project.readme_text()
+        out: List[Violation] = []
+        for name, (f, ln) in sorted(project.alert_impls.items()):
+            if name not in project.alert_rules:
+                out.append(Violation(
+                    f, ln, "OBS002",
+                    f"alert rule {name!r} is implemented but missing "
+                    "from the canonical ALERT_RULES registry — "
+                    "register it (AlertManager rejects unregistered "
+                    "rules at runtime too)"))
+            if f"`{name}`" not in readme:
+                out.append(Violation(
+                    f, ln, "OBS002",
+                    f"alert rule {name!r} missing from README's "
+                    f"alerts table (document as `{name}`)"))
+        return out
+
+
 # ---------------------------------------------------------------------------
 # CC — concurrency: copy-on-read snapshots, scheduler-owned mutation
 # ---------------------------------------------------------------------------
@@ -919,6 +1012,7 @@ ALL_RULES: Sequence[Rule] = (
     DT003WallClock(),
     FlagsHygiene(),
     OBS001ProgramLabelCompleteness(),
+    OBS002AlertRuleRegistry(),
     CC001CopyOnRead(),
 )
 
@@ -933,6 +1027,7 @@ RULE_DOCS: Dict[str, str] = {
     "FL002": "defined flags must be read somewhere outside tests/",
     "FL003": "defined flags must appear in README's flags tables",
     "OBS001": OBS001ProgramLabelCompleteness.doc,
+    "OBS002": OBS002AlertRuleRegistry.doc,
     "CC001": "scrape-thread readers iterate copies (list(...)-wrapped)",
     "CC002": "scrape-thread readers never mutate scheduler-owned state",
     "CC003": ("readers on sanitizer-bearing classes carry their "

@@ -55,6 +55,10 @@ SAFE_READS = frozenset({
     # recompile-watchdog state and HBM residency are copy-on-read
     # host metadata
     "profile_snapshot", "recompile_snapshot", "hbm_snapshot",
+    # flight-data readers (PR 13): time-series windows are immutable
+    # once appended (the ring copies under its lock), alert/cost
+    # snapshots copy every nested structure
+    "timeline_snapshot", "alerts_snapshot", "cost_snapshot",
 })
 
 

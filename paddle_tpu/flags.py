@@ -256,6 +256,59 @@ define_flag("recompile_watchdog", True,
             "explicitly after real warmup traffic. One artifact per "
             "program per engine; counters keep counting. Never "
             "raises; off = no watchdog, one identity check per tick")
+define_flag("timeseries", False,
+            "serving flight-data recorder "
+            "(observability/timeseries.py): a bounded ring of "
+            "fixed-cadence windowed samples over the engine's/"
+            "router's metrics — counter deltas become per-window "
+            "rates, gauges are point-sampled, histogram window-"
+            "percentiles ride along (telemetry on). Tick-driven and "
+            "wall-clock-free in every decision, scrape-thread-safe "
+            "copy-on-read; read via engine.timeline_snapshot(), the "
+            "/timeline endpoint and `dump --timeline`. off = no "
+            "store is constructed (one identity check per tick, zero "
+            "new compiled programs, outputs bit-identical)")
+define_flag("timeseries_cadence", 16,
+            "scheduler ticks per time-series window: every Nth tick "
+            "closes a window and appends one sample (counter deltas "
+            "over exactly N ticks — deterministic)")
+define_flag("timeseries_retention", 256,
+            "time-series ring capacity (windows): old samples fall "
+            "off, bounding host memory no matter how long the engine "
+            "runs; at the default cadence x retention this is the "
+            "last ~4k scheduler ticks of history")
+define_flag("alerts", True,
+            "rule-based detectors over the serving time-series "
+            "(observability/alerts.py): multi-window SLO burn-rate, "
+            "queue-depth growth, prefix-hit / spec-acceptance "
+            "collapse, post-seal recompiles, HBM residency — each "
+            "with hysteresis (no flapping), firing structured "
+            "`alert` tracer events + a FlightRecorder artifact "
+            "carrying the triggering window, surfaced in "
+            "metrics_snapshot()['alerts'] and the fleet snapshot. "
+            "Evaluated only when PT_FLAGS_timeseries is on (the "
+            "rules read the series); off = no detectors constructed")
+define_flag("cost_attribution", True,
+            "per-request device-cost attribution: each step's "
+            "measured program-ms (profiler-sampled; sync-wall "
+            "estimate on unsampled steps) is split across the "
+            "requests the step advanced, proportional to tokens "
+            "advanced, accumulated on the request and recorded at "
+            "finish into pt_serve_request_device_ms{engine,slo} and "
+            "the request ledger (cost survives failover/drain "
+            "handoffs); read via engine.cost_snapshot(). Pure host "
+            "arithmetic — zero device syncs, zero new compiled "
+            "programs. off = requests carry device_ms 0 (one "
+            "identity check per seam, outputs bit-identical)")
+define_flag("slo_degradation", False,
+            "let the degradation ladder consume the SLO burn-rate "
+            "alert (read-only AlertManager.is_active hook): an "
+            "active slo_burn_rate counts as saturation pressure, so "
+            "sustained burn climbs the CAPACITY rungs (shed batch-"
+            "class admissions, throttle) even before the queue "
+            "backs up — never the fault jump. Requires timeseries + "
+            "alerts on to have any effect; off (default) leaves the "
+            "ladder's inputs untouched (outputs pinned identical)")
 define_flag("recompile_warmup_ticks", 64,
             "scheduler ticks before the recompile watchdog auto-seals "
             "the program set (warmup compiles are expected; "

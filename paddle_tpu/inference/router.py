@@ -343,6 +343,15 @@ class EngineRouter:
                 and float(flags.flag("trace_sample")) > 0:
             self._tracer = observability.Tracer(
                 engine_id=f"router{self._tel.router_id}")
+        # fleet flight data (PT_FLAGS_timeseries): the router keeps
+        # its own fixed-cadence windowed history over the FLEET
+        # counters (routed/held/failovers/...) per fleet tick, beside
+        # each replica engine's own store — same off == None no-op
+        self._ts = None
+        if bool(flags.flag("timeseries")):
+            label = (f"router{self._tel.router_id}"
+                     if self._tel is not None else None)
+            self._ts = observability.TimeSeriesStore(label=label)
         self._san = None
         if bool(flags.flag("sanitize")):
             from ..analysis.sanitizer import EngineSanitizer
@@ -552,6 +561,8 @@ class EngineRouter:
                 1 for r in self._replicas
                 if self._routable(r, r.engine.backpressure()))
             self._tel.on_fleet_state(routable, len(self._queue))
+        if self._ts is not None:
+            self._ts.on_tick(self._flight_collect)
         if san is not None:
             # under the admission lock: placement writes queue + owner
             # map as one atomic unit, so an unlocked read could catch a
@@ -905,9 +916,43 @@ class EngineRouter:
             "replicas": reps,
         }
 
+    def _flight_collect(self) -> dict:
+        """Fleet counters + gauges for one router time-series window
+        (scheduler-thread only; the replicas keep their own engine-
+        labeled stores)."""
+        counters = {k: float(v)
+                    for k, v in list(self.fleet_stats.items())}
+        routable = sum(
+            1 for rep in self._replicas
+            if self._routable(rep, rep.engine.backpressure()))
+        gauges = {
+            "queue_depth": float(len(self._queue)),
+            "routable_replicas": float(routable),
+            "n_replicas": float(len(self._replicas)),
+        }
+        return {"counters": counters, "gauges": gauges,
+                "percentiles": {}}
+
+    def timeline_snapshot(self) -> dict:
+        """The FLEET time-series view: the router's own windowed
+        fleet-counter history plus every replica engine's timeline
+        (``{"enabled": False}`` when PT_FLAGS_timeseries is off).
+        Copy-on-read — served at ``/timeline`` on the fleet metrics
+        server."""
+        if self._san is not None:
+            self._san.check_read("timeline_snapshot")
+        if self._ts is None:
+            return {"enabled": False}
+        st = self._ts.snapshot()
+        return {"enabled": True, "router": st,
+                "replicas": [rep.engine.timeline_snapshot()
+                             for rep in list(self._replicas)]}
+
     def fleet_snapshot(self) -> dict:
         """Host-side router counters + breaker states (available with
-        telemetry off, like every engine snapshot)."""
+        telemetry off, like every engine snapshot). ``alerts``
+        aggregates every replica's alert-rule state — the fleet view
+        of "which replica is burning its SLO budget"."""
         if self._san is not None:
             self._san.check_read("fleet_snapshot")
         st = {k: v for k, v in list(self.fleet_stats.items())}
@@ -922,6 +967,17 @@ class EngineRouter:
         st["injector"] = (self._injector.snapshot()
                           if self._injector is not None
                           else {"enabled": False})
+        alerts = {"enabled": False, "fired": 0, "active": []}
+        for rep in list(self._replicas):
+            asn = rep.engine.alerts_snapshot()
+            if not asn.get("enabled"):
+                continue
+            alerts["enabled"] = True
+            alerts["fired"] += asn["fired_total"]
+            for rule in list(asn["active"]):
+                alerts["active"].append(
+                    {"replica": rep.idx, "rule": rule})
+        st["alerts"] = alerts
         return st
 
     def slo_snapshot(self) -> dict:

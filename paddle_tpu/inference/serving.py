@@ -261,10 +261,22 @@ class Request:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     greedy: Optional[bool] = None
+    # attributed device cost (ms), accumulated step by step: each
+    # step's measured program-ms (profiler sample; sync-wall estimate
+    # on unsampled steps) split across the requests the step advanced,
+    # proportional to tokens advanced. device_ms_profiled is the
+    # portion backed by MEASURED samples (the rest is the honest
+    # host-wall upper bound). Travels in request_ledger, so cost
+    # survives failover/drain handoffs.
+    device_ms: float = 0.0
+    device_ms_profiled: float = 0.0
     _submit_t: float = 0.0
     _admit_t: float = 0.0
     # absolute deadline instant (perf_counter seconds; 0 = none)
     _deadline_t: float = 0.0
+    # finish-time cost already recorded (idempotency guard: a request
+    # can reach a terminal path more than once across flush points)
+    _cost_recorded: bool = False
     # replay re-queues consumed so far (crash recovery)
     _retries: int = 0
     # prompt block digests, computed once — a pool-blocked request is
@@ -394,6 +406,11 @@ def request_ledger(req: Request) -> dict:
         "ttft_ms": req.ttft_ms,
         "submit_t": req._submit_t,
         "admit_t": req._admit_t,
+        # attributed device cost so far: the move must not zero what
+        # the request already burned (per-request cost accounting
+        # survives failover/drain exactly like its SLO clock)
+        "device_ms": float(req.device_ms),
+        "device_ms_profiled": float(req.device_ms_profiled),
     }
 
 
@@ -718,6 +735,61 @@ class ContinuousBatchingEngine:
                            if self._tel is not None
                            else (self._prof.engine_id
                                  if self._prof is not None else "-")))
+        # ---------------- flight data: history + alerts + cost -------
+        # PT_FLAGS_timeseries (observability/timeseries.py): a bounded
+        # ring of fixed-cadence windowed samples over this engine's
+        # metrics, tick-driven (wall-clock-free in every decision) and
+        # copy-on-read for the scrape thread. PT_FLAGS_alerts rides it:
+        # rule-based detectors (SLO burn-rate, queue growth, hit-rate /
+        # acceptance collapse, post-seal recompiles, HBM residency)
+        # evaluate each closed window with hysteresis. Off = None —
+        # one identity check per tick, zero new compiled programs,
+        # outputs bit-identical (pinned by test).
+        self._ts = None
+        self._alerts = None
+        if bool(flags.flag("timeseries")):
+            label = (self._tel.engine_id if self._tel is not None
+                     else None)
+            self._ts = observability.TimeSeriesStore(label=label)
+            if bool(flags.flag("alerts")):
+                self._alerts = observability.AlertManager(
+                    self._ts.label, tracer=self._tracer)
+        # the degradation ladder's read-only burn-rate hook
+        # (PT_FLAGS_slo_degradation, default off: the ladder's inputs
+        # are untouched and its outputs pinned identical)
+        self._slo_degradation = bool(flags.flag("slo_degradation"))
+        # host tick/token counters the time-series collector windows
+        # (cheap ints, always maintained — like prefix/spec stats)
+        self._tokens_emitted = 0
+
+        # per-request device-cost attribution (PT_FLAGS_cost_
+        # attribution): split each step's measured program-ms
+        # (profiler sample; sync-wall estimate on unsampled steps)
+        # across the requests the step advanced, proportional to
+        # tokens advanced. Pure host arithmetic over stamps the step
+        # paths already take — zero device syncs, zero new compiled
+        # programs; off = one identity check per seam.
+        self._cost_enabled = bool(flags.flag("cost_attribution"))
+        self.cost_stats = {
+            # program -> total attributed ms (measured + estimated)
+            "attributed_ms": {},
+            # split by evidence: profiled_ms is backed by MEASURED
+            # block-until-ready samples, estimated_ms by the honest
+            # sync-wall upper bound on unsampled steps
+            "profiled_ms": 0.0, "estimated_ms": 0.0,
+            "requests_finished": 0,
+            "request_device_ms_total": 0.0,
+            # slo class (or "untracked") -> {requests, device_ms_total}
+            "by_slo": {},
+        }
+        # recent finished-request costs (p50 over the window)
+        self._cost_window: collections.deque = collections.deque(
+            maxlen=512)
+        # requests that reached a terminal state mid-step: their
+        # finish-time cost recording is deferred past the step's
+        # attribution pass (the final chunk's share must be included)
+        self._cost_pending: List[Request] = []
+
         # live HBM residency gauges (host metadata only): the weight
         # components are immutable after init — computed ONCE here so
         # profiler-sampled refreshes only re-walk the (small) dynamic
@@ -897,6 +969,9 @@ class ContinuousBatchingEngine:
         req.output = [int(t) for t in ledger.get("output", ())]
         req.ttft_ms = ledger.get("ttft_ms")
         req._retries = int(ledger.get("retries", 0))
+        req.device_ms = float(ledger.get("device_ms", 0.0) or 0.0)
+        req.device_ms_profiled = float(
+            ledger.get("device_ms_profiled", 0.0) or 0.0)
         # original instants win over build_request's fresh stamps: the
         # move must not shrink queue-wait out of TTFT or grant a fresh
         # deadline clock
@@ -1819,6 +1894,7 @@ class ContinuousBatchingEngine:
             last_idx = np.zeros((cfg.max_slots,), np.int32)
             finishing = []
             packed = 0
+            call_shares = [] if self._cost_enabled else None
             for job in remaining:
                 req, slot, p, job_ids = job[0], job[1], job[5], job[6]
                 take = min(C, job_ids.size - p)
@@ -1829,6 +1905,8 @@ class ContinuousBatchingEngine:
                     finishing.append(job)
                 job[5] = p + take
                 packed += take
+                if call_shares is not None:
+                    call_shares.append((req, take))
                 if tr is not None and tr.want_request(req.rid):
                     tr.request(req.rid, "prefill_chunk", start=int(p),
                                tokens=int(take), slot=slot)
@@ -1853,6 +1931,16 @@ class ContinuousBatchingEngine:
                 # decode/verify step's sync window)
                 p_dec = prof.observe("prefill_chunk", t0, t_call,
                                      time.perf_counter(), toks)
+                if call_shares:
+                    # prefill cost attributes only on MEASURED calls:
+                    # an unsampled chunk is async — its device time
+                    # surfaces in the next step's sync window, and
+                    # charging host-dispatch wall as device cost
+                    # would be dishonest. Reconciliation holds at
+                    # profile_sample_every=1.
+                    self._attribute_cost(
+                        "prefill_chunk", p_dec["device_ms"], True,
+                        call_shares)
             if tr is not None:
                 # unsampled dispatches stay a dispatch-only span: the
                 # chunk program is async — its device time surfaces in
@@ -1944,6 +2032,12 @@ class ContinuousBatchingEngine:
                         p_dec = prof.observe(
                             "prefill_bucket", t0, t_call,
                             time.perf_counter(), (first_dev, filled))
+                        if self._cost_enabled:
+                            # single-request program: the whole
+                            # measured wall is this request's
+                            self._attribute_cost(
+                                "prefill_bucket", p_dec["device_ms"],
+                                True, [(req, n)])
                     if self.cfg.paged:
                         self.layer_caches = self._scatter_paged()(
                             self.layer_caches, filled,
@@ -2001,6 +2095,11 @@ class ContinuousBatchingEngine:
                 req._admit_t = now
                 req.ttft_ms = (now - req._submit_t) * 1e3
             req.output.append(first)
+            # the prefill-sampled first token counts toward the
+            # flight-data token counter too (telemetry's on_admit/
+            # on_readmit make the same call) — a prefill-heavy window
+            # must not read as zero tokens
+            self._tokens_emitted += 1
             self.seq_lens[slot] = n_ctx
             self.last_tok[slot] = first
             if self._tel is not None:
@@ -2139,6 +2238,11 @@ class ContinuousBatchingEngine:
         self._finished[req.rid] = req
         self._release_slot(slot)
         self._finish_accounting(req, reason)
+        if self._cost_enabled:
+            # defer the finish-time cost record past the step's
+            # attribution pass: this request's final chunk share has
+            # not been split yet (flushed in the step wrapper)
+            self._cost_pending.append(req)
         if self._tel is not None:
             self._tel.on_finish(req.tpot_ms)
 
@@ -2180,6 +2284,9 @@ class ContinuousBatchingEngine:
         req.cancelled = True
         self._finished[request_id] = req
         self._finish_accounting(req, "cancel")
+        # record immediately: a cancel lands between ticks, with no
+        # pending step share to wait for
+        self._record_cost_finish(req)
         if self._tel is not None:
             self._tel.on_cancel()
         return True
@@ -2199,6 +2306,11 @@ class ContinuousBatchingEngine:
         req.done = True
         self._finished[req.rid] = req
         self._finish_accounting(req, reason)
+        # record immediately: timeout expiry runs at tick START and
+        # retry exhaustion inside a quarantine — neither has a pending
+        # step share (the failed step's device work is never
+        # attributed), and a reclaimed replica may never tick again
+        self._record_cost_finish(req)
         if self._tel is not None:
             if reason == "timeout":
                 self._tel.on_timeout()
@@ -2409,13 +2521,24 @@ class ContinuousBatchingEngine:
 
     def _observe_health(self):
         """One degradation-ladder tick: saturation from the live
-        admission state, faults accumulated since the last tick."""
+        admission state, faults accumulated since the last tick.
+        Under ``PT_FLAGS_slo_degradation`` (default off) an ACTIVE
+        SLO burn-rate alert also counts as saturation pressure — the
+        documented read-only ``AlertManager.is_active`` hook: the
+        engine is missing latency targets, which is a capacity
+        problem, so sustained burn climbs the capacity rungs (shed
+        batch / throttle) and never the fault jump. With the flag off
+        the ladder's inputs are untouched (outputs pinned
+        identical)."""
         if self._degctl is None:
             self._faults_tick = 0
             return
         qd = len(self._queue)
         sat = qd > 0 and (len(self._free_heap) == 0
                           or self._pool_blocked)
+        if self._slo_degradation and self._alerts is not None \
+                and self._alerts.is_active("slo_burn_rate"):
+            sat = True
         before = self._degctl.level
         level = self._degctl.observe(saturated=bool(sat),
                                      faults=self._faults_tick)
@@ -2550,13 +2673,26 @@ class ContinuousBatchingEngine:
         if wd is not None:
             wd.tick_begin()
         out = self._step_impl()
+        self._tick_epilogue(wd, san, "step")
+        return out
+
+    def _tick_epilogue(self, wd, san, site: str):
+        """Shared post-step sequence for the step()/step_chunk()
+        wrappers: watchdog diff, deferred cost-finish flush, flight
+        tick, chaos corruption seam, sanitizer invariants — ONE list,
+        so the two step paths can never desynchronize on a per-tick
+        feature. Every hook is a single identity check when its
+        subsystem is off."""
         if wd is not None:
             wd.tick_end()
+        if self._cost_pending:
+            self._flush_cost()
+        if self._ts is not None:
+            self._flight_tick()
         if self._injector is not None:
             self._corrupt_point()
         if san is not None:
-            san.check_tick(self, "step")
-        return out
+            san.check_tick(self, site)
 
     def _step_impl(self) -> bool:
         """Admit waiting requests, run one decode step for all active
@@ -2621,6 +2757,7 @@ class ContinuousBatchingEngine:
             return True
         t_sync = time.perf_counter()
         emitted = 0
+        cost_shares = [] if self._cost_enabled else None
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
                 continue
@@ -2632,7 +2769,19 @@ class ContinuousBatchingEngine:
             emitted += 1
             if adv is not None:
                 adv[req.rid] = 1
+            if cost_shares is not None:
+                cost_shares.append((req, 1))
             self._maybe_finish(slot, tok)
+        self._tokens_emitted += emitted
+        if cost_shares:
+            # attributed device wall: the measured sample when this
+            # dispatch was profiled, else the dispatch-done→token-sync
+            # host wall (the documented upper-bound fallback)
+            self._attribute_cost(
+                "decode_step",
+                p_dec["device_ms"] if p_dec is not None
+                else (t_sync - t_disp) * 1e3,
+                p_dec is not None, cost_shares)
         if adv is not None:
             # sampled dispatches report the MEASURED decomposition
             # (schedule_ms/dispatch_ms/device_ms, profiled=True);
@@ -2785,6 +2934,7 @@ class ContinuousBatchingEngine:
         t_sync = time.perf_counter()
         emitted = 0
         proposed_tot = accepted_tot = 0
+        cost_shares = [] if self._cost_enabled else None
         for slot in range(cfg.max_slots):
             if not chunk_slots[slot] or not self.active[slot]:
                 continue
@@ -2793,6 +2943,7 @@ class ContinuousBatchingEngine:
             a = min(int(acc_np[slot]), n)
             toks = [int(ids[slot, 1 + j]) for j in range(a)]
             toks.append(int(preds_np[slot, a]))
+            slot_emitted = 0
             for tok in toks:
                 if req.done:
                     break  # EOS mid-chain: later tokens discarded
@@ -2800,9 +2951,12 @@ class ContinuousBatchingEngine:
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
                 emitted += 1
+                slot_emitted += 1
                 if adv is not None:
                     adv[req.rid] = adv.get(req.rid, 0) + 1
                 self._maybe_finish(slot, tok)
+            if cost_shares is not None and slot_emitted:
+                cost_shares.append((req, slot_emitted))
             if spec_by_rid is not None and n:
                 spec_by_rid[req.rid] = [n, a]
             if n:
@@ -2816,6 +2970,16 @@ class ContinuousBatchingEngine:
         self.spec_stats["proposed"] += proposed_tot
         self.spec_stats["accepted"] += accepted_tot
         self.spec_stats["emitted"] += emitted
+        self._tokens_emitted += emitted
+        if cost_shares:
+            # unsampled fallback conflates the overlapped admission
+            # dispatch (the sync_wall_ms caveat); the profiled sample
+            # is the verify program alone
+            self._attribute_cost(
+                "spec_verify",
+                p_dec["device_ms"] if p_dec is not None
+                else (t_sync - t_disp) * 1e3,
+                p_dec is not None, cost_shares)
         if adv is not None:
             # sampled: measured schedule/dispatch/device decomposition
             # (the profiler blocked on the verify outputs BEFORE the
@@ -2870,12 +3034,7 @@ class ContinuousBatchingEngine:
         if wd is not None:
             wd.tick_begin()
         out = self._step_chunk_impl(max_chunk)
-        if wd is not None:
-            wd.tick_end()
-        if self._injector is not None:
-            self._corrupt_point()
-        if san is not None:
-            san.check_tick(self, "step_chunk")
+        self._tick_epilogue(wd, san, "step_chunk")
         return out
 
     def _corrupt_point(self):
@@ -3053,6 +3212,8 @@ class ContinuousBatchingEngine:
         # (matches what step() measures)
         t_sync = time.perf_counter()
         emitted = 0
+        cost_by_slot: Dict[int, list] = {} if self._cost_enabled \
+            else None
         for k in range(K):
             for slot in range(self.cfg.max_slots):
                 # chunk_slots: was in this chunk; active: not finished
@@ -3068,7 +3229,17 @@ class ContinuousBatchingEngine:
                 emitted += 1
                 if adv is not None:
                     adv[req.rid] = adv.get(req.rid, 0) + 1
+                if cost_by_slot is not None:
+                    cost_by_slot.setdefault(slot, [req, 0])[1] += 1
                 self._maybe_finish(slot, tok)
+        self._tokens_emitted += emitted
+        if cost_by_slot:
+            self._attribute_cost(
+                "decode_chunk",
+                p_dec["device_ms"] if p_dec is not None
+                else (t_sync - t_disp) * 1e3,
+                p_dec is not None,
+                [(req, n) for req, n in cost_by_slot.values()])
         if adv is not None:
             # sampled: measured decomposition. Unsampled fallback:
             # same schedule/dispatch windows, plus sync_wall_ms
@@ -3210,6 +3381,12 @@ class ContinuousBatchingEngine:
         snap["programs"] = self.profile_snapshot()
         snap["recompile"] = self.recompile_snapshot()
         snap["hbm"] = dict(hbm, total=sum(list(hbm.values())))
+        # flight data (PR 13): alert-rule states and per-request
+        # device-cost attribution ride the one unified document too
+        # (the full time-series stays on timeline_snapshot()/
+        # /timeline — windows x samples would bloat every scrape)
+        snap["alerts"] = self.alerts_snapshot()
+        snap["cost"] = self.cost_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -3312,6 +3489,183 @@ class ContinuousBatchingEngine:
         sweep."""
         if self._tel is not None:
             self._tel.window_reset()
+
+    # ---------------- per-request device-cost attribution ----------
+    def _attribute_cost(self, program: str, device_ms: float,
+                        profiled: bool, shares):
+        """Split one step's device wall across the requests it
+        advanced, proportional to tokens advanced (``shares`` is
+        [(req, tokens)]). The split is exact up to float rounding —
+        the shares sum to ``device_ms`` — which is the documented
+        rounding the reconciliation test allows. ``profiled`` marks a
+        MEASURED sample (block_until_ready device wall); the fallback
+        is the step's sync-wall estimate, accumulated separately so a
+        reader can tell evidence from upper bound."""
+        if device_ms <= 0 or not shares:
+            return
+        total = sum(n for _, n in shares)
+        if total <= 0:
+            return
+        st = self.cost_stats
+        st["attributed_ms"][program] = \
+            st["attributed_ms"].get(program, 0.0) + device_ms
+        st["profiled_ms" if profiled else "estimated_ms"] += device_ms
+        for req, n in shares:
+            share = device_ms * (n / total)
+            req.device_ms += share
+            if profiled:
+                req.device_ms_profiled += share
+
+    def _record_cost_finish(self, req: Request):
+        """Terminal cost bookkeeping for one request (idempotent —
+        terminal paths can revisit a request across flush points)."""
+        if not self._cost_enabled or req._cost_recorded:
+            return
+        req._cost_recorded = True
+        st = self.cost_stats
+        st["requests_finished"] += 1
+        st["request_device_ms_total"] += req.device_ms
+        key = req.slo or "untracked"
+        by = st["by_slo"].get(key)
+        if by is None:
+            by = st["by_slo"][key] = {"requests": 0,
+                                      "device_ms_total": 0.0}
+        by["requests"] += 1
+        by["device_ms_total"] += req.device_ms
+        self._cost_window.append(req.device_ms)
+        if self._tel is not None:
+            self._tel.on_request_cost(key, req.device_ms)
+
+    def _flush_cost(self):
+        """Record finish-time costs deferred past the step's
+        attribution pass (requests that hit EOS/budget mid-step must
+        include the final chunk's share — _maybe_finish runs BEFORE
+        the step attributes, so it defers here)."""
+        if not self._cost_pending:
+            return
+        pending, self._cost_pending = self._cost_pending, []
+        for req in pending:
+            self._record_cost_finish(req)
+
+    def cost_snapshot(self) -> dict:
+        """Per-request device-cost attribution totals (plain host
+        counters — available with PT_FLAGS_telemetry=off, like every
+        other serving stat surface). ``request_device_ms_p50`` is over
+        the recent finished-request window."""
+        if self._san is not None:
+            self._san.check_read("cost_snapshot")
+        if not self._cost_enabled:
+            return {"enabled": False}
+        st = {k: v for k, v in list(self.cost_stats.items())}
+        st["attributed_ms"] = {
+            k: v for k, v in list(st["attributed_ms"].items())}
+        st["by_slo"] = {k: {kk: vv for kk, vv in list(v.items())}
+                        for k, v in list(st["by_slo"].items())}
+        win = sorted(self._cost_window)
+        st["request_device_ms_p50"] = (win[len(win) // 2] if win
+                                       else None)
+        n = st["requests_finished"]
+        st["request_device_ms_mean"] = (
+            st["request_device_ms_total"] / n if n else None)
+        st["enabled"] = True
+        return st
+
+    # ---------------- flight data (time-series + alerts) ----------
+    def _flight_tick(self):
+        """One scheduler tick for the flight-data layer: advance the
+        time-series store (a window closes every cadence-th tick) and,
+        on a closed window, run the alert detectors over the series.
+        Pure host bookkeeping; the tick count is the only input to
+        every decision."""
+        ts = self._ts
+        if ts is None:
+            return
+        sample = ts.on_tick(self._flight_collect)
+        if sample is not None and self._alerts is not None:
+            self._alerts.evaluate(ts)
+
+    def _flight_collect(self) -> dict:
+        """Cumulative counters + point gauges for one time-series
+        window (scheduler-thread only — the store's readers are the
+        scrape-safe surface). Host values the scheduler already holds;
+        histogram window-percentiles ride along when telemetry is
+        on."""
+        st = self.resilience_stats
+        counters = {
+            "tokens": float(self._tokens_emitted),
+            "finished": float(len(self._finished)),
+            "prefix_hits": float(self.prefix_stats["hits"]),
+            "prefix_misses": float(self.prefix_stats["misses"]),
+            "prefix_hit_tokens": float(
+                self.prefix_stats["hit_tokens"]),
+            "prefix_prompt_tokens": float(
+                self.prefix_stats["prompt_tokens"]),
+            "prefix_evictions": float(self.prefix_stats["evictions"]),
+            "spec_proposed": float(self.spec_stats["proposed"]),
+            "spec_accepted": float(self.spec_stats["accepted"]),
+            "spec_verify_calls": float(
+                self.spec_stats["verify_calls"]),
+            "recoveries": float(st["recoveries"]),
+            "retries": float(st["retries"]),
+            "timeouts": float(st["timeouts"]),
+            "failed": float(st["failed"]),
+            "recompiles": float(
+                sum(self._watchdog.recompiles.values())
+                if self._watchdog is not None else 0),
+            "device_ms": float(self.cost_stats["profiled_ms"]
+                               + self.cost_stats["estimated_ms"]),
+        }
+        for cls, s in list(self.slo_stats.items()):
+            counters[f"slo_met:{cls}"] = float(s["met"])
+            counters[f"slo_violated:{cls}"] = float(s["violated"])
+        qd, occ, used, total = self._tel_state()
+        ctl = self._degctl
+        gauges = {
+            "queue_depth": float(qd),
+            "occupancy": occ,
+            "active_slots": float(self.active.sum()),
+            "free_slots": float(len(self._free_heap)),
+            "kv_used": used,
+            "kv_total": total,
+            "kv_utilization": used / total if total else 0.0,
+            "degradation_level": float(ctl.level
+                                       if ctl is not None else 0),
+        }
+        percentiles = (self._tel.window_percentiles()
+                       if self._tel is not None else {})
+        return {"counters": counters, "gauges": gauges,
+                "percentiles": percentiles}
+
+    def timeline_snapshot(self) -> dict:
+        """The retained time-series windows (``{"enabled": False}``
+        when PT_FLAGS_timeseries is off). Copy-on-read — the
+        /timeline endpoint and `dump --timeline` read this from the
+        scrape thread."""
+        if self._san is not None:
+            self._san.check_read("timeline_snapshot")
+        if self._ts is None:
+            return {"enabled": False}
+        st = self._ts.snapshot()
+        st["enabled"] = True
+        return st
+
+    def alerts_snapshot(self) -> dict:
+        """Alert-rule states + bounded transition log
+        (``{"enabled": False}`` when alerts are off). Copy-on-read."""
+        if self._san is not None:
+            self._san.check_read("alerts_snapshot")
+        if self._alerts is None:
+            return {"enabled": False}
+        st = self._alerts.snapshot()
+        st["enabled"] = True
+        return st
+
+    def alerts_window_reset(self):
+        """Zero the per-rule peak trackers — one measurement window
+        per bench sweep step (fire counts, hysteresis state and the
+        registry totals keep running)."""
+        if self._alerts is not None:
+            self._alerts.window_reset()
 
     # ---------------- program-time attribution ----------------
     def _hbm_update(self):
@@ -3428,9 +3782,11 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
     """Serve ``/metrics`` (Prometheus text exposition of the process
     registry), ``/healthz`` (JSON readiness: liveness + engine snapshot
     + back-pressure state — **503** while admission is saturated or
-    the engine is draining, so a router can drain the replica) and
+    the engine is draining, so a router can drain the replica),
     ``/trace`` (the engine's lifecycle tracer as Chrome trace-event
-    JSON, Perfetto-loadable; 404 when tracing is off) on a daemon
+    JSON, Perfetto-loadable; 404 when tracing is off) and
+    ``/timeline`` (the engine's/router's retained time-series windows
+    as JSON; 404 when ``PT_FLAGS_timeseries`` is off) on a daemon
     thread.
 
     Also accepts an :class:`~paddle_tpu.inference.router.EngineRouter`
@@ -3495,6 +3851,18 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                     self._send(
                         code, json.dumps(payload, default=str).encode(),
                         "application/json")
+                elif path == "/timeline":
+                    tl = getattr(engine, "timeline_snapshot", None)
+                    snap = tl() if tl is not None else None
+                    if snap is None or not snap.get("enabled"):
+                        self._send(
+                            404, b"timeline disabled "
+                            b"(PT_FLAGS_timeseries off)", "text/plain")
+                    else:
+                        self._send(
+                            200,
+                            json.dumps(snap, default=str).encode(),
+                            "application/json")
                 elif path == "/trace":
                     from urllib.parse import parse_qs, urlparse
 

@@ -10,8 +10,10 @@ and a one-shot dump CLI (``python -m paddle_tpu.observability.dump``).
 no-op (shared null objects, no dict churn). See README "Observability".
 """
 
-from . import profiling, tracing  # noqa: F401
+from . import alerts, profiling, timeseries, tracing  # noqa: F401
+from .alerts import ALERT_RULES, AlertManager, AlertRule  # noqa: F401
 from .comm import comm_log, record as record_collective, reset_comm_log  # noqa: F401
+from .timeseries import TimeSeriesStore  # noqa: F401
 from .profiling import (  # noqa: F401
     PROGRAM_LABELS,
     ProgramProfiler,

@@ -21,6 +21,9 @@ Options:
                  replica Chrome trace (failed-over rids joined by flow
                  events). For a *running* fleet server, point --url at
                  /trace?fleet=1 instead
+  --timeline     every in-process TimeSeriesStore's retained windows
+                 (PT_FLAGS_timeseries) as JSON. For a *running*
+                 server, point --url at /timeline instead
 """
 
 from __future__ import annotations
@@ -67,6 +70,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="per-fleet snapshot + merged router+replica "
                          "Chrome trace (flow-correlated failovers)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="every in-process time-series store's "
+                         "retained windows (PT_FLAGS_timeseries) as "
+                         "JSON — for a running server, point --url at "
+                         "/timeline instead")
     args = ap.parse_args(argv)
 
     if args.url:
@@ -76,8 +84,19 @@ def main(argv=None) -> int:
             sys.stdout.write(resp.read().decode("utf-8", "replace"))
         return 0
 
-    from . import comm, registry, tracing
+    from . import comm, registry, timeseries, tracing
 
+    if args.timeline:
+        out = [s.snapshot() for s in timeseries.stores()]
+        out.sort(key=lambda s: s["label"])
+        json.dump(out, sys.stdout, default=str)
+        sys.stdout.write("\n")
+        if not out:
+            print("dump --timeline: no in-process TimeSeriesStore "
+                  "(PT_FLAGS_timeseries off, or no engine "
+                  "constructed; use --url http://host:port/timeline "
+                  "for a running server)", file=sys.stderr)
+        return 0
     if args.fleet:
         out = []
         for fleet in tracing.fleets():

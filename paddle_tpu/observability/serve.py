@@ -168,6 +168,20 @@ class ServingTelemetry:
         # from the gauge side)
         self._hbm_components: set = set()
         LS = ("engine", "slo")
+        self._req_device = reg.histogram(
+            "pt_serve_request_device_ms",
+            "per-request ATTRIBUTED device time (ms), recorded at "
+            "finish: each step's measured program-ms (ProgramProfiler "
+            "sample; sync-wall estimate on unsampled steps) split "
+            "across the requests the step advanced, proportional to "
+            "tokens advanced — the measured per-token cost the "
+            "Tensix-style bytes-per-token models are laid against. "
+            "slo='untracked' for SLO-less requests",
+            labels=LS, buckets=exp_buckets(0.05, 2.0, 22))
+        # slo labels this engine recorded costs under — window_reset
+        # must clear each series' percentile window (labels aren't
+        # enumerable from the histogram side; the hbm pattern)
+        self._cost_slos: set = set()
         self._slo_met = reg.counter(
             "pt_serve_slo_met_total",
             "finished requests that met every SLO target of their "
@@ -268,6 +282,11 @@ class ServingTelemetry:
             self._hbm_peak.set_max(nbytes, **lab)
             self._hbm_components.add(comp)
 
+    def on_request_cost(self, slo: str, device_ms: float):
+        """One finished request's attributed device cost (ms)."""
+        self._req_device.observe(device_ms, slo=slo, **self._lab())
+        self._cost_slos.add(slo)
+
     def on_spec_slot(self, proposed: int, accepted: int):
         """One slot's outcome in one verify pass — feeds the
         acceptance-rate histogram (per-slot granularity: a 100%-accept
@@ -316,6 +335,18 @@ class ServingTelemetry:
         self._kv_peak.set_max(util, **lab)
 
     # ---------------- read side ----------------
+    def window_percentiles(self) -> dict:
+        """Current histogram window-percentiles for the time-series
+        collector (None entries while a window has no observations —
+        the sample records the absence honestly)."""
+        lab = self._lab()
+        return {
+            "ttft_ms_p50": self._ttft.percentile(50, **lab),
+            "ttft_ms_p99": self._ttft.percentile(99, **lab),
+            "tpot_ms_p50": self._tpot.percentile(50, **lab),
+            "request_tpot_ms_p99": self._req_tpot.percentile(99, **lab),
+        }
+
     def snapshot(self) -> dict:
         lab = self._lab()
         return {
@@ -384,6 +415,8 @@ class ServingTelemetry:
         self._tpot.reset_window(**lab)
         self._req_tpot.reset_window(**lab)
         self._spec_accept_hist.reset_window(**lab)
+        for slo in list(self._cost_slos):
+            self._req_device.reset_window(slo=slo, **lab)
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)
         self._kv_peak.set(0.0, **lab)

@@ -134,13 +134,16 @@ class Tracer:
                     "t0": t0, "t1": t1, "engine": self.engine_id,
                     "args": args})
 
-    def engine_event(self, name: str, **args):
+    def engine_event(self, name: str, _force: bool = False, **args):
         """Engine-scoped instant (e.g. a prefix-cache eviction storm).
         Rate-gated by the same sample period as requests/steps: an
         unsampled flood of COW/evict instants must not cycle the ring
         and evict the rare request spans a low ``trace_sample`` was
-        set to preserve."""
-        if next(self._eng_n) % self.period != 0:
+        set to preserve. ``_force`` bypasses the thinning for rare
+        MUST-RECORD events (alert transitions): dropping one of those
+        to rate-gating would hide the incident the tracer exists to
+        explain."""
+        if not _force and next(self._eng_n) % self.period != 0:
             return
         self._push({"kind": "engine", "name": name,
                     "t0": time.perf_counter(), "t1": None,

@@ -181,3 +181,41 @@ def test_compact_line_healthy_result(tmp_path, monkeypatch):
     assert parsed["extra"]["mfu_est"] == 0.563
     assert parsed["extra"]["secondary"]["infer"]["value"] == 12.0
     assert "error" not in parsed["extra"]["secondary"]["infer"]
+
+
+def test_compact_line_carries_flight_scalars(tmp_path, monkeypatch):
+    """The serve7b flight-data summary rides the ledger line
+    (burn_rate_peak / req_device_ms_p50 / alerts_fired, plus the
+    mid-QPS row's burn_rate) and is shed with the other secondary
+    detail when the line must shrink."""
+    import bench
+
+    monkeypatch.setattr(bench, "DETAILS_PATH",
+                        str(tmp_path / "BENCH_DETAILS.json"))
+    r = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+         "extra": {"platform": "tpu", "n_chips": 1, "secondary": {
+             "serve7b": {
+                 "metric": "serve7b_tokens_per_sec", "value": 100.0,
+                 "unit": "tokens/s", "vs_baseline": 1.0,
+                 "extra": {"goodput_under_slo": {
+                     "sweep": [
+                         {"qps": 2.0, "goodput": 1.0,
+                          "p99_ttft_ms": 30.0, "p99_tpot_ms": 8.0,
+                          "burn_rate": 0.0},
+                         {"qps": 8.0, "goodput": 0.5,
+                          "p99_ttft_ms": 90.0, "p99_tpot_ms": 20.0,
+                          "burn_rate": 5.0},
+                     ],
+                     "flight": {"burn_rate_peak": 5.0,
+                                "req_device_ms_p50": 1.25,
+                                "alerts_fired": 2}}}}}}}
+    row = json.loads(bench._compact_line(r))["extra"]["secondary"][
+        "serve7b"]
+    assert row["flight"] == {"burn_rate_peak": 5.0,
+                             "req_device_ms_p50": 1.25,
+                             "alerts_fired": 2}
+    assert row["goodput"]["burn_rate"] == 0.0  # mid row of 2 = first
+    monkeypatch.setattr(bench, "MAX_LINE_BYTES", 400)
+    shed = json.loads(bench._compact_line(r))
+    sec = shed["extra"].get("secondary", {}).get("serve7b", {})
+    assert "flight" not in sec and "goodput" not in sec

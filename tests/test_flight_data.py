@@ -1,0 +1,747 @@
+"""Flight-data layer (ISSUE 13): time-series metric history, SLO
+burn-rate alerting, and per-request device-cost attribution.
+
+Claims pinned here:
+
+1. **Off-flags are true no-ops** — with ``timeseries``/``alerts`` off
+   (and with ``cost_attribution`` off) the engine compiles EXACTLY the
+   same program set and emits bit-identical greedy outputs.
+2. **Deterministic alerting** — a seeded saturation/fault storm fires
+   the SLO burn-rate alert at the SAME ticks across runs and across
+   both cache modes, with the triggering series window attached to the
+   FlightRecorder artifact.
+3. **Cost reconciliation** — at profiler cadence 1, per-request
+   attributed device-ms sums reconcile with the profiler's per-program
+   totals to float rounding; cost travels in the request ledger across
+   drain/failover handoffs.
+4. **Scrape safety** — timeline/alert/cost readers stay well-formed
+   (no torn windows) under a producer-thread fault storm with the
+   sanitizer on (chaos lane).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import serving_utils
+from paddle_tpu import flags as F
+from paddle_tpu.inference.resilience import FaultInjector
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.observability import alerts as A
+from paddle_tpu.observability import timeseries as TS
+
+# the programs whose device wall is split across requests — page_copy
+# and the prefix insert/read programs are engine overhead, documented
+# as outside the attribution rule
+ATTRIBUTED = {"decode_step", "decode_chunk", "spec_verify",
+              "prefill_chunk", "prefill_bucket"}
+
+
+@pytest.fixture
+def flight_flags():
+    """set_flags with restore for every knob this suite flips."""
+    keys = ("timeseries", "timeseries_cadence", "timeseries_retention",
+            "alerts", "cost_attribution", "slo_degradation",
+            "telemetry", "telemetry_dump_dir", "trace_sample",
+            "profile_programs", "profile_sample_every", "spec_decode",
+            "fault_inject", "sanitize")
+    saved = {k: F.flag(k) for k in keys}
+    yield F.set_flags
+    F.set_flags(saved)
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore mechanics
+# ---------------------------------------------------------------------------
+def test_store_windows_deltas_rates():
+    st = TS.TimeSeriesStore(label="u", cadence=2, retention=16)
+    vals = {"c": 0.0}
+
+    def collect():
+        return {"counters": dict(vals), "gauges": {"g": vals["c"]},
+                "percentiles": {"p": None}}
+
+    out = []
+    for _ in range(6):
+        vals["c"] += 3.0
+        out.append(st.on_tick(collect))
+    # windows close on ticks 2, 4, 6 only
+    assert [s is not None for s in out] == [False, True] * 3
+    series = st.series()
+    assert [s["tick"] for s in series] == [2, 4, 6]
+    # each window saw exactly 2 ticks x +3
+    assert all(s["deltas"]["c"] == 6.0 for s in series)
+    assert all(s["rates"]["c"] == 3.0 for s in series)
+    assert series[0]["counters"]["c"] == 6.0  # cumulative view kept
+    assert series[-1]["gauges"]["g"] == 18.0
+    assert series[0]["window_ticks"] == 2
+
+
+def test_store_counter_reset_never_goes_negative():
+    """Prometheus counter-reset convention: a source reset between
+    windows (the goodput sweep clears slo_stats/_finished per QPS
+    step) restarts the delta from the post-reset count — a negative
+    delta would poison every window-aggregating alert rule."""
+    st = TS.TimeSeriesStore(label="u3", cadence=1, retention=8)
+    st.on_tick(lambda: {"counters": {"c": 40.0}})
+    st.on_tick(lambda: {"counters": {"c": 43.0}})
+    # reset: the source dropped to 2 (counts since the reset)
+    st.on_tick(lambda: {"counters": {"c": 2.0}})
+    st.on_tick(lambda: {"counters": {"c": 5.0}})
+    assert [s["deltas"]["c"] for s in st.series()] \
+        == [40.0, 3.0, 2.0, 3.0]
+    assert all(s["deltas"]["c"] >= 0 for s in st.series())
+
+
+def test_store_retention_bound_and_copy_on_read():
+    st = TS.TimeSeriesStore(label="u2", cadence=1, retention=3)
+    for i in range(7):
+        st.on_tick(lambda: {"counters": {"c": float(i)}})
+    series = st.series()
+    assert len(series) == 3 and len(st) == 3
+    assert [s["tick"] for s in series] == [5, 6, 7]
+    # reader owns its list: mutating it cannot touch the ring
+    series.clear()
+    assert len(st.series()) == 3
+    assert st in TS.stores()
+
+
+# ---------------------------------------------------------------------------
+# alert rules: hysteresis + detectors over synthetic samples
+# ---------------------------------------------------------------------------
+def _sample(tick, deltas=None, gauges=None):
+    return {"tick": tick, "window_ticks": 1, "t": 0.0, "wall_s": None,
+            "counters": {}, "deltas": deltas or {},
+            "gauges": gauges or {}, "percentiles": {}}
+
+
+def _burn_sample(tick, met, violated):
+    return _sample(tick, deltas={"slo_met:interactive": float(met),
+                                 "slo_violated:interactive":
+                                     float(violated)})
+
+
+def test_burn_rule_hysteresis_no_flapping():
+    r = A.SLOBurnRate(budget=0.1, threshold=2.0, fire_for=2,
+                      clear_for=3)
+    samples = [_burn_sample(1, 4, 0)]
+    assert r.update(samples) is None and not r.active
+    # one bad window: streak 1 < fire_for — no fire yet
+    samples.append(_burn_sample(2, 0, 4))
+    assert r.update(samples) is None and not r.active
+    samples.append(_burn_sample(3, 0, 4))
+    assert r.update(samples) == "fire" and r.active
+    assert r.fired == 1 and r.value >= 2.0
+    # healthy windows: needs clear_for consecutive to clear
+    samples.append(_burn_sample(4, 4, 0))
+    assert r.update(samples) is None and r.active
+    samples.append(_burn_sample(5, 4, 0))
+    assert r.update(samples) is None and r.active
+    samples.append(_burn_sample(6, 4, 0))
+    assert r.update(samples) == "clear" and not r.active
+    # alternating bad/good can never fire a fire_for=2 rule
+    r2 = A.SLOBurnRate(budget=0.1, threshold=2.0, fire_for=2,
+                       clear_for=3)
+    s2 = []
+    for i in range(12):
+        s2.append(_burn_sample(i + 1, 0 if i % 2 else 4,
+                               4 if i % 2 else 0))
+        assert r2.update(s2) is None
+    assert not r2.active and r2.fired == 0
+
+
+def test_burn_rule_needs_both_windows():
+    # slow window healthy, fast window bad: min(fast, slow) stays low
+    r = A.SLOBurnRate(budget=0.5, threshold=2.0, fast_windows=1,
+                      slow_windows=4, fire_for=1)
+    samples = [_burn_sample(i, 8, 0) for i in range(1, 4)]
+    samples.append(_burn_sample(4, 0, 8))
+    assert r.update(samples) is None
+    assert r.value < 2.0
+
+
+def test_queue_growth_and_hbm_and_recompile_rules():
+    q = A.QueueDepthGrowth(windows=3, min_depth=2, fire_for=1)
+    s = [_sample(1, gauges={"queue_depth": 1.0}),
+         _sample(2, gauges={"queue_depth": 2.0}),
+         _sample(3, gauges={"queue_depth": 4.0})]
+    assert q.update(s) == "fire"
+    # plateau is not growth
+    q2 = A.QueueDepthGrowth(windows=3, min_depth=2, fire_for=1)
+    s2 = s[:2] + [_sample(3, gauges={"queue_depth": 2.0})]
+    assert q2.update(s2) is None
+
+    h = A.HbmResidency(threshold=0.9, fire_for=1)
+    assert h.update([_sample(1, gauges={"kv_utilization": 0.95})]) \
+        == "fire"
+    r = A.RecompilePostSeal()
+    assert r.update([_sample(1, deltas={"recompiles": 1.0})]) == "fire"
+    assert r.update([_sample(2, deltas={"recompiles": 0.0})]) is None
+
+
+def test_ratio_collapse_needs_healthy_baseline():
+    kw = dict(floor=0.25, healthy=0.5, baseline_windows=2,
+              min_den=4.0, fire_for=1)
+    mk = lambda t, hit, tot: _sample(  # noqa: E731
+        t, deltas={"prefix_hit_tokens": float(hit),
+                   "prefix_prompt_tokens": float(tot)})
+    # healthy baseline then collapse: fires
+    r = A.PrefixHitCollapse(**kw)
+    s = [mk(1, 6, 10), mk(2, 6, 10), mk(3, 0, 10)]
+    assert r.update(s) == "fire"
+    # cold cache from the start: never "collapsed", no fire
+    r2 = A.PrefixHitCollapse(**kw)
+    s2 = [mk(1, 0, 10), mk(2, 0, 10), mk(3, 0, 10)]
+    assert r2.update(s2) is None
+    # spec twin shares the machinery
+    r3 = A.SpecAcceptCollapse(floor=0.25, healthy=0.5,
+                              baseline_windows=2, min_den=4.0,
+                              fire_for=1)
+    mk3 = lambda t, a, p: _sample(  # noqa: E731
+        t, deltas={"spec_accepted": float(a),
+                   "spec_proposed": float(p)})
+    assert r3.update([mk3(1, 6, 10), mk3(2, 6, 10),
+                      mk3(3, 0, 10)]) == "fire"
+
+
+def test_manager_rejects_unregistered_rule():
+    class Rogue(A.AlertRule):
+        name = "not_in_registry"
+
+        def check(self, samples):
+            return False, {}
+
+    with pytest.raises(ValueError, match="ALERT_RULES"):
+        A.AlertManager(rules=[Rogue()])
+    with pytest.raises(ValueError, match="duplicate"):
+        A.AlertManager(rules=[A.SLOBurnRate(), A.SLOBurnRate()])
+
+
+def test_alert_rules_registry_matches_defaults():
+    """Runtime twin of ptlint OBS002: the default rule set covers the
+    canonical registry exactly."""
+    assert {r.name for r in A.default_rules()} == set(A.ALERT_RULES)
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+def _run_workload(paged, max_new=8, n=3, seed=0):
+    model, cfg = serving_utils.tiny_model(seed=seed)
+    eng = ContinuousBatchingEngine(model, serving_utils.tiny_ecfg(paged))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, 10) for _ in range(n)]
+    reqs = eng.run(prompts, max_new_tokens=max_new, max_chunk=4)
+    return eng, reqs
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_off_flags_identity_zero_new_programs(paged, flight_flags,
+                                              compile_counter):
+    """timeseries/alerts/cost off vs on: bit-identical outputs and the
+    EXACT same compiled-program set (the flight-data layer is host
+    bookkeeping — zero new compiled programs either way)."""
+    from paddle_tpu.inference import serving
+
+    arms = {}
+    programs = {}
+    for arm, fl in (
+            ("all_off", {"timeseries": False, "alerts": False,
+                         "cost_attribution": False}),
+            ("all_on", {"timeseries": True, "timeseries_cadence": 2,
+                        "alerts": True, "cost_attribution": True}),
+    ):
+        flight_flags(fl)
+        base = dict(serving.TRACE_COUNTS)
+        eng, reqs = _run_workload(paged)
+        arms[arm] = [r.output for r in reqs]
+        programs[arm] = {k: v - base.get(k, 0)
+                         for k, v in serving.TRACE_COUNTS.items()
+                         if v - base.get(k, 0)}
+        if arm == "all_off":
+            assert eng._ts is None and eng._alerts is None
+            assert not eng._cost_enabled
+            assert eng.timeline_snapshot() == {"enabled": False}
+            assert eng.alerts_snapshot() == {"enabled": False}
+            assert eng.cost_snapshot() == {"enabled": False}
+            assert all(r.device_ms == 0.0 for r in reqs)
+    assert arms["all_on"] == arms["all_off"]
+    assert programs["all_on"] == programs["all_off"]
+    compile_counter.assert_programs(set(programs["all_off"]))
+
+
+def test_engine_timeline_windows(flight_flags):
+    flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                  "alerts": True})
+    eng, reqs = _run_workload(paged=True)
+    tl = eng.timeline_snapshot()
+    assert tl["enabled"] and tl["cadence"] == 2
+    series = tl["series"]
+    assert tl["windows"] == len(series) >= 1
+    # ticks land exactly on cadence multiples, strictly increasing
+    assert all(s["tick"] % 2 == 0 for s in series)
+    assert all(b["tick"] > a["tick"]
+               for a, b in zip(series, series[1:]))
+    # cumulative counters never go backwards; final totals match the
+    # engine's own host counters
+    for key in ("tokens", "finished"):
+        vals = [s["counters"][key] for s in series]
+        assert vals == sorted(vals)
+    total = sum(len(r.output) for r in reqs)
+    assert series[-1]["counters"]["tokens"] <= total  # last window may
+    # have closed before the final tokens landed
+    # deltas sum to the last cumulative value
+    assert sum(s["deltas"]["tokens"] for s in series) \
+        == series[-1]["counters"]["tokens"]
+    # gauges present
+    assert "kv_utilization" in series[-1]["gauges"]
+    # alerts evaluated once per closed window; nothing fired on a
+    # healthy run
+    asn = eng.alerts_snapshot()
+    assert asn["enabled"] and asn["active"] == []
+    assert asn["stats"]["evaluated"] == len(series)
+    assert asn["fired_total"] == 0
+
+
+def test_timeline_tokens_count_first_tokens(flight_flags):
+    """The 'tokens' counter includes each request's prefill-sampled
+    FIRST token: a prefill-heavy window (max_new_tokens=1 traffic)
+    must not read as zero tokens — per-token cost derivations over the
+    series would divide by an undercount."""
+    flight_flags({"timeseries": True, "timeseries_cadence": 1})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng = ContinuousBatchingEngine(model,
+                                   serving_utils.tiny_ecfg(False))
+    rng = np.random.default_rng(0)
+    reqs = eng.run([rng.integers(1, cfg.vocab_size, 8)
+                    for _ in range(3)], max_new_tokens=1, max_chunk=2)
+    total = sum(len(r.output) for r in reqs)
+    assert total == 3  # pure first-token traffic
+    series = eng.timeline_snapshot()["series"]
+    assert series[-1]["counters"]["tokens"] == total
+
+
+# ---------------------------------------------------------------------------
+# the seeded storm: deterministic burn-rate firing + artifact
+# ---------------------------------------------------------------------------
+def _burn_storm(paged, set_flags, dump_dir, spec="step:0.08,seed:11"):
+    """Saturation/fault storm: 2 slots, 8 tight-TTFT interactive
+    requests (every finish violates), seeded step faults — drives the
+    burn-rate alert deterministically."""
+    set_flags({"timeseries": True, "timeseries_cadence": 2,
+               "alerts": True, "telemetry": True,
+               "telemetry_dump_dir": dump_dir,
+               "cost_attribution": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng = ContinuousBatchingEngine(
+        model, serving_utils.tiny_ecfg(paged),
+        fault_injector=FaultInjector(spec))
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        eng.add_request(rng.integers(1, cfg.vocab_size, 10), 6,
+                        slo="interactive", ttft_target_ms=0.001)
+    while eng.step_chunk(2) or eng.active.any() or eng._queue:
+        pass
+    return eng
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_burn_rate_storm_deterministic(paged, flight_flags, tmp_path):
+    """The acceptance pin: same ticks, same windows, both cache modes,
+    two fresh runs — and the artifact carries the triggering series
+    window."""
+    runs = []
+    for i in range(2):
+        d = tmp_path / f"run{i}"
+        d.mkdir()
+        eng = _burn_storm(paged, flight_flags, str(d))
+        asn = eng.alerts_snapshot()
+        runs.append([(t["rule"], t["event"], t["tick"])
+                     for t in asn["transitions"]])
+        if i == 0:
+            assert ("slo_burn_rate", "fire") in [
+                (r, e) for r, e, _ in runs[0]], runs[0]
+            assert "slo_burn_rate" in asn["active"]
+            assert asn["rules"]["slo_burn_rate"]["peak"] >= 2.0
+            # the firing left exactly one artifact whose record
+            # carries the triggering window of series samples
+            dumps = sorted(d.glob("flight_*.json"))
+            assert dumps, "no FlightRecorder artifact written"
+            doc = json.loads(dumps[0].read_text())
+            rec = next(r for r in doc["records"]
+                       if r.get("kind") == "alert")
+            assert rec["rule"] == "slo_burn_rate"
+            assert rec["window"], "triggering window missing"
+            assert all("deltas" in s for s in rec["window"])
+            # forced tracer event survives sample thinning
+            assert any(e["name"] == "alert"
+                       and e["args"]["rule"] == "slo_burn_rate"
+                       for e in eng._tracer.events())
+            # registry surfaces the firing
+            from paddle_tpu import observability as obs
+
+            fired = obs.global_registry().get(
+                "pt_serve_alerts_fired_total")
+            assert any(v >= 1 for v in fired.series().values())
+    assert runs[0] == runs[1], "storm transitions are not deterministic"
+
+
+def test_slo_degradation_hook(flight_flags):
+    """PT_FLAGS_slo_degradation: an active burn climbs the ladder's
+    capacity rungs without real queue saturation; off leaves the
+    ladder at 0 for the identical workload."""
+    levels = {}
+    for flag_on in (False, True):
+        flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                      "alerts": True, "slo_degradation": flag_on})
+        model, cfg = serving_utils.tiny_model(seed=0)
+        ecfg = serving_utils.tiny_ecfg(False, max_slots=4)
+        eng = ContinuousBatchingEngine(model, ecfg)
+        rng = np.random.default_rng(0)
+        # steady trickle (one arrival per 2 ticks, 4 slots): the queue
+        # never backs up — no REAL saturation — but every finish
+        # violates its 1µs TTFT target, so finishes land in every
+        # window and the burn sustains through its hysteresis
+        for _ in range(24):
+            eng.add_request(rng.integers(1, cfg.vocab_size, 8), 4,
+                            slo="interactive", ttft_target_ms=0.001)
+            eng.step_chunk(2)
+            eng.step_chunk(2)
+        levels[flag_on] = eng.backpressure()["degradation_level"]
+        assert eng.alerts_snapshot()["rules"]["slo_burn_rate"]["fired"] \
+            >= 1
+    assert levels[False] == 0
+    assert levels[True] >= 1
+
+
+# ---------------------------------------------------------------------------
+# per-request device-cost attribution
+# ---------------------------------------------------------------------------
+def test_cost_accumulates_and_records_at_finish(flight_flags):
+    flight_flags({"cost_attribution": True})
+    eng, reqs = _run_workload(paged=True)
+    assert all(r.device_ms > 0 for r in reqs)
+    cs = eng.cost_snapshot()
+    assert cs["enabled"]
+    # profiler off: everything is the sync-wall estimate
+    assert cs["profiled_ms"] == 0.0 and cs["estimated_ms"] > 0.0
+    assert cs["requests_finished"] == len(reqs)
+    assert cs["request_device_ms_total"] == pytest.approx(
+        sum(r.device_ms for r in reqs))
+    assert cs["request_device_ms_p50"] is not None
+    assert cs["by_slo"]["untracked"]["requests"] == len(reqs)
+    # attribution conserves each step's wall exactly (float rounding)
+    assert sum(cs["attributed_ms"].values()) == pytest.approx(
+        cs["profiled_ms"] + cs["estimated_ms"])
+    # the unified snapshot embeds it
+    assert eng.metrics_snapshot()["cost"]["enabled"]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_cost_reconciles_with_profiler_totals(paged, flight_flags,
+                                              compile_counter):
+    """THE reconciliation pin (acceptance criterion): at profiler
+    cadence 1 every dispatch of every attributed program is measured,
+    so per-request device-ms sums equal the profiler's per-program
+    device totals to float rounding — and the profiler adds zero
+    compiled programs while doing it."""
+    from paddle_tpu.inference import serving
+
+    flight_flags({"cost_attribution": True, "profile_programs": True,
+                  "profile_sample_every": 1})
+    base = dict(serving.TRACE_COUNTS)
+    eng, reqs = _run_workload(paged, max_new=8, n=4)
+    assert len(reqs) == 4 and all(r.done for r in reqs)
+    cs = eng.cost_snapshot()
+    # cadence 1: nothing fell back to the sync-wall estimate
+    assert cs["estimated_ms"] == 0.0
+    prof = eng.profile_snapshot()["programs"]
+    prof_total = sum(
+        st["sampled"] * st["device_ms_mean"]
+        for name, st in prof.items()
+        if name in ATTRIBUTED and st["sampled"])
+    req_total = sum(r.device_ms for r in reqs)
+    assert req_total == pytest.approx(prof_total, rel=1e-9)
+    assert req_total == pytest.approx(cs["profiled_ms"], rel=1e-9)
+    assert sum(r.device_ms_profiled for r in reqs) \
+        == pytest.approx(req_total, rel=1e-9)
+    # per-program cross-check
+    for name, ms in cs["attributed_ms"].items():
+        st = prof[name]
+        assert ms == pytest.approx(
+            st["sampled"] * st["device_ms_mean"], rel=1e-9)
+    # zero new compiled programs from profiling + attribution
+    grown = {k: v - base.get(k, 0)
+             for k, v in serving.TRACE_COUNTS.items()
+             if v - base.get(k, 0)}
+    assert set(grown) <= ATTRIBUTED | {"prefix_insert", "prefix_read",
+                                       "page_copy"}
+
+
+def test_cost_rides_ledger_across_handoff(flight_flags):
+    """Cost survives a drain handoff: the ledger carries device_ms and
+    admit_ledger restores it, so the successor's finish-time record
+    bills the request's WHOLE life."""
+    flight_flags({"cost_attribution": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng1 = ContinuousBatchingEngine(model,
+                                    serving_utils.tiny_ecfg(True))
+    rng = np.random.default_rng(0)
+    rid = eng1.add_request(rng.integers(1, cfg.vocab_size, 10), 24)
+    for _ in range(3):
+        eng1.step_chunk(2)
+    summary = eng1.drain(deadline_ms=1.0, max_chunk=2)
+    led = next(l for l in summary["unfinished"] if l["rid"] == rid)
+    assert led["device_ms"] > 0
+    burned = led["device_ms"]
+    eng2 = ContinuousBatchingEngine(model,
+                                    serving_utils.tiny_ecfg(True))
+    eng2.admit_ledger(led)
+    while eng2.step_chunk(2) or eng2.active.any() or eng2._queue:
+        pass
+    req = eng2._finished[rid]
+    assert req.device_ms > burned  # prior life + continued decode
+    cs = eng2.cost_snapshot()
+    assert cs["request_device_ms_total"] == pytest.approx(
+        req.device_ms)
+
+
+def test_cancel_and_timeout_record_cost(flight_flags):
+    flight_flags({"cost_attribution": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng = ContinuousBatchingEngine(model, serving_utils.tiny_ecfg(True))
+    rng = np.random.default_rng(0)
+    r1 = eng.add_request(rng.integers(1, cfg.vocab_size, 10), 24)
+    r2 = eng.add_request(rng.integers(1, cfg.vocab_size, 10), 24,
+                         deadline_ms=30.0)
+    for _ in range(3):
+        eng.step_chunk(2)
+    assert eng.cancel(r1)
+    time.sleep(0.04)  # r2's deadline expires
+    eng.step_chunk(2)
+    cs = eng.cost_snapshot()
+    assert eng._finished[r1].finish_reason == "cancel"
+    assert eng._finished[r2].finish_reason == "timeout"
+    assert cs["requests_finished"] >= 2
+    assert eng._finished[r1].device_ms > 0
+    assert cs["request_device_ms_total"] >= \
+        eng._finished[r1].device_ms
+
+
+# ---------------------------------------------------------------------------
+# endpoints / CLI / router
+# ---------------------------------------------------------------------------
+def test_timeline_endpoint(flight_flags):
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.inference import start_metrics_server
+
+    flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                  "alerts": True, "telemetry": True})
+    eng, _ = _run_workload(paged=False)
+    srv = start_metrics_server(eng, port=0)
+    try:
+        with urllib.request.urlopen(
+                srv.url + "/timeline", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["enabled"] and doc["windows"] >= 1
+        assert doc["series"][0]["tick"] % 2 == 0
+    finally:
+        srv.shutdown()
+    # off: 404, mirroring /trace
+    flight_flags({"timeseries": False})
+    model, _cfg = serving_utils.tiny_model(seed=0)
+    eng2 = ContinuousBatchingEngine(model,
+                                    serving_utils.tiny_ecfg(False))
+    srv2 = start_metrics_server(eng2, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv2.url + "/timeline", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv2.shutdown()
+
+
+def test_dump_cli_timeline(capsys, flight_flags):
+    flight_flags({"timeseries": True, "timeseries_cadence": 2})
+    from paddle_tpu.observability import dump
+
+    _eng, _ = _run_workload(paged=False)
+    assert dump.main(["--timeline"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert any(s["windows"] >= 1 for s in out)
+    assert all("series" in s for s in out)
+
+
+def test_router_fleet_timeline_and_alert_aggregation(flight_flags):
+    from paddle_tpu.inference.router import EngineRouter
+
+    flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                  "alerts": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    router = EngineRouter(model, serving_utils.tiny_ecfg(True),
+                          n_replicas=2)
+    rng = np.random.default_rng(0)
+    # tight targets: replica burn rules fire, the fleet view must see
+    for _ in range(6):
+        router.add_request(rng.integers(1, cfg.vocab_size, 8), 4,
+                           slo="interactive", ttft_target_ms=0.001)
+    while router.step(2):
+        pass
+    tl = router.timeline_snapshot()
+    assert tl["enabled"]
+    assert tl["router"]["windows"] >= 1
+    assert len(tl["replicas"]) == 2
+    assert all(r["enabled"] for r in tl["replicas"])
+    # fleet counters windowed on the router's own store
+    assert "routed" in tl["router"]["series"][-1]["counters"]
+    fs = router.fleet_snapshot()
+    assert fs["alerts"]["enabled"]
+    assert fs["alerts"]["fired"] >= 1
+    assert any(a["rule"] == "slo_burn_rate"
+               for a in fs["alerts"]["active"])
+
+
+# ---------------------------------------------------------------------------
+# chaos lane: readers under a producer-thread fault storm (sanitized)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_flight_readers_under_fault_storm(flight_flags):
+    """Timeline/alert/cost readers from a scrape thread while a
+    producer thread feeds a seeded fault storm — sanitizer on (chaos
+    autouse fixture): no torn windows (every sample fully formed,
+    ticks strictly increasing on cadence), no scheduler-state mutation
+    from the scrape thread, pool fully recovered after."""
+    flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                  "alerts": True, "cost_attribution": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng = ContinuousBatchingEngine(
+        model, serving_utils.tiny_ecfg(True, max_slots=2),
+        fault_injector=FaultInjector("step:0.05,nan:0.03,seed:7"))
+    rng = np.random.default_rng(1)
+    stop = threading.Event()
+    errors = []
+
+    def produce():
+        try:
+            for i in range(10):
+                eng.add_request(rng.integers(1, cfg.vocab_size, 8), 4,
+                                slo="interactive",
+                                ttft_target_ms=0.001)
+                time.sleep(0.005)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def scrape():
+        try:
+            while not stop.is_set():
+                tl = eng.timeline_snapshot()
+                if tl["enabled"]:
+                    ticks = [s["tick"] for s in tl["series"]]
+                    assert ticks == sorted(ticks)
+                    assert all(t % 2 == 0 for t in ticks)
+                    for s in tl["series"]:
+                        assert {"counters", "deltas", "rates",
+                                "gauges"} <= set(s)
+                eng.alerts_snapshot()
+                eng.cost_snapshot()
+                eng.metrics_snapshot()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    prod = threading.Thread(target=produce)
+    scr = threading.Thread(target=scrape, daemon=True)
+    prod.start()
+    scr.start()
+    deadline = time.monotonic() + 60
+    while (eng.active.any() or eng._queue or prod.is_alive()) \
+            and time.monotonic() < deadline:
+        eng.step_chunk(2)
+    prod.join(timeout=10)
+    stop.set()
+    scr.join(timeout=10)
+    assert not errors, errors
+    assert time.monotonic() < deadline, "storm did not converge"
+    # every request accounted, pool recovered
+    assert len(eng._finished) == 10
+    assert not eng.active.any()
+    assert eng.pool.free_pages > 0
+    assert len(eng._free_heap) == eng.cfg.max_slots
+    # the storm fired the burn alert through the fault noise too
+    assert eng.alerts_snapshot()["rules"]["slo_burn_rate"]["fired"] \
+        >= 1
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_flight_kill_storm_soak(flight_flags):
+    """Heavier producer-thread kill-storm soak (cancel-every-3rd rid +
+    step/nan/latency faults) with the scrape thread hammering every
+    flight reader — slow lane (tier-1 budget guard): the fast chaos
+    twin above keeps tier-1 coverage."""
+    flight_flags({"timeseries": True, "timeseries_cadence": 2,
+                  "timeseries_retention": 8, "alerts": True,
+                  "cost_attribution": True})
+    model, cfg = serving_utils.tiny_model(seed=0)
+    eng = ContinuousBatchingEngine(
+        model, serving_utils.tiny_ecfg(True, max_slots=2),
+        fault_injector=FaultInjector(
+            "step:0.08,nan:0.04,latency:0.05,latency_ms:2,seed:3"))
+    rng = np.random.default_rng(2)
+    rids, errors = [], []
+    stop = threading.Event()
+
+    def produce():
+        try:
+            for i in range(24):
+                rid = eng.add_request(
+                    rng.integers(1, cfg.vocab_size, 8), 4,
+                    slo="interactive", ttft_target_ms=0.001)
+                rids.append(rid)
+                time.sleep(0.003)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def scrape():
+        try:
+            while not stop.is_set():
+                tl = eng.timeline_snapshot()
+                # retention ring bounded even under storm
+                assert tl["windows"] <= 8
+                eng.alerts_snapshot()
+                eng.cost_snapshot()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    prod = threading.Thread(target=produce)
+    scr = threading.Thread(target=scrape, daemon=True)
+    prod.start()
+    scr.start()
+    deadline = time.monotonic() + 120
+    seen_cancel = set()
+    while (eng.active.any() or eng._queue or prod.is_alive()) \
+            and time.monotonic() < deadline:
+        eng.step_chunk(2)
+        # cancel-every-3rd from the scheduler thread (the engine's
+        # documented cancel contract)
+        for rid in list(rids):
+            if rid % 3 == 0 and rid not in seen_cancel:
+                seen_cancel.add(rid)
+                eng.cancel(rid)
+    prod.join(timeout=10)
+    stop.set()
+    scr.join(timeout=10)
+    assert not errors, errors
+    assert time.monotonic() < deadline, "soak did not converge"
+    assert len(eng._finished) == 24
+    assert not eng.active.any()
+    assert len(eng._free_heap) == eng.cfg.max_slots
+    # every finished request carries a recorded cost exactly once
+    cs = eng.cost_snapshot()
+    assert cs["requests_finished"] == 24
+    assert cs["request_device_ms_total"] == pytest.approx(
+        sum(r.device_ms for r in eng._finished.values()))

@@ -199,6 +199,39 @@ def test_obs001_fires_on_unlabeled_program(tmp_repo):
     assert not [v for v in result.violations if v.rule == "OBS001"]
 
 
+def test_obs002_fires_on_unregistered_alert_rule(tmp_repo):
+    """OBS002: an AlertRule implementation missing from ALERT_RULES or
+    the README alerts table is a completeness violation (transitive
+    subclasses count); registered + documented rules pass. A scan that
+    never sees alerts.py stays silent."""
+    obsd = tmp_repo / "paddle_tpu" / "observability"
+    obsd.mkdir(parents=True)
+    alerts_py = obsd / "alerts.py"
+    alerts_py.write_text(
+        'ALERT_RULES = {"known": "a registered rule"}\n'
+        "class AlertRule:\n"
+        '    name = ""\n'
+        "class Known(AlertRule):\n"
+        '    name = "known"\n'
+        "class _Shape(AlertRule):\n"
+        "    pass\n"
+        "class Mystery(_Shape):\n"
+        '    name: str = "mystery"\n')  # AnnAssign spelling counts too
+    (tmp_repo / "README.md").write_text("alerts: `known` only\n")
+    result = lint.scan([str(tmp_repo / "paddle_tpu")], str(tmp_repo))
+    obs = [v for v in result.violations if v.rule == "OBS002"]
+    # mystery is both unregistered AND undocumented
+    assert len(obs) == 2, obs
+    assert all("mystery" in v.message for v in obs)
+    msgs = " | ".join(v.message for v in obs)
+    assert "ALERT_RULES" in msgs and "README" in msgs
+    # a scan that never saw alerts.py: silent
+    other = tmp_repo / "paddle_tpu" / "inference" / "x.py"
+    other.write_text("pass\n")
+    result = lint.scan([str(other)], str(tmp_repo))
+    assert not [v for v in result.violations if v.rule == "OBS002"]
+
+
 def test_inline_suppression_and_skip_file(tmp_repo):
     bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
     # the marker is assembled at runtime so scanning THIS test file

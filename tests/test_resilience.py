@@ -576,6 +576,7 @@ def test_drain_ledger_payload_shape():
         "temperature", "top_k", "top_p", "greedy", "slo",
         "ttft_target_ms", "tpot_target_ms", "deadline_t",
         "max_retries", "retries", "ttft_ms", "submit_t", "admit_t",
+        "device_ms", "device_ms_profiled",
     }
     assert led["rid"] == rid
     assert led["prompt"] == list(range(1, 11))
