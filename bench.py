@@ -582,6 +582,16 @@ def _compact_line(result):
                     k: rf.get(k) for k in
                     ("failovers", "outputs_match",
                      "failover_overhead_pct")}
+            # contract-audit verdict (serve7b): the repo program
+            # set's ptaudit result rides the ledger — programs
+            # audited, op-counts-ok bit, violation count — so a
+            # donation/dtype/size regression is visible on the same
+            # line as the perf numbers it would silently rot
+            au = (r.get("extra") or {}).get("audit") or {}
+            if au:
+                row["audit"] = {
+                    k: au.get(k) for k in
+                    ("programs", "op_counts_ok", "violations")}
             # measured-vs-modeled step breakdown (serve7b): the
             # decode-chunk measured p50 beside its HBM floor, plus
             # the recompile-watchdog verdict, ride the ledger so the
@@ -611,6 +621,7 @@ def _compact_line(result):
             row.pop("flight", None)
             row.pop("quant", None)
             row.pop("replica_failover", None)
+            row.pop("audit", None)
             row.pop("step_breakdown", None)
         line = json.dumps(out)
     if len(line) > MAX_LINE_BYTES:

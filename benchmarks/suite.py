@@ -1033,6 +1033,41 @@ def _replica_failover_scenario(model, base_ecfg, tpu):
     return out
 
 
+def _audit_scenario():
+    """Contract-audit verdict for the ledger: the canonical tiny-arm
+    repo program set (ptaudit, analysis/program_audit.py). The
+    structural families (AL donation, DQ001 dtype pairs, TX transfer
+    bans, DD dead operands) are platform-honest and run everywhere;
+    the committed ``.ptaudit-baseline.json`` size/creep pins (SZ,
+    DQ002) are CPU-trace canonical — on TPU the fused Pallas kernels
+    change the op mix, so the baseline comparison is skipped there
+    and ``op_counts_ok`` reads None, never a spurious red. Compact on
+    purpose (the ledger line sheds it with the other secondary
+    detail): program count, the op-counts-ok bit, the total violation
+    count with the first few rule ids named."""
+    from paddle_tpu.analysis import program_audit as PA
+
+    on_cpu = _platform() != "tpu"
+    t0 = time.perf_counter()
+    try:
+        rep = PA.audit_repo(use_baseline=on_cpu)
+    except Exception as e:  # a broken audit must not sink the bench
+        # op_counts_ok None: nothing was COMPARED — the error field
+        # and violations:-1 carry the failure, never a spurious red
+        return {"programs": 0, "op_counts_ok": None,
+                "violations": -1, "error": str(e)[:200]}
+    viol = rep["violations"]
+    return {
+        "programs": len(rep["entries"]),
+        "op_counts_ok": (not any(
+            v.rule in ("SZ001", "SZ002", "DQ002") for v in viol))
+        if on_cpu else None,
+        "violations": len(viol),
+        "rules": sorted({v.rule for v in viol})[:5],
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _quant_scenario(base_ecfg, tpu):
     """Quantized-serving A/B: the SAME greedy workload served three
     ways — bf16 weights (baseline), int8 weight streaming, and
@@ -1326,6 +1361,7 @@ def bench_serve7b(tpu_diags):
     replica_failover = _replica_failover_scenario(model, ecfg, tpu)
     quant = _quant_scenario(ecfg, tpu)
     step_breakdown = _step_breakdown_scenario(model, ecfg, tpu)
+    audit = _audit_scenario()
     eng = ContinuousBatchingEngine(model, ecfg)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
@@ -1379,6 +1415,7 @@ def bench_serve7b(tpu_diags):
         "replica_failover": replica_failover,
         "quant": quant,
         "step_breakdown": step_breakdown,
+        "audit": audit,
         "decode_attn_roofline": _decode_attn_roofline(
             cfg, ecfg, prompt_len + measure_tokens // 2,
             2 if cache_dtype == jnp.bfloat16 else 4),

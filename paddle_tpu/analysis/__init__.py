@@ -1,6 +1,6 @@
 """Static analysis + runtime invariant checking for the serving stack.
 
-Two halves of one correctness story:
+Three layers of one correctness story (source → trace → runtime):
 
 * :mod:`paddle_tpu.analysis.lint` — **ptlint**, an AST-based static
   lint (``python -m paddle_tpu.analysis.lint <paths>`` or the
@@ -11,6 +11,18 @@ Two halves of one correctness story:
   only caught by observation. The analysis engine is stdlib-``ast``
   only (importing :mod:`.lint`/:mod:`.rules` directly pulls in no
   jax; the ``-m``/console launches import the parent package once).
+
+* :mod:`paddle_tpu.analysis.program_audit` — **ptaudit**
+  (``python -m paddle_tpu.analysis.audit``), a jaxpr-level contract
+  auditor over the compiled serving program set: one declarative
+  ``PROGRAM_CONTRACTS`` entry per ``TRACE_COUNTS`` program name
+  (ptlint PA001 keeps the registry complete), traced at tiny
+  CPU-friendly shapes and audited for donation/aliasing (AL), dtype
+  discipline (DQ), host-transfer bans (TX), dead operands (DD) and
+  op-count budgets against ``.ptaudit-baseline.json`` (SZ).
+  ``PT_FLAGS_audit_on_seal`` lets production engines self-audit at
+  ``seal_programs()``. ``python -m paddle_tpu.analysis.check`` runs
+  ptlint + ptaudit as one gate with one exit code.
 
 * :mod:`paddle_tpu.analysis.sanitizer` — a runtime invariant checker
   behind ``PT_FLAGS_sanitize`` (off = one identity check per hook

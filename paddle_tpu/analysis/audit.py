@@ -1,0 +1,18 @@
+"""``python -m paddle_tpu.analysis.audit`` — the ptaudit CLI.
+
+Thin launcher for :mod:`paddle_tpu.analysis.program_audit` (the
+contract registry, probes and rule families live there); mirrors
+ptlint's UX: ``--json``, ``--rules``, ``--write-baseline``,
+``--no-baseline``, ``--arms``, non-zero exit on violations. Unlike
+ptlint this module is jax-heavy by nature — it traces the real
+serving programs — so it is never imported by the lint path.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .program_audit import main  # noqa: F401
+
+if __name__ == "__main__":
+    sys.exit(main())

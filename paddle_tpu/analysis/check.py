@@ -1,0 +1,109 @@
+"""``python -m paddle_tpu.analysis.check`` — ptlint + ptaudit in one
+gate with one exit code.
+
+The CI/tooling front door for the whole static-analysis layer: the
+AST lint over the source tree AND the jaxpr contract audit over the
+compiled serving program set, each against its committed baseline.
+
+Usage::
+
+    python -m paddle_tpu.analysis.check                # full repo
+    python -m paddle_tpu.analysis.check --json
+    python -m paddle_tpu.analysis.check --arms paged-bf16
+
+Exit status: 0 when BOTH halves are clean, 1 when either reports a
+violation, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from . import lint
+
+_LINT_PATHS = ("paddle_tpu", "tests", "benchmarks")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptcheck",
+        description="run ptlint (AST) + ptaudit (jaxpr contracts) "
+                    "together: one gate, one exit code")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: nearest pyproject.toml)")
+    ap.add_argument("--arms", default=None,
+                    help="comma-separated ptaudit arm subset")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable combined output")
+    args = ap.parse_args(argv)
+
+    root = args.root or lint.find_root(
+        os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, p) for p in _LINT_PATHS
+             if os.path.exists(os.path.join(root, p))]
+    if not paths:
+        print(f"ptcheck: no scan paths under {root}", file=sys.stderr)
+        return 2
+
+    # ---- ptlint half ----
+    result = lint.scan(paths, root)
+    try:
+        baseline = lint.load_baseline(
+            os.path.join(root, lint.BASELINE_NAME))
+    except ValueError as e:
+        print(f"ptcheck: {e}", file=sys.stderr)
+        return 2
+    lint_new, _accepted = lint.apply_baseline(
+        result.violations, baseline)
+
+    # ---- ptaudit half (jax-heavy import deferred past the lint) ----
+    from . import program_audit as PA
+
+    # the audit half traces the IMPORTED package's programs against
+    # that tree's baseline — a --root pointing at a different
+    # checkout would silently gate one tree's lint with another
+    # tree's audit, so refuse the mix outright
+    pkg_root = lint.find_root(
+        os.path.dirname(os.path.abspath(PA.__file__)))
+    if os.path.realpath(root) != os.path.realpath(pkg_root):
+        print(f"ptcheck: --root {root} is not the imported "
+              f"paddle_tpu's repo ({pkg_root}) — the audit half can "
+              "only trace the imported package; run ptcheck from "
+              "that checkout instead", file=sys.stderr)
+        return 2
+
+    arm_names = [a.strip() for a in args.arms.split(",")] \
+        if args.arms else None
+    try:
+        audit = PA.audit_repo(arms=arm_names)
+    except (PA.AuditError, ValueError) as e:
+        print(f"ptcheck: {e}", file=sys.stderr)
+        return 2
+    audit_viol = audit["violations"]
+
+    if args.as_json:
+        print(json.dumps({
+            "lint": {"files": result.files,
+                     "violations": [v.__dict__ for v in lint_new]},
+            "audit": {"programs": sorted(audit["entries"]),
+                      "violations": [v.__dict__ for v in audit_viol]},
+        }, indent=2))
+        return 1 if (lint_new or audit_viol) else 0
+
+    for v in lint_new:
+        print(f"{v.file}:{v.line}: {v.rule} {v.message}")
+    for x in audit_viol:
+        print(f"{x.arm}::{x.program}: {x.rule} {x.message}")
+    print(f"ptcheck: lint {result.files} file(s) "
+          f"{len(lint_new)} violation(s); audit "
+          f"{len(audit['entries'])} program(s) "
+          f"{len(audit_viol)} violation(s)")
+    return 1 if (lint_new or audit_viol) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

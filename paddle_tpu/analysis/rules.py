@@ -86,6 +86,12 @@ class Project:
         self.alert_impls: Dict[str, Tuple[str, int]] = {}
         self.alert_rules: Set[str] = set()
         self.saw_alerts_module = False
+        # PA001: the PROGRAM_CONTRACTS literal keys from
+        # analysis/program_audit.py (trace_programs above is shared
+        # with OBS001 — verdicts run after every module is scanned,
+        # so rule order in ALL_RULES doesn't matter)
+        self.program_contracts: Set[str] = set()
+        self.saw_audit_module = False
 
     def readme_text(self) -> str:
         path = os.path.join(self.root, "README.md")
@@ -676,6 +682,64 @@ class OBS001ProgramLabelCompleteness(Rule):
         return out
 
 
+class PA001ProgramContractCompleteness(Rule):
+    """Collector + one project-level verdict: every compiled serving
+    program registered in a ``TRACE_COUNTS`` compile counter must also
+    carry a contract in
+    ``analysis/program_audit.PROGRAM_CONTRACTS`` — the declarative
+    registry the jaxpr auditor (ptaudit) traces and enforces. OBS001
+    guarantees a new program joins the *measurement* surface; this
+    guarantees it joins the *audit* surface, so a program cannot ship
+    without stating its donation/dtype/dead-operand promises. The
+    runtime twin (tests/test_program_audit.py) pins the AST view
+    against the imported registry."""
+
+    id = "PA001"
+    doc = ("every TRACE_COUNTS-registered program name must carry a "
+           "contract in analysis/program_audit.PROGRAM_CONTRACTS")
+
+    def applies(self, relpath):
+        return _in(relpath, "paddle_tpu")
+
+    def check_module(self, project, tree, src, relpath):
+        del src
+        # any program_audit.py under an analysis/ dir: the real
+        # module plus synthetic tmp-repo twins the rule tests plant
+        if relpath.endswith("analysis/program_audit.py"):
+            project.saw_audit_module = True
+            for node in ast.walk(tree):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target]
+                           if isinstance(node, ast.AnnAssign) else [])
+                if any(isinstance(t, ast.Name)
+                       and t.id == "PROGRAM_CONTRACTS"
+                       for t in targets) \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        s = _const_str(k)
+                        if s is not None:
+                            project.program_contracts.add(s)
+        # TRACE_COUNTS bumps accumulate in project.trace_programs via
+        # OBS001's collector (same scope, same walk) — no second scan
+        return []
+
+    def check_project(self, project):
+        if not project.saw_audit_module:
+            # partial scan (e.g. `lint tests/`): without the contract
+            # registry in view every program would read uncontracted
+            return []
+        out: List[Violation] = []
+        for name, (f, ln) in sorted(project.trace_programs.items()):
+            if name not in project.program_contracts:
+                out.append(Violation(
+                    f, ln, "PA001",
+                    f"compiled program {name!r} bumps TRACE_COUNTS "
+                    "but has no contract in analysis/program_audit."
+                    "PROGRAM_CONTRACTS — ptaudit cannot verify its "
+                    "donation/dtype/transfer promises"))
+        return out
+
+
 class OBS002AlertRuleRegistry(Rule):
     """Collector + one project-level verdict: every alert-rule
     implementation in ``observability/alerts.py`` (a class deriving
@@ -1013,6 +1077,7 @@ ALL_RULES: Sequence[Rule] = (
     FlagsHygiene(),
     OBS001ProgramLabelCompleteness(),
     OBS002AlertRuleRegistry(),
+    PA001ProgramContractCompleteness(),
     CC001CopyOnRead(),
 )
 
@@ -1028,6 +1093,7 @@ RULE_DOCS: Dict[str, str] = {
     "FL003": "defined flags must appear in README's flags tables",
     "OBS001": OBS001ProgramLabelCompleteness.doc,
     "OBS002": OBS002AlertRuleRegistry.doc,
+    "PA001": PA001ProgramContractCompleteness.doc,
     "CC001": "scrape-thread readers iterate copies (list(...)-wrapped)",
     "CC002": "scrape-thread readers never mutate scheduler-owned state",
     "CC003": ("readers on sanitizer-bearing classes carry their "

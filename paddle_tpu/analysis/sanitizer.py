@@ -59,6 +59,9 @@ SAFE_READS = frozenset({
     # once appended (the ring copies under its lock), alert/cost
     # snapshots copy every nested structure
     "timeline_snapshot", "alerts_snapshot", "cost_snapshot",
+    # seal-time contract-audit verdict (ptaudit): the report is
+    # immutable after seal_programs(); the snapshot copies it
+    "audit_snapshot",
 })
 
 

@@ -256,6 +256,17 @@ define_flag("recompile_watchdog", True,
             "explicitly after real warmup traffic. One artifact per "
             "program per engine; counters keep counting. Never "
             "raises; off = no watchdog, one identity check per tick")
+define_flag("audit_on_seal", False,
+            "run the ptaudit jaxpr contract audit "
+            "(analysis/program_audit.py: donation/aliasing, dtype "
+            "discipline, transfer bans, dead operands) over the "
+            "engine's OWN compiled programs at its real shapes when "
+            "seal_programs() seals the set — a trace-only self-audit "
+            "(no compile, no dispatch, TRACE_COUNTS restored so the "
+            "watchdog and compile-count guards never see it); the "
+            "verdict surfaces in metrics_snapshot()['audit']. Off = "
+            "one identity check at seal. Size budgets (SZ) stay with "
+            "the CLI's canonical tiny arms")
 define_flag("timeseries", False,
             "serving flight-data recorder "
             "(observability/timeseries.py): a bounded ring of "

@@ -499,6 +499,15 @@ class ContinuousBatchingEngine:
         # constants — a 7B int8 model would otherwise bake ~7 GB of
         # weights into every compiled program
         self.buffers = extract_buffers(model)
+        if self.weight_dtype != "bf16":
+            # PTQ's act_scale calibration buffers are dead in every
+            # weight-only serving forward (ptaudit DD001 found them
+            # riding each compiled program as 15 unread args on the
+            # tiny model alone) — drop them from the per-dispatch
+            # buffer args; they stay on the Layer tree for
+            # state_dict round-trips
+            self.buffers = {n: v for n, v in self.buffers.items()
+                            if not n.endswith(".act_scale")}
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -735,6 +744,14 @@ class ContinuousBatchingEngine:
                            if self._tel is not None
                            else (self._prof.engine_id
                                  if self._prof is not None else "-")))
+        # PT_FLAGS_audit_on_seal (analysis/program_audit.py): run the
+        # jaxpr contract audit (AL/DQ/TX/DD rule families) over THIS
+        # engine's own programs at its real shapes when the program
+        # set seals — trace-only self-audit, no compile, no dispatch,
+        # TRACE_COUNTS restored. Off (default) = one identity check
+        # at seal; the verdict surfaces in metrics_snapshot()["audit"]
+        self._audit_on_seal = bool(flags.flag("audit_on_seal"))
+        self._audit_report = None
         # ---------------- flight data: history + alerts + cost -------
         # PT_FLAGS_timeseries (observability/timeseries.py): a bounded
         # ring of fixed-cadence windowed samples over this engine's
@@ -1102,7 +1119,13 @@ class ContinuousBatchingEngine:
                     first = jax.random.categorical(
                         key, last / self.cfg.temperature)
                 return first, filled
-            self._prefill_c = jax.jit(fn, static_argnums=(6,))
+            # caches (the fresh per-call bucket cache) is donated: the
+            # program fills it in place and the caller only ever uses
+            # the returned `filled`. ptaudit AL001 found the missing
+            # donation — without it every legacy prefill paid a full
+            # bucket-cache copy on top of the fill
+            self._prefill_c = jax.jit(fn, static_argnums=(6,),
+                                      donate_argnums=(2,))
         return self._prefill_c
 
     def _insert_contig(self):
@@ -3387,6 +3410,10 @@ class ContinuousBatchingEngine:
         # /timeline — windows x samples would bloat every scrape)
         snap["alerts"] = self.alerts_snapshot()
         snap["cost"] = self.cost_snapshot()
+        # seal-time contract audit (ptaudit): the self-audit verdict
+        # rides the one unified document too ({"enabled": False}
+        # when PT_FLAGS_audit_on_seal is off)
+        snap["audit"] = self.audit_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -3718,9 +3745,50 @@ class ContinuousBatchingEngine:
         """Seal the recompile watchdog's expected program set NOW
         (e.g. right after a bench warmup) instead of waiting out
         PT_FLAGS_recompile_warmup_ticks. No-op when the watchdog is
-        off."""
+        off. With ``PT_FLAGS_audit_on_seal`` the sealed program set is
+        also contract-audited (ptaudit AL/DQ/TX/DD) at this engine's
+        own shapes — trace-only, compile accounting untouched."""
         if self._watchdog is not None:
             self._watchdog.seal()
+        if self._audit_on_seal:
+            from ..analysis.program_audit import audit_engine
+
+            try:
+                self._audit_report = audit_engine(self, arm="seal")
+            except Exception as e:
+                # the self-audit NEVER takes down a production seal
+                # (the recompile watchdog's "never raises" contract):
+                # probe/signature drift surfaces as an error verdict
+                # on the snapshot instead
+                self._audit_report = {
+                    "arm": "seal", "programs": {}, "skipped": {},
+                    "violations": [], "error": f"{type(e).__name__}: "
+                                               f"{e}"}
+
+    def audit_snapshot(self) -> dict:
+        """Seal-time contract-audit verdict (``{"enabled": False}``
+        when PT_FLAGS_audit_on_seal is off; ``sealed: False`` before
+        the first seal). Copy-on-read like every scrape surface —
+        the report is immutable after seal, and only copies leave."""
+        if self._san is not None:
+            self._san.check_read("audit_snapshot")
+        if not self._audit_on_seal:
+            return {"enabled": False}
+        rep = self._audit_report
+        if rep is None:
+            return {"enabled": True, "sealed": False}
+        out = {
+            "enabled": True, "sealed": True,
+            "programs": len(list(rep["programs"])),
+            "skipped": len(list(rep["skipped"])),
+            "violations": [
+                {"program": v.program, "rule": v.rule,
+                 "message": v.message}
+                for v in list(rep["violations"])],
+        }
+        if rep.get("error"):
+            out["error"] = rep["error"]
+        return out
 
     def prefix_affinity_tokens(self, hashes: List[bytes]) -> int:
         """Read-only prefix-affinity probe for the multi-engine

@@ -52,6 +52,27 @@ from .paged_attention import (
 )
 
 
+# ---------------------------------------------------------------------------
+# ptaudit contract annotation (analysis/program_audit.py imports this):
+# the dtype widenings the decode-path kernels PROMISE — narrow streams
+# (bf16/f16 caches, int8/int4 payloads and weight groups) stay narrow
+# through HBM and widen only at these in-register sites. Any other
+# narrow->wide convert inside a compiled serving program is a DQ001
+# finding, because it silently re-widens the stream the bytes-per-token
+# models (kernelbench) price as narrow.
+# ---------------------------------------------------------------------------
+AUDIT_WIDEN_ALLOW = {
+    "bfloat16->float32": "attention gathers bf16 K/V rows and "
+                         "accumulates logits/softmax in f32 in-VMEM "
+                         "(never re-materialized wide to HBM)",
+    "float16->float32": "same softmax-accumulator discipline for f16 "
+                        "caches",
+    "int8->float32": "in-kernel dequant: int8 KV payloads / weight "
+                     "groups widen against their f32 scale rows only "
+                     "at the matmul/attention input",
+}
+
+
 def contiguous_chunk(max_len: int) -> int:
     """Streaming granularity over the [slots, max_len] cache rows:
     gcd(max_len, 128) — i.e. the largest power-of-two divisor of

@@ -9,31 +9,18 @@ copy-pasted per suite. Import from test modules as ``import
 serving_utils`` (pytest puts tests/ on sys.path).
 """
 
-import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.inference.serving import (
-    ContinuousBatchingEngine,
-    EngineConfig,
+from paddle_tpu.analysis.program_audit import (
+    tiny_engine_config,
+    tiny_model,  # noqa: F401  (re-export: suites import it from here)
 )
-from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
 
-
-def tiny_model(seed=0):
-    import paddle_tpu as pt
-
-    pt.seed(seed)
-    cfg = LlamaConfig.tiny()
-    return LlamaForCausalLM(cfg), cfg
-
-
-def tiny_ecfg(paged, **kw):
-    kw.setdefault("max_slots", 2)
-    kw.setdefault("max_len", 128)
-    kw.setdefault("seq_buckets", (32,))
-    kw.setdefault("cache_dtype", jnp.float32)
-    kw.setdefault("page_size", 8)
-    return EngineConfig(paged=paged, **kw)
+# the tiny model/engine factories live with the contract auditor
+# (analysis/program_audit.py) — ONE source of truth for the
+# CPU-friendly shapes both the audits and these suites trace at
+tiny_ecfg = tiny_engine_config
 
 
 def drain(eng, step=None):

@@ -183,6 +183,35 @@ def test_compact_line_healthy_result(tmp_path, monkeypatch):
     assert "error" not in parsed["extra"]["secondary"]["infer"]
 
 
+def test_compact_line_carries_audit_verdict(tmp_path, monkeypatch):
+    """The serve7b ptaudit verdict rides the ledger line (programs /
+    op_counts_ok / violations — compact, never the full report) and
+    is shed with the other secondary detail when the line must
+    shrink."""
+    import bench
+
+    monkeypatch.setattr(bench, "DETAILS_PATH",
+                        str(tmp_path / "BENCH_DETAILS.json"))
+    r = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+         "extra": {"platform": "tpu", "n_chips": 1, "secondary": {
+             "serve7b": {
+                 "metric": "serve7b_tokens_per_sec", "value": 100.0,
+                 "unit": "tokens/s", "vs_baseline": 1.0,
+                 "extra": {"audit": {
+                     "programs": 20, "op_counts_ok": True,
+                     "violations": 0, "rules": [],
+                     "wall_s": 3.2}}}}}}
+    row = json.loads(bench._compact_line(r))["extra"]["secondary"][
+        "serve7b"]
+    # compact triple only — rules/wall stay in BENCH_DETAILS.json
+    assert row["audit"] == {"programs": 20, "op_counts_ok": True,
+                            "violations": 0}
+    monkeypatch.setattr(bench, "MAX_LINE_BYTES", 200)
+    shed = json.loads(bench._compact_line(r))
+    sec = shed["extra"].get("secondary", {}).get("serve7b", {})
+    assert "audit" not in sec
+
+
 def test_compact_line_carries_flight_scalars(tmp_path, monkeypatch):
     """The serve7b flight-data summary rides the ledger line
     (burn_rate_peak / req_device_ms_p50 / alerts_fired, plus the

@@ -232,6 +232,58 @@ def test_obs002_fires_on_unregistered_alert_rule(tmp_repo):
     assert not [v for v in result.violations if v.rule == "OBS002"]
 
 
+def test_pa001_fires_on_uncontracted_program(tmp_repo):
+    """PA001: a TRACE_COUNTS program name with no PROGRAM_CONTRACTS
+    entry is a completeness violation (the OBS001 shape, applied to
+    the jaxpr contract auditor); contracted names pass. A partial
+    scan that never sees program_audit.py stays silent."""
+    ana = tmp_repo / "paddle_tpu" / "analysis"
+    ana.mkdir(parents=True)
+    (ana / "program_audit.py").write_text(
+        'PROGRAM_CONTRACTS = {"known": "a contracted program"}\n')
+    srv = tmp_repo / "paddle_tpu" / "inference" / "srv.py"
+    srv.write_text(
+        "import collections\n"
+        "TRACE_COUNTS = collections.Counter()\n"
+        "def a():\n"
+        '    TRACE_COUNTS["known"] += 1\n'
+        "def b():\n"
+        '    TRACE_COUNTS["mystery"] += 1\n')
+    result = lint.scan([str(tmp_repo / "paddle_tpu")], str(tmp_repo))
+    pa = [v for v in result.violations if v.rule == "PA001"]
+    assert len(pa) == 1, pa
+    assert "mystery" in pa[0].message
+    assert pa[0].file.endswith("srv.py")
+    # partial scan without the contract registry: silent, not noisy
+    result = lint.scan([str(srv)], str(tmp_repo))
+    assert not [v for v in result.violations if v.rule == "PA001"]
+
+
+def test_program_contract_registry_matches_runtime():
+    """The AST-level PROGRAM_CONTRACTS view PA001 checks against ==
+    the imported registry (the OBS001/FL001 runtime-twin contract) ==
+    the attribution registry's program names."""
+    import ast
+
+    from paddle_tpu.analysis.program_audit import PROGRAM_CONTRACTS
+    from paddle_tpu.analysis.rules import (
+        PA001ProgramContractCompleteness,
+    )
+    from paddle_tpu.observability.profiling import PROGRAM_LABELS
+
+    project = lint.Project(REPO)
+    rule = PA001ProgramContractCompleteness()
+    path = os.path.join(REPO, "paddle_tpu", "analysis",
+                        "program_audit.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    rule.check_module(project, tree, "",
+                      "paddle_tpu/analysis/program_audit.py")
+    assert project.saw_audit_module
+    assert project.program_contracts == set(PROGRAM_CONTRACTS)
+    assert project.program_contracts == set(PROGRAM_LABELS)
+
+
 def test_inline_suppression_and_skip_file(tmp_repo):
     bad = tmp_repo / "paddle_tpu" / "inference" / "bad.py"
     # the marker is assembled at runtime so scanning THIS test file

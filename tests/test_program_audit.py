@@ -1,0 +1,608 @@
+"""ptaudit — the jaxpr-level contract auditor (tier-1 gate).
+
+Four claims:
+
+1. **The repo's real serving program set audits clean** — every
+   contracted program, both cache modes x bf16/int8 arms, against the
+   committed ``.ptaudit-baseline.json`` (AL donation, DQ dtype
+   discipline, TX transfer bans, DD dead operands, SZ size pins).
+
+2. **Every rule family actually fires** — hand-built violating
+   programs (undonated pool write, unallowlisted upcast, io_callback
+   smuggled into a jit, dead input, passthrough output, budget bust)
+   each trip the named rule through the same ``audit_traced`` path
+   the engine auditor uses.
+
+3. **Audits are invisible to compile accounting** — audit-off is an
+   identity (``{"enabled": False}``, zero behavior change), audit-on
+   adds ZERO compiled programs (``compile_counter.assert_programs``)
+   and restores ``TRACE_COUNTS`` exactly.
+
+4. **The fixes the auditor forced stay fixed** — ``prefill_bucket``
+   donates its bucket cache (the missing-donation finding) and the
+   quantized engine ships no dead ``act_scale`` buffers (the
+   dead-input finding); both pinned structurally here.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import serving_utils
+from paddle_tpu import flags
+from paddle_tpu.analysis import program_audit as PA
+from paddle_tpu.analysis.program_audit import (
+    AUDIT_ARMS,
+    PROGRAM_CONTRACTS,
+    ProgramContract,
+    audit_traced,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the repo program set (audits cached once per session — tracing all
+# arms costs seconds, and every test below reads the same report)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="session")
+def repo_audit():
+    return PA.audit_repo()
+
+
+def test_contract_registry_matches_program_labels():
+    """Runtime twin of ptlint PA001: the contract registry covers
+    exactly the attribution registry (PROGRAM_LABELS, itself pinned
+    to TRACE_COUNTS by OBS001) — no uncontracted program, no stale
+    contract."""
+    from paddle_tpu.observability.profiling import PROGRAM_LABELS
+
+    assert set(PROGRAM_CONTRACTS) == set(PROGRAM_LABELS)
+    # ...and every contract has a probe: PA001 forces the contract,
+    # this pin forces the probe (a contracted-but-unprobeable program
+    # is a clean AuditError, but it must never get that far)
+    assert set(PA._PROBES) == set(PROGRAM_CONTRACTS)
+
+
+def test_repo_program_set_audits_clean(repo_audit):
+    assert not repo_audit["violations"], "\n".join(
+        f"  {v.arm}::{v.program}: {v.rule} {v.message}"
+        for v in repo_audit["violations"])
+    # every canonical arm audited, with the expected program counts
+    # (contig carries the prefix-store + legacy-insert programs,
+    # paged the scatter/copy ones, int8 drops the legacy prefill)
+    got = {a: sorted(r["programs"])
+           for a, r in repo_audit["arms"].items()}
+    assert set(got) == set(AUDIT_ARMS)
+    assert got["contig-bf16"] == [
+        "decode_chunk", "decode_step", "prefill_bucket",
+        "prefill_chunk", "prefill_insert", "prefix_insert",
+        "prefix_read", "spec_verify"]
+    assert got["paged-bf16"] == [
+        "decode_chunk", "decode_step", "page_copy", "prefill_bucket",
+        "prefill_chunk", "prefill_scatter", "spec_verify"]
+    assert got["paged-int8"] == [
+        "decode_chunk", "decode_step", "page_copy", "prefill_chunk",
+        "spec_verify"]
+    # int8 legacy-prefill skips carry their reason
+    assert "prefill_bucket" in repo_audit["arms"]["paged-int8"][
+        "skipped"]
+
+
+def test_committed_baseline_is_an_exact_pin(repo_audit):
+    """The committed baseline equals the current traces exactly —
+    op-count drift in EITHER direction shows up as a reviewable
+    baseline diff, never silently."""
+    baseline = PA.load_baseline(os.path.join(REPO, PA.BASELINE_NAME))
+    assert baseline == repo_audit["entries"]
+
+
+def test_pool_writers_donate_and_narrow_streams_stay_narrow(
+        repo_audit):
+    """The two headline promises, read off the report: every
+    pool-writing program's pool operand is donated in every arm, and
+    the int8 arm's only monitored widening is the allowlisted dequant
+    pair (int8->float32) — no hidden f32 re-widening of the streams
+    the bytes-per-token models price as narrow."""
+    for arm, r in repo_audit["arms"].items():
+        for name, entry in r["programs"].items():
+            want = sorted(PROGRAM_CONTRACTS[name].donate)
+            assert entry["donated"] == want, (arm, name, entry)
+    int8 = repo_audit["arms"]["paged-int8"]["programs"]
+    widen_pairs = {p for e in int8.values() for p in e["widen"]}
+    assert widen_pairs <= {"int8->float32"}
+    # and the dequant pair actually occurs (the check has teeth)
+    assert any(e["widen"].get("int8->float32") for e in int8.values())
+
+
+def test_prefill_bucket_donation_stays_fixed(repo_audit):
+    """Regression pin for ptaudit's first real finding: the legacy
+    per-bucket prefill used to fill its bucket cache WITHOUT donating
+    it (a full bucket-cache copy per legacy prefill)."""
+    for arm in ("contig-bf16", "paged-bf16"):
+        entry = repo_audit["arms"][arm]["programs"]["prefill_bucket"]
+        assert entry["donated"] == ["caches"], (arm, entry)
+
+
+def test_quantized_engine_ships_no_act_scale(repo_audit):
+    """Regression pin for ptaudit's dead-input finding: PTQ's
+    act_scale calibration buffers are unread by every weight-only
+    serving forward and used to ride each int8 program as dead args."""
+    eng = PA.build_audit_engine("paged-int8")
+    assert not [n for n in eng.buffers if n.endswith(".act_scale")]
+    # ...and the model tree still carries them for state_dict
+    assert [n for n, _ in eng.model.named_buffers()
+            if n.endswith(".act_scale")]
+    # no pb leaf is dead in the int8 report
+    for name, entry in repo_audit["arms"]["paged-int8"][
+            "programs"].items():
+        assert not [d for d in entry["dead"] if d.startswith("pb")], (
+            name, entry["dead"])
+
+
+# ---------------------------------------------------------------------------
+# rule families fire on hand-built violating programs
+# ---------------------------------------------------------------------------
+def _rules(viol):
+    return [v.rule for v in viol]
+
+
+def _audit(fn, args, contract, *, static=(), names=None,
+           baseline_entry=None, check_size=False):
+    return audit_traced(
+        "synthetic", fn, args, static,
+        names or tuple(f"a{i}" for i in range(len(args) - len(static))),
+        contract, arm="test", baseline_entry=baseline_entry,
+        check_size=check_size)
+
+
+def test_al001_fires_on_undonated_pool_write():
+    def fn(pool, x):
+        return pool.at[0].set(x), x.sum()
+
+    contract = ProgramContract(modes=("paged",), donate=("pool",))
+    args = (jnp.zeros((4, 2)), jnp.ones((2,)))
+    _entry, viol = _audit(jax.jit(fn), args, contract,
+                          names=("pool", "x"))
+    assert _rules(viol) == ["AL001"]
+    assert "pool" in viol[0].message
+    # donated -> clean
+    _entry, viol = _audit(jax.jit(fn, donate_argnums=(0,)), args,
+                          contract, names=("pool", "x"))
+    assert not viol
+
+
+def test_al002_fires_on_undeclared_donation():
+    def fn(pool, x):
+        return pool.at[0].set(x)
+
+    contract = ProgramContract(modes=("paged",))  # declares nothing
+    _entry, viol = _audit(
+        jax.jit(fn, donate_argnums=(0,)),
+        (jnp.zeros((4, 2)), jnp.ones((2,))), contract,
+        names=("pool", "x"))
+    assert _rules(viol) == ["AL002"]
+
+
+def test_dq001_fires_on_unallowlisted_upcast():
+    def fn(x):
+        return x.astype(jnp.float32) * 2.0
+
+    x = jnp.ones((4,), jnp.bfloat16)
+    _entry, viol = _audit(jax.jit(fn), (x,),
+                          ProgramContract(modes=("paged",)))
+    assert _rules(viol) == ["DQ001"]
+    assert "bfloat16->float32" in viol[0].message
+    # allowlisted -> clean, and the count lands in the entry
+    entry, viol = _audit(
+        jax.jit(fn), (x,),
+        ProgramContract(modes=("paged",),
+                        widen_allow={"bfloat16->float32": "test"}))
+    assert not viol
+    assert entry["widen"] == {"bfloat16->float32": 1}
+
+
+def test_dq001_sees_implicit_dot_accumulation():
+    """preferred_element_type lets a matmul widen bf16/int8 operands
+    straight into an f32 output with NO convert eqn — the auditor
+    must count that as the same monitored widening (a movement-
+    contract program gaining an f32-accum dot is a DQ001, not
+    invisible)."""
+    def fn(x, w):
+        return jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    args = (jnp.ones((2, 4), jnp.bfloat16), jnp.ones((4, 3),
+                                                     jnp.bfloat16))
+    entry, viol = _audit(jax.jit(fn), args,
+                         ProgramContract(modes=("paged",)),
+                         names=("x", "w"))
+    assert _rules(viol) == ["DQ001"]
+    assert entry["widen"] == {"bfloat16->float32": 1}
+    _entry, viol = _audit(
+        jax.jit(fn), args,
+        ProgramContract(modes=("paged",),
+                        widen_allow={"bfloat16->float32": "accum"}),
+        names=("x", "w"))
+    assert not viol
+
+
+def test_dq001_sees_int8_to_bf16_dequant():
+    """int8 -> bfloat16 is a widening too ("bfloat16" doesn't match
+    the float* name check — dequanting to the serving dtype is the
+    most natural regression and must not slip the monitor)."""
+    def fn(q):
+        return q.astype(jnp.bfloat16) * 2
+
+    entry, viol = _audit(jax.jit(fn), (jnp.ones((4,), jnp.int8),),
+                         ProgramContract(modes=("paged",)))
+    assert _rules(viol) == ["DQ001"]
+    assert entry["widen"] == {"int8->bfloat16": 1}
+
+
+def test_dq002_fires_on_widen_count_creep():
+    def fn(x):
+        return x.astype(jnp.float32) + x.astype(jnp.float32)[::-1]
+
+    contract = ProgramContract(
+        modes=("paged",), widen_allow={"bfloat16->float32": "test"})
+    x = jnp.ones((4,), jnp.bfloat16)
+    _entry, viol = _audit(
+        jax.jit(fn), (x,), contract,
+        baseline_entry={"eqns": 0, "widen": {"bfloat16->float32": 1}})
+    assert "DQ002" in _rules(viol)
+    assert "1 -> 2" in [v for v in viol if v.rule == "DQ002"][0].message
+    # exact pin: a SHRINK reports too — silent headroom would let a
+    # later upcast site creep back in under the old allowance
+    _entry, viol = _audit(
+        jax.jit(fn), (x,), contract,
+        baseline_entry={"eqns": 0, "widen": {"bfloat16->float32": 3}})
+    dq = [v for v in viol if v.rule == "DQ002"]
+    assert dq and "shrank 3 -> 2" in dq[0].message
+    # a pin whose pair vanished entirely (site + allowance removed
+    # together) is a stale-baseline finding, not a silent pass
+    def clean(x):
+        return x * 2
+
+    _entry, viol = _audit(
+        jax.jit(clean), (jnp.ones((4,), jnp.bfloat16),),
+        ProgramContract(modes=("paged",)), check_size=False,
+        baseline_entry={"eqns": 0, "widen": {"int8->float32": 2}})
+    dq = [v for v in viol if v.rule == "DQ002"]
+    assert dq and "stale pin" in dq[0].message
+
+
+def test_tx001_fires_on_io_callback_in_jit():
+    from jax.experimental import io_callback
+
+    def fn(x):
+        io_callback(lambda v: None, None, x)
+        return x + 1
+
+    _entry, viol = _audit(jax.jit(fn), (jnp.ones((2,)),),
+                          ProgramContract(modes=("paged",)))
+    assert _rules(viol) == ["TX001"]
+    assert "io_callback" in viol[0].message
+
+    # a callback can't hide inside a cond BRANCH (branch jaxprs live
+    # in a tuple param — the walker descends into those too)
+    def hidden(x):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda v: jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(v.shape, v.dtype), v),
+            lambda v: v * 2, x)
+
+    _entry, viol = _audit(jax.jit(hidden), (jnp.ones((2,)),),
+                          ProgramContract(modes=("paged",)))
+    assert _rules(viol) == ["TX001"]
+    assert "pure_callback" in viol[0].message
+
+
+def test_dd001_fires_on_dead_input():
+    def fn(x, unused):
+        return x * 2
+
+    args = (jnp.ones((2,)), jnp.ones((3,)))
+    _entry, viol = _audit(jax.jit(fn), args,
+                          ProgramContract(modes=("paged",)),
+                          names=("x", "unused"))
+    assert _rules(viol) == ["DD001"]
+    assert "unused" in viol[0].message
+    # allowlisted via dead_ok -> clean
+    _entry, viol = _audit(
+        jax.jit(fn), args,
+        ProgramContract(modes=("paged",), dead_ok=("unused",)),
+        names=("x", "unused"))
+    assert not viol
+
+
+def test_dd002_fires_on_passthrough_and_constant_outputs():
+    def fn(pool, x):
+        return pool, x + 1, jnp.int32(7)
+
+    _entry, viol = _audit(
+        jax.jit(fn), (jnp.zeros((3,)), jnp.ones((2,))),
+        ProgramContract(modes=("paged",)), names=("pool", "x"))
+    rules = _rules(viol)
+    assert rules.count("DD002") == 2, viol
+    msgs = " | ".join(v.message for v in viol)
+    assert "passes input 'pool'" in msgs and "constant" in msgs
+    # a pure passthrough is ALSO a dead input (nothing reads it)
+    assert rules.count("DD001") == 1
+    # contract allowances (passthrough_ok + dead_ok) -> only the
+    # constant output still fires
+    _entry, viol = _audit(
+        jax.jit(fn), (jnp.zeros((3,)), jnp.ones((2,))),
+        ProgramContract(modes=("paged",), passthrough_ok=("pool",),
+                        dead_ok=("pool",)),
+        names=("pool", "x"))
+    assert len(viol) == 1 and "constant" in viol[0].message
+
+
+def test_sz_rules_fire_on_budget_bust_and_missing_entry():
+    def fn(x):
+        return x * 2 + 1
+
+    contract = ProgramContract(modes=("paged",))
+    args = (jnp.ones((2,)),)
+    entry, viol = _audit(jax.jit(fn), args, contract,
+                         baseline_entry=None, check_size=True)
+    assert _rules(viol) == ["SZ002"]
+    # exact pin: growth AND shrinkage both report
+    _entry, viol = _audit(
+        jax.jit(fn), args, contract, check_size=True,
+        baseline_entry={"eqns": entry["eqns"] - 1, "widen": {}})
+    assert _rules(viol) == ["SZ001"] and "grew" in viol[0].message
+    _entry, viol = _audit(
+        jax.jit(fn), args, contract, check_size=True,
+        baseline_entry={"eqns": entry["eqns"] + 5, "widen": {}})
+    assert _rules(viol) == ["SZ001"] and "shrank" in viol[0].message
+    # matching pin -> clean
+    _entry, viol = _audit(
+        jax.jit(fn), args, contract, check_size=True,
+        baseline_entry={"eqns": entry["eqns"], "widen": {}})
+    assert not viol
+
+
+def test_audit_restores_trace_accounting():
+    """Tracing a real engine program bumps TRACE_COUNTS at trace time;
+    the auditor must put every count (and shape note) back."""
+    from paddle_tpu.inference import serving as S
+
+    eng = PA.build_audit_engine("contig-bf16")
+    before_counts = dict(S.TRACE_COUNTS)
+    before_shapes = dict(S.TRACE_SHAPES)
+    r = PA.audit_engine(eng, arm="probe")
+    assert r["programs"]  # it really traced
+    assert dict(S.TRACE_COUNTS) == before_counts
+    assert dict(S.TRACE_SHAPES) == before_shapes
+
+
+# ---------------------------------------------------------------------------
+# engine path: audit-off identity, audit-on-seal, zero new programs
+# ---------------------------------------------------------------------------
+def _run_tiny_workload(eng):
+    rng = np.random.default_rng(0)
+    reqs = eng.run([rng.integers(1, 64, 9), rng.integers(1, 64, 5)],
+                   max_new_tokens=6)
+    return [r.output for r in reqs]
+
+
+def test_audit_off_is_identity(compile_counter):
+    """Default flags: no audit object, audit_snapshot is the off
+    sentinel, seal_programs() stays cheap, and the workload compiles
+    exactly the usual chunked-prefill program set."""
+    assert flags.flag("audit_on_seal") is False
+    model, _cfg = serving_utils.tiny_model()
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    eng = ContinuousBatchingEngine(model, serving_utils.tiny_ecfg(True))
+    out_off = _run_tiny_workload(eng)
+    eng.seal_programs()
+    assert eng.audit_snapshot() == {"enabled": False}
+    assert eng.metrics_snapshot()["audit"] == {"enabled": False}
+    compile_counter.assert_programs(
+        {"prefill_chunk", "decode_chunk", "decode_step"})
+    assert out_off  # real tokens came out
+
+
+def test_audit_on_seal_zero_new_programs(compile_counter):
+    """audit_on_seal: the same workload, the same outputs, ZERO new
+    compiled programs from the audit (trace-only), TRACE_COUNTS
+    restored, and the verdict on metrics_snapshot()."""
+    from paddle_tpu.inference import serving as S
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    model, _cfg = serving_utils.tiny_model()
+    ref = ContinuousBatchingEngine(model, serving_utils.tiny_ecfg(True))
+    want = _run_tiny_workload(ref)
+
+    flags.set_flags({"audit_on_seal": True})
+    try:
+        eng = ContinuousBatchingEngine(model,
+                                       serving_utils.tiny_ecfg(True))
+        got = _run_tiny_workload(eng)
+        before = dict(S.TRACE_COUNTS)
+        eng.seal_programs()
+        assert dict(S.TRACE_COUNTS) == before
+        snap = eng.audit_snapshot()
+        assert snap["enabled"] and snap["sealed"]
+        assert snap["violations"] == []
+        # the full paged program set (f32 cache: legacy prefill legal)
+        assert snap["programs"] == 7 and snap["skipped"] == 3
+        assert eng.metrics_snapshot()["audit"] == snap
+    finally:
+        flags.set_flags({"audit_on_seal": False})
+    assert got == want
+    # across BOTH engines and the seal-audit: only the usual programs
+    compile_counter.assert_programs(
+        {"prefill_chunk", "decode_chunk", "decode_step"})
+
+
+def test_audit_on_seal_survives_legacy_prefill_engine():
+    """Regression: a PT_FLAGS_prefill_chunk=0 engine has no [slots,C]
+    program to trace — the seal-time self-audit must SKIP it with a
+    reason, not crash the seal call."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    model, _cfg = serving_utils.tiny_model()
+    flags.set_flags({"audit_on_seal": True, "prefill_chunk": 0})
+    try:
+        eng = ContinuousBatchingEngine(model,
+                                       serving_utils.tiny_ecfg(False))
+        eng.seal_programs()
+        snap = eng.audit_snapshot()
+        assert snap["sealed"] and snap["violations"] == []
+        reason = eng._audit_report["skipped"]["prefill_chunk"]
+        assert "prefill_chunk=0" in reason
+    finally:
+        flags.set_flags({
+            "audit_on_seal": False,
+            "prefill_chunk":
+                flags.registry()["prefill_chunk"]["default"]})
+
+
+def test_audit_on_seal_never_raises(monkeypatch):
+    """A broken probe (signature drift a later PR forgot to mirror)
+    must surface as an error VERDICT on the snapshot, never crash the
+    production seal call — the recompile watchdog's 'never raises'
+    contract applies to the self-audit too."""
+    from paddle_tpu.analysis import program_audit as mod
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    model, _cfg = serving_utils.tiny_model()
+    flags.set_flags({"audit_on_seal": True})
+    try:
+        eng = ContinuousBatchingEngine(model,
+                                       serving_utils.tiny_ecfg(False))
+
+        def broken(engine):
+            raise mod.AuditError("probe drift")
+
+        monkeypatch.setitem(mod._PROBES, "decode_step", broken)
+        eng.seal_programs()  # must not raise
+        snap = eng.audit_snapshot()
+        assert snap["sealed"] and "probe drift" in snap["error"]
+        assert snap["programs"] == 0 and snap["violations"] == []
+    finally:
+        flags.set_flags({"audit_on_seal": False})
+
+
+def test_audit_on_seal_before_seal_reports_unsealed():
+    flags.set_flags({"audit_on_seal": True})
+    try:
+        eng = PA.build_audit_engine("contig-bf16")
+        assert eng.audit_snapshot() == {"enabled": True,
+                                        "sealed": False}
+    finally:
+        flags.set_flags({"audit_on_seal": False})
+
+
+# ---------------------------------------------------------------------------
+# CLI: audit + combined check
+# ---------------------------------------------------------------------------
+def test_audit_cli_rules_and_json(tmp_path, capsys):
+    rc = PA.main(["--rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for rid in ("AL001", "DQ001", "TX001", "DD001", "SZ001"):
+        assert rid in out
+    rc = PA.main(["--arms", "paged-bf16", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["violations"] == []
+    assert "decode_step" in doc["arms"]["paged-bf16"]["programs"]
+    # unknown arm is a usage error, not a vacuously clean audit
+    assert PA.main(["--arms", "nope"]) == 2
+
+
+def test_audit_cli_write_baseline_round_trip(tmp_path, capsys):
+    path = tmp_path / "base.json"
+    rc = PA.main(["--arms", "paged-bf16", "--write-baseline",
+                  "--baseline", str(path)])
+    assert rc == 0
+    data = json.loads(path.read_text())
+    assert "paged-bf16::decode_step" in data["entries"]
+    rc = PA.main(["--arms", "paged-bf16", "--baseline", str(path)])
+    assert rc == 0
+    # re-writing PRUNES stale pins within the audited arms (a deleted
+    # program's entry must not ambush a future re-add) while keeping
+    # other arms' pins untouched
+    data = json.loads(path.read_text())
+    data["entries"]["paged-bf16::retired_program"] = {
+        "eqns": 1, "widen": {}}
+    data["entries"]["contig-bf16::decode_step"] = {
+        "eqns": 7, "widen": {}}
+    path.write_text(json.dumps(data))
+    rc = PA.main(["--arms", "paged-bf16", "--write-baseline",
+                  "--baseline", str(path)])
+    assert rc == 0
+    entries = json.loads(path.read_text())["entries"]
+    assert "paged-bf16::retired_program" not in entries
+    assert entries["contig-bf16::decode_step"] == {"eqns": 7,
+                                                   "widen": {}}
+    # a bust against a doctored pin exits 1 and names SZ001
+    data["entries"]["paged-bf16::decode_step"]["eqns"] -= 1
+    path.write_text(json.dumps(data))
+    rc = PA.main(["--arms", "paged-bf16", "--baseline", str(path)])
+    assert rc == 1
+    assert "SZ001" in capsys.readouterr().out
+    # malformed baseline is a loud usage error on the READ path, and
+    # the write path (the documented recovery command) replaces it
+    # with a warning instead of dying on the corruption it fixes
+    path.write_text("{not json")
+    assert PA.main(["--arms", "paged-bf16",
+                    "--baseline", str(path)]) == 2
+    rc = PA.main(["--arms", "paged-bf16", "--write-baseline",
+                  "--baseline", str(path)])
+    err = capsys.readouterr().err
+    assert rc == 0 and "replacing malformed baseline" in err
+    assert "paged-bf16::decode_step" in json.loads(
+        path.read_text())["entries"]
+
+
+def test_write_baseline_cannot_accept_structural_violations(
+        tmp_path, capsys, monkeypatch):
+    """--write-baseline re-pins sizes; an AL/DQ001/TX/DD violation the
+    same audit found must still print and fail the command — a
+    baseline write is not a waiver."""
+    from paddle_tpu.analysis.program_audit import AuditViolation
+
+    real = PA.audit_repo
+
+    def with_structural(*a, **kw):
+        rep = real(*a, **kw)
+        rep["violations"].append(AuditViolation(
+            "paged-bf16", "decode_step", "AL001", "synthetic"))
+        return rep
+
+    monkeypatch.setattr(PA, "audit_repo", with_structural)
+    path = tmp_path / "base.json"
+    rc = PA.main(["--arms", "paged-bf16", "--write-baseline",
+                  "--baseline", str(path)])
+    cap = capsys.readouterr()
+    assert rc == 1
+    assert "AL001" in cap.out and "cannot accept" in cap.err
+    # the size pins still landed (the write half did its job)
+    assert "paged-bf16::decode_step" in json.loads(
+        path.read_text())["entries"]
+
+
+def test_check_cli_runs_both_gates(capsys):
+    from paddle_tpu.analysis import check
+
+    rc = check.main(["--arms", "paged-bf16", "--json"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0, doc
+    assert doc["lint"]["violations"] == []
+    assert doc["audit"]["violations"] == []
+    assert any(p.startswith("paged-bf16::")
+               for p in doc["audit"]["programs"])
