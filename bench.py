@@ -563,6 +563,31 @@ def _compact_line(result):
                     k: fl.get(k) for k in
                     ("burn_rate_peak", "req_device_ms_p50",
                      "alerts_fired")}
+            # scheduler A/B scalars (serve7b): FIFO-vs-SLO-fair
+            # goodput at the saturated burst plus the starvation
+            # adversary's worst-small-tenant TTFT bound — the numbers
+            # that rank admission policies on the ledger
+            sa = (r.get("extra") or {}).get("sched_ab") or {}
+            if sa:
+                row["sched_ab"] = {
+                    "fifo_goodput": (sa.get("fifo") or {}).get(
+                        "goodput"),
+                    "slo_fair_goodput": (sa.get("slo_fair") or {})
+                    .get("goodput"),
+                    "preemptions": (sa.get("slo_fair") or {}).get(
+                        "preemptions"),
+                    "starve_bound_x": (sa.get("starvation") or {})
+                    .get("bound_factor"),
+                }
+            # HTTP front-door overhead (serve7b): server-path tok/s
+            # beside the library path — the wire tax, measured over a
+            # real loopback socket
+            hf = (r.get("extra") or {}).get("http_front_door") or {}
+            if hf:
+                row["http_front_door"] = {
+                    k: hf.get(k) for k in
+                    ("library_tokens_per_sec", "http_tokens_per_sec",
+                     "overhead_pct")}
             # quantized-serving scalars (serve7b): the MODELED compound
             # ×-factor names the expected win on the ledger before the
             # TPU window, and outputs_match/first_divergence carry the
@@ -619,6 +644,8 @@ def _compact_line(result):
             row.pop("error", None)
             row.pop("goodput", None)
             row.pop("flight", None)
+            row.pop("sched_ab", None)
+            row.pop("http_front_door", None)
             row.pop("quant", None)
             row.pop("replica_failover", None)
             row.pop("audit", None)

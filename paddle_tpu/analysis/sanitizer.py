@@ -62,6 +62,10 @@ SAFE_READS = frozenset({
     # seal-time contract-audit verdict (ptaudit): the report is
     # immutable after seal_programs(); the snapshot copies it
     "audit_snapshot",
+    # multi-tenant accounting (serving front door): cumulative tenant
+    # counters + live slot/page usage, copied per-call; pages_of reads
+    # share _tel_state's staleness contract
+    "tenant_snapshot",
 })
 
 
@@ -200,7 +204,9 @@ class EngineSanitizer:
                 owners[p] = owners.get(p, 0) + 1
         store = engine._prefix
         if engine.cfg.paged and store is not None:
-            for p in list(getattr(store, "_blocks", {}).values()):
+            # entries are (page id, namespace) — the retain is on the
+            # page regardless of which tenant published it
+            for p, _ns in list(getattr(store, "_blocks", {}).values()):
                 owners[p] = owners.get(p, 0) + 1
         for p, n in sorted(owners.items()):
             if pool.ref.get(p, 0) != n:

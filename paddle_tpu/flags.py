@@ -320,6 +320,42 @@ define_flag("slo_degradation", False,
             "backs up — never the fault jump. Requires timeseries + "
             "alerts on to have any effect; off (default) leaves the "
             "ladder's inputs untouched (outputs pinned identical)")
+define_flag("tenant_prefix_namespace", True,
+            "multi-tenant prefix-cache isolation: tenant-tagged "
+            "requests hash their prompt blocks under a per-tenant "
+            "namespace seed, so tenants can neither probe for nor "
+            "borrow each other's cached KV, and pool-pressure "
+            "eviction spends the requesting tenant's own cold "
+            "entries first. Untagged requests (tenant=None) always "
+            "share the default chain — single-tenant traffic is "
+            "bit-identical either way. off = all tenants share one "
+            "namespace (maximum reuse, zero isolation)")
+define_flag("sched_policy", "fifo",
+            "serving front door's default admission scheduler when "
+            "none is passed to start_api_server: fifo = the engine's "
+            "native submission-order admission; slo_fair = "
+            "serving_api.SLOFairScheduler (per-tenant weighted fair "
+            "share + TTFT-deadline urgency decide admission order, "
+            "chunk split and preemption). An explicit scheduler= "
+            "argument always wins")
+define_flag("api_max_tenants", 256,
+            "serving front door: maximum DISTINCT tenant ids accepted "
+            "over the server's lifetime — tenant strings are "
+            "client-controlled and each unique value mints permanent "
+            "per-tenant metric series, accounting buckets and "
+            "fair-share ledger entries, so unbounded cardinality is a "
+            "memory/scrape DoS; past the cap, requests carrying a NEW "
+            "tenant are rejected with HTTP 429 (known tenants and "
+            "untagged requests always pass; 0 rejects every "
+            "tenant-tagged API request)")
+define_flag("sched_preempt", True,
+            "allow the SLO-fair scheduler to PREEMPT an active "
+            "batch-class slot (release slot/pages, re-queue with "
+            "history for deterministic replay through the existing "
+            "prefill program — zero new compiled programs) when an "
+            "interactive request is about to miss its TTFT target "
+            "and no slot is free; bounded per request. off = "
+            "admission reordering and quotas only")
 define_flag("recompile_warmup_ticks", 64,
             "scheduler ticks before the recompile watchdog auto-seals "
             "the program set (warmup compiles are expected; "

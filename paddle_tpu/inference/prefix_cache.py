@@ -33,20 +33,27 @@ from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 _SEED = b"pt-prefix-v1"
 
 
-def block_hashes(prompt: np.ndarray, block: int) -> List[bytes]:
+def block_hashes(prompt: np.ndarray, block: int,
+                 namespace: str = "") -> List[bytes]:
     """Chained digests of the prompt's FULL token blocks (the rolling
     hash): ``h_i = H(h_{i-1} || tokens[i*B:(i+1)*B])``. The partial
-    tail block is never hashed — prefixes are block-aligned."""
+    tail block is never hashed — prefixes are block-aligned.
+
+    ``namespace`` seeds the chain (multi-tenant isolation): two tenants
+    submitting the SAME system prompt get disjoint digest chains, so
+    neither can probe for — or borrow — the other's cached KV. The
+    default empty namespace reproduces the un-namespaced chain bit for
+    bit (single-tenant traffic is unchanged)."""
     toks = np.ascontiguousarray(np.asarray(prompt).reshape(-1), np.int64)
     out: List[bytes] = []
-    prev = _SEED
+    prev = _SEED + namespace.encode() if namespace else _SEED
     for i in range(toks.size // block):
         h = hashlib.blake2b(
             prev + toks[i * block:(i + 1) * block].tobytes(),
@@ -57,11 +64,19 @@ def block_hashes(prompt: np.ndarray, block: int) -> List[bytes]:
 
 
 class PagedPrefixStore:
-    """digest → page id, refcount-pinned in the engine's PagePool."""
+    """digest → page id, refcount-pinned in the engine's PagePool.
+
+    Entries remember the NAMESPACE (tenant) that published them, so
+    pool-pressure eviction can spend a tenant's own cold entries first
+    (``evict(prefer_ns=...)``) — one tenant's eviction storm drains its
+    own namespace before it can touch another tenant's shared system
+    prompt."""
 
     def __init__(self):
-        # LRU order == dict order: least-recent first
-        self._blocks: "OrderedDict[bytes, int]" = OrderedDict()
+        # LRU order == dict order: least-recent first.
+        # digest -> (page id, namespace)
+        self._blocks: "OrderedDict[bytes, Tuple[int, str]]" = \
+            OrderedDict()
         self.evictions = 0
 
     def __len__(self) -> int:
@@ -79,11 +94,11 @@ class PagedPrefixStore:
         (LRU-refreshed)."""
         pages = []
         for h in hashes:
-            page = self._blocks.get(h)
-            if page is None:
+            ent = self._blocks.get(h)
+            if ent is None:
                 break
             self._blocks.move_to_end(h)
-            pages.append(page)
+            pages.append(ent[0])
         return pages
 
     def match_len(self, hashes: List[bytes]) -> int:
@@ -99,14 +114,16 @@ class PagedPrefixStore:
             n += 1
         return n
 
-    def insert(self, digest: bytes, page: int, pool) -> bool:
+    def insert(self, digest: bytes, page: int, pool,
+               ns: str = "") -> bool:
         """Pin ``page`` under ``digest`` (no-op if already cached —
-        the original stays authoritative)."""
+        the original stays authoritative). ``ns`` records the
+        publishing namespace for eviction preference."""
         if digest in self._blocks:
             self._blocks.move_to_end(digest)
             return False
         pool.retain(page)
-        self._blocks[digest] = page
+        self._blocks[digest] = (page, ns)
         return True
 
     def evictable_pages(self, pool, exclude=()) -> int:
@@ -114,25 +131,38 @@ class PagedPrefixStore:
         nothing but the store owns, minus ``exclude`` (pages the
         caller is about to adopt, which would pin them)."""
         ex = set(exclude)
-        return sum(1 for p in self._blocks.values()
+        return sum(1 for p, _ns in self._blocks.values()
                    if p not in ex and pool.ref.get(p, 0) == 1)
 
-    def evict(self, pool, n_pages: int) -> int:
+    def evict(self, pool, n_pages: int,
+              prefer_ns: Optional[str] = None) -> int:
         """Free up to ``n_pages`` pages, LRU-first, skipping entries a
         live slot is still borrowing (page refcount > 1). Evicting a
         chain-interior block strands its (unreachable) children until
         their own LRU turn — correctness is unaffected, lookups just
-        stop at the gap."""
+        stop at the gap.
+
+        ``prefer_ns``: spend THAT namespace's cold entries first (the
+        requesting tenant paying for its own pressure); only when its
+        namespace can't cover the shortfall does eviction fall back to
+        global LRU over the rest."""
         freed = 0
-        for digest, page in list(self._blocks.items()):
+        passes = ([prefer_ns, None] if prefer_ns is not None
+                  else [None])
+        for want_ns in passes:
             if freed >= n_pages:
                 break
-            if pool.ref.get(page, 0) != 1:
-                continue  # borrowed by an active slot
-            del self._blocks[digest]
-            pool.release(page)
-            self.evictions += 1
-            freed += 1
+            for digest, (page, ns) in list(self._blocks.items()):
+                if freed >= n_pages:
+                    break
+                if want_ns is not None and ns != want_ns:
+                    continue
+                if pool.ref.get(page, 0) != 1:
+                    continue  # borrowed by an active slot
+                del self._blocks[digest]
+                pool.release(page)
+                self.evictions += 1
+                freed += 1
         return freed
 
 
@@ -141,7 +171,7 @@ class ContigPrefixStore:
 
     def __init__(self, max_blocks: int):
         self.max_blocks = max(int(max_blocks), 0)
-        # digest -> (k, v); k/v: [n_layers, block, kvh, d].
+        # digest -> (k, v, ns); k/v: [n_layers, block, kvh, d].
         # LRU order == dict order: least-recent first.
         self._blocks: "OrderedDict[bytes, Tuple]" = OrderedDict()
         self.evictions = 0
@@ -163,7 +193,7 @@ class ContigPrefixStore:
             if ent is None:
                 break
             self._blocks.move_to_end(h)
-            out.append(ent)
+            out.append(ent[:2])
         return out
 
     def match_len(self, hashes: List[bytes]) -> int:
@@ -175,14 +205,33 @@ class ContigPrefixStore:
             n += 1
         return n
 
-    def insert(self, digest: bytes, k, v) -> bool:
+    def insert(self, digest: bytes, k, v, ns: str = "",
+               protect=()) -> bool:
+        """``protect``: digests of the chain currently being inserted —
+        eviction must not cannibalize the chain's own earlier blocks
+        (evicting block 0 to make room for block 1 would leave a gap
+        every later lookup stops at)."""
         if self.max_blocks == 0:
             return False
         if digest in self._blocks:
             self._blocks.move_to_end(digest)
             return False
+        keep = set(protect)
         while len(self._blocks) >= self.max_blocks:
-            self._blocks.popitem(last=False)
+            # the inserting namespace's own cold entries go first —
+            # a tenant filling the store evicts itself before it can
+            # flush a neighbor's cached system prompt; fall back to
+            # global LRU, then (degenerate: everything protected) to
+            # the raw LRU head
+            victim = next(
+                (h for h, ent in self._blocks.items()
+                 if ent[2] == ns and h not in keep), None)
+            if victim is None:
+                victim = next(
+                    (h for h in self._blocks if h not in keep), None)
+            if victim is None:
+                victim = next(iter(self._blocks))
+            del self._blocks[victim]
             self.evictions += 1
-        self._blocks[digest] = (k, v)
+        self._blocks[digest] = (k, v, ns)
         return True

@@ -91,6 +91,7 @@ from .serving import (
     build_request,
     new_slo_bucket,
     request_ledger,
+    request_namespace,
 )
 
 # breaker states (also the pt_router_breaker_state gauge encoding)
@@ -387,7 +388,13 @@ class EngineRouter:
             ids = (np.concatenate([req.prompt,
                                    np.asarray(req.output, np.int64)])
                    if req.output else req.prompt)
-            req._hashes = block_hashes(ids, self.cfg.page_size)
+            # the TENANT-aware chain (request_namespace): affinity must
+            # hash exactly like the target engine's admission match,
+            # or it would steer traffic at pages the replica can never
+            # share across the namespace boundary
+            req._hashes = block_hashes(
+                ids, self.cfg.page_size,
+                namespace=request_namespace(req))
         return req._hashes
 
     def _routable(self, rep: _Replica, bp: dict) -> bool:
@@ -1013,6 +1020,41 @@ class EngineRouter:
         return {"classes": classes, "met": met, "violated": violated,
                 "goodput": met / tracked if tracked else None}
 
+    def tenant_snapshot(self) -> dict:
+        """FLEET-level per-tenant accounting: every replica's
+        ``tenant_snapshot`` merged key-by-key (counts sum; the
+        scheduler sub-doc reports each replica's policy). Same
+        copy-on-read contract as the engine reader."""
+        if self._san is not None:
+            self._san.check_read("tenant_snapshot")
+        tenants: Dict[str, Dict[str, float]] = {}
+        preemptions = 0
+        policies = []
+        for rep in list(self._replicas):
+            snap = rep.engine.tenant_snapshot()
+            sched = snap.get("scheduler") or {}
+            policies.append(sched.get("policy"))
+            preemptions += int(sched.get("preemptions", 0) or 0)
+            for key, st in list(snap["tenants"].items()):
+                agg = tenants.setdefault(key, {})
+                for k, v in list(st.items()):
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+        # router-held requests count as queued against their tenant
+        for req in list(self._queue):
+            agg = tenants.setdefault(req.tenant or "-", {})
+            agg["queued"] = agg.get("queued", 0) + 1
+        return {
+            "tenants": tenants,
+            "scheduler": {
+                "policy": (policies[0] if policies
+                           and all(p == policies[0]
+                                   for p in policies)
+                           else policies),
+                "preemptions": preemptions,
+            },
+        }
+
     def fleet_chrome_trace(self) -> dict:
         """ONE merged Perfetto-loadable trace for the whole fleet:
         the router's route/failover/breaker event stream plus every
@@ -1034,6 +1076,7 @@ class EngineRouter:
                 else self._tel.snapshot())
         snap["fleet"] = self.fleet_snapshot()
         snap["slo"] = self.slo_snapshot()
+        snap["tenants"] = self.tenant_snapshot()
         snap["replicas"] = [rep.engine.metrics_snapshot()
                             for rep in list(self._replicas)]
         return snap

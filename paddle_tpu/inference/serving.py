@@ -247,6 +247,11 @@ class Request:
     deadline_ms: Optional[float] = None
     # per-request replay-retry bound (None = EngineConfig.max_retries)
     max_retries: Optional[int] = None
+    # multi-tenant identity (None = the anonymous shared tenant "-"):
+    # drives the SLO-fair scheduler's weighted fair share + quotas,
+    # the per-tenant prefix-cache namespace, and the tenant label on
+    # serve metrics — never the compiled programs (pure host policy)
+    tenant: Optional[str] = None
     # SLO class + targets (None = untracked); tpot_ms is the
     # per-request mean decode latency, computed once at finish
     slo: Optional[str] = None
@@ -294,6 +299,7 @@ def build_request(rid: int, prompt, max_new_tokens: int = 32,
                   top_k: Optional[int] = None,
                   top_p: Optional[float] = None,
                   greedy: Optional[bool] = None,
+                  tenant: Optional[str] = None,
                   slo: Optional[str] = None,
                   ttft_target_ms: Optional[float] = None,
                   tpot_target_ms: Optional[float] = None,
@@ -321,6 +327,20 @@ def build_request(rid: int, prompt, max_new_tokens: int = 32,
         raise ValueError(f"top_k must be >= 0; got {top_k}")
     if top_p is not None and not 0 < top_p <= 1:
         raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+    if tenant is not None:
+        if not isinstance(tenant, str) or not tenant \
+                or len(tenant) > 64 \
+                or any(c.isspace() or not c.isprintable()
+                       for c in tenant):
+            # the tenant string becomes a metric label, a prefix-cache
+            # hash namespace and a scheduler dict key — reject shapes
+            # that could mangle any of the three
+            raise ValueError(
+                "tenant must be a non-empty printable string without "
+                f"whitespace, at most 64 chars; got {tenant!r}")
+        if tenant == "-":
+            raise ValueError(
+                'tenant "-" is reserved for untagged requests')
     if slo is None and (ttft_target_ms is not None
                         or tpot_target_ms is not None):
         slo = "custom"  # explicit targets are an SLO by themselves
@@ -365,7 +385,7 @@ def build_request(rid: int, prompt, max_new_tokens: int = 32,
             f"{max_retries!r}")
     req = Request(rid, prompt, max_new_tokens, eos_token_id,
                   temperature=temperature, top_k=top_k, top_p=top_p,
-                  greedy=greedy, slo=slo,
+                  greedy=greedy, tenant=tenant, slo=slo,
                   ttft_target_ms=ttft_target_ms,
                   tpot_target_ms=tpot_target_ms,
                   deadline_ms=deadline_ms, max_retries=max_retries,
@@ -373,6 +393,18 @@ def build_request(rid: int, prompt, max_new_tokens: int = 32,
     if deadline_ms is not None:
         req._deadline_t = req._submit_t + deadline_ms / 1e3
     return req
+
+
+def request_namespace(req: Request) -> str:
+    """The request's prefix-cache hash namespace: its tenant when
+    tenant isolation is on (``PT_FLAGS_tenant_prefix_namespace``),
+    else the shared default chain. ONE function for the engine's
+    admission match and the router's affinity probe — the two must
+    hash identically or affinity would steer traffic at pages the
+    replica can never share."""
+    if req.tenant and bool(flags.flag("tenant_prefix_namespace")):
+        return req.tenant
+    return ""
 
 
 def request_ledger(req: Request) -> dict:
@@ -394,6 +426,7 @@ def request_ledger(req: Request) -> dict:
         "top_k": req.top_k,
         "top_p": req.top_p,
         "greedy": req.greedy,
+        "tenant": req.tenant,
         "slo": req.slo,
         "ttft_target_ms": req.ttft_target_ms,
         "tpot_target_ms": req.tpot_target_ms,
@@ -658,11 +691,29 @@ class ContinuousBatchingEngine:
         # with telemetry off, like prefix_stats/spec_stats): class ->
         # met/violated/target-miss/token counts, written at finish
         self.slo_stats: Dict[str, Dict[str, int]] = {}
+        # ---- SLO-aware multi-tenant scheduler seam ----
+        # optional host-side admission policy (serving_api.scheduler.
+        # SLOFairScheduler is the shipped one; None = FIFO, today's
+        # exact behavior). Pure policy: zero new compiled programs —
+        # it only reorders which queued request claims a slot, caps
+        # per-slot chunk budgets, and may preempt (see set_scheduler)
+        self._sched = None
+        self.sched_stats = {"policy": "fifo", "preemptions": 0}
+        # tenant -> cumulative host counters (telemetry-off-safe,
+        # like slo_stats); written at finish/preempt on the
+        # scheduler thread, read via tenant_snapshot()
+        self.tenant_stats: Dict[str, Dict[str, float]] = {}
         # set by the admission paths when the head request is blocked
         # on KV-pool pages (slots free, pool exhausted) — the PAGED
         # engine's dominant saturation mode, which a free-slot count
-        # alone cannot see; read by backpressure()/healthz
+        # alone cannot see; read by backpressure()/healthz.
+        # _pool_blocked_prev holds the PREVIOUS admission pass's
+        # verdict (the live flag resets at each pass's start) — the
+        # scheduler policy's preemption window reads it, because
+        # "slots free but no pages" is exactly the saturation mode
+        # where preempting a page-holding victim helps
         self._pool_blocked = False
+        self._pool_blocked_prev = False
 
         # telemetry (None when PT_FLAGS_telemetry=off → scheduling loop
         # pays a single identity check per hook site)
@@ -865,6 +916,99 @@ class ContinuousBatchingEngine:
 
         return mesh_context(self.mesh)
 
+    # ---------------- scheduler policy seam ----------------
+    def set_scheduler(self, policy):
+        """Install (or clear, with ``None``) the admission scheduler
+        policy — the SLO-aware multi-tenant scheduler's seam into the
+        engine. The policy is consulted on the SCHEDULER THREAD only,
+        at three points:
+
+        * ``pick(engine, candidates)`` — admission ORDER: choose the
+          next queued request to claim a slot (replaces FIFO).
+        * ``before_admission(engine)`` — the preemption window before
+          each admission wave; may call ``engine.preempt(slot)`` and
+          returns the preempted rids (excluded from this wave).
+        * ``slot_caps(engine)`` — per-slot decode-token caps applied
+          to each chunk's budget vector (``None`` = uncapped).
+        * ``note_admit(engine, req)`` — fair-share accounting hook,
+          called when a pick's claim commits.
+
+        Pure host-side policy: the compiled program set is untouched
+        (pinned by the compile-counter guards) and greedy outputs are
+        per-request bit-identical under any admission order. Policy
+        rides the CHUNKED admission path only — the legacy bucketed
+        prefill (``PT_FLAGS_prefill_chunk=0``) stays FIFO, like the
+        prefix cache."""
+        self._sched = policy
+        self.sched_stats["policy"] = (
+            "fifo" if policy is None
+            else getattr(policy, "name", type(policy).__name__))
+
+    def _pick_admission(self, skip, fifo_cursor):
+        """Admission-order seam: the next queued request to TRY (a
+        peek — removal happens only when its slot/page claim commits),
+        or None to stop this wave. ``skip`` holds rids already
+        deferred OR committed this wave (shed batch / draining /
+        preempted / claimed). Default FIFO rides ``fifo_cursor`` — a
+        wave-local ``[snapshot, index]`` pair, ONE queue copy per
+        wave with a monotone index (a deep shed/drain wave must stay
+        O(queue), not O(queue²)). With a policy: the policy re-ranks
+        a fresh snapshot per pick (usage/urgency move as the wave
+        claims slots)."""
+        if self._sched is None:
+            if not skip:
+                # pure-FIFO fast path: head peek, O(1) — the
+                # snapshot is not even taken until something defers
+                return self._queue[0] if self._queue else None
+            cands, i = fifo_cursor
+            if cands is None:
+                cands = fifo_cursor[0] = list(self._queue)
+            while i < len(cands) and cands[i].rid in skip:
+                i += 1
+            fifo_cursor[1] = i
+            return cands[i] if i < len(cands) else None
+        cands = [r for r in list(self._queue) if r.rid not in skip]
+        if not cands:
+            return None
+        return self._sched.pick(self, cands)
+
+    def preempt(self, slot: int) -> bool:
+        """Preempt the ACTIVE request in ``slot``: release its
+        slot/KV pages/prefix refs through the one teardown path and
+        re-queue it at the FRONT with its generated history intact.
+        Re-admission replays prompt+history through the existing
+        ``[slots, C]`` chunked prefill program — the crash-recovery
+        path — so greedy outputs stay bit-identical and ZERO new
+        programs compile. TTFT/admit instants and attributed cost are
+        preserved (the request is the same object); the price is the
+        replay's prefill recompute, which the scheduler policy must
+        weigh (and bound) before calling.
+
+        Scheduler-thread only, same contract as ``cancel``: an
+        in-flight chunk's writes to the freed pages are stream-ordered
+        before any successor's prefill writes, and the host loop
+        discards the preempted slot's remaining chunk tokens via the
+        ``active`` mask."""
+        req = self._slot_req.get(slot)
+        if req is None:
+            return False
+        self._release_slot(slot)
+        req.slot = None
+        # replay ids grow by the generated history: stale digests
+        # (hashed at admission) no longer cover them
+        req._hashes = None
+        self._queue.appendleft(req)
+        self.sched_stats["preemptions"] += 1
+        self._tenant_bucket(req.tenant)["preemptions"] += 1
+        if self._tel is not None:
+            self._tel.on_preempt()
+        tr = self._tracer
+        if tr is not None and tr.want_request(req.rid):
+            tr.request(req.rid, "preempt", slot=slot,
+                       tokens=len(req.output),
+                       tenant=req.tenant or "-")
+        return True
+
     # ---------------- request lifecycle ----------------
     def add_request(self, prompt, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
@@ -872,6 +1016,7 @@ class ContinuousBatchingEngine:
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
                     greedy: Optional[bool] = None,
+                    tenant: Optional[str] = None,
                     slo: Optional[str] = None,
                     ttft_target_ms: Optional[float] = None,
                     tpot_target_ms: Optional[float] = None,
@@ -886,6 +1031,13 @@ class ContinuousBatchingEngine:
         compiled trace). Sampling requests never draft for speculative
         decoding — greedy acceptance needs an argmax chain to verify
         against.
+
+        ``tenant``: multi-tenant identity (non-empty printable
+        string, no whitespace, ≤64 chars; ``None`` = untagged). Drives
+        the SLO-fair scheduler's weighted fair share and quotas, the
+        per-tenant prefix-cache namespace
+        (``PT_FLAGS_tenant_prefix_namespace``) and the tenant label on
+        serve metrics — never the compiled programs.
 
         ``slo``: latency class (``"interactive"`` | ``"batch"``) whose
         TTFT / per-request-TPOT targets (``SLO_CLASSES``, overridable
@@ -910,7 +1062,8 @@ class ContinuousBatchingEngine:
         req = build_request(
             0, prompt, max_new_tokens, eos_token_id,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            greedy=greedy, slo=slo, ttft_target_ms=ttft_target_ms,
+            greedy=greedy, tenant=tenant, slo=slo,
+            ttft_target_ms=ttft_target_ms,
             tpot_target_ms=tpot_target_ms, deadline_ms=deadline_ms,
             max_retries=max_retries, max_len=self.cfg.max_len)
         # mint AFTER validation (a rejected request burns no rid) and
@@ -978,7 +1131,8 @@ class ContinuousBatchingEngine:
             int(ledger["max_new_tokens"]), ledger.get("eos_token_id"),
             temperature=ledger.get("temperature"),
             top_k=ledger.get("top_k"), top_p=ledger.get("top_p"),
-            greedy=ledger.get("greedy"), slo=ledger.get("slo"),
+            greedy=ledger.get("greedy"), tenant=ledger.get("tenant"),
+            slo=ledger.get("slo"),
             ttft_target_ms=ledger.get("ttft_target_ms"),
             tpot_target_ms=ledger.get("tpot_target_ms"),
             max_retries=ledger.get("max_retries"),
@@ -1512,7 +1666,9 @@ class ContinuousBatchingEngine:
         if ids is None:
             ids = self._prefill_ids(req)
         if req._hashes is None:
-            req._hashes = block_hashes(ids, self._prefix_block)
+            req._hashes = block_hashes(
+                ids, self._prefix_block,
+                namespace=request_namespace(req))
         hashes = req._hashes
         matched = self._prefix.match(hashes)
         prefix_len = len(matched) * self._prefix_block
@@ -1522,10 +1678,13 @@ class ContinuousBatchingEngine:
         return hashes, matched, prefix_len, full_cover
 
     def _note_prefix(self, prefix_len: int, n: int,
-                     rid: Optional[int] = None):
+                     req: Optional[Request] = None):
+        tenant = (req.tenant or "-") if req is not None else "-"
         tr = self._tracer
-        if tr is not None and rid is not None and tr.want_request(rid):
-            tr.request(rid, "prefix_lookup", hit_tokens=int(prefix_len),
+        if tr is not None and req is not None \
+                and tr.want_request(req.rid):
+            tr.request(req.rid, "prefix_lookup",
+                       hit_tokens=int(prefix_len),
                        prompt_tokens=int(n))
         if n < self._prefix_block:
             # no full block: block_hashes yields nothing, so the prompt
@@ -1541,13 +1700,20 @@ class ContinuousBatchingEngine:
         else:
             st["misses"] += 1
         if self._tel is not None:
-            self._tel.on_prefix(prefix_len, n, self._prefix.cached_pages)
+            self._tel.on_prefix(prefix_len, n,
+                                self._prefix.cached_pages,
+                                tenant=tenant)
 
-    def _evict_pages(self, n_pages: int) -> int:
-        """Reclaim pool pages from cache-only prefix entries (LRU)."""
+    def _evict_pages(self, n_pages: int,
+                     prefer_ns: Optional[str] = None) -> int:
+        """Reclaim pool pages from cache-only prefix entries (LRU).
+        ``prefer_ns``: evict the requesting tenant's own namespace
+        first — its pool pressure spends its own cold entries before
+        it can flush another tenant's cached system prompt."""
         if self._prefix is None or not self.cfg.paged:
             return 0
-        freed = self._prefix.evict(self.pool, n_pages)
+        freed = self._prefix.evict(self.pool, n_pages,
+                                   prefer_ns=prefer_ns)
         if freed:
             self.prefix_stats["evictions"] += freed
             if self._tel is not None:
@@ -1691,7 +1857,8 @@ class ContinuousBatchingEngine:
             if not pool.alloc(slot, need):
                 missing = pool.pages_needed(need) \
                     - len(pool.pages_of[slot])
-                self._evict_pages(missing - pool.free_pages)
+                self._evict_pages(missing - pool.free_pages,
+                                  prefer_ns=request_namespace(req))
                 if not pool.alloc(slot, need):
                     pool.free(slot)  # releases adopted refs too
                     return None
@@ -1706,7 +1873,8 @@ class ContinuousBatchingEngine:
             raise
 
     def _prefix_store_insert(self, slot: int, prompt: np.ndarray,
-                             hashes: List[bytes], n_matched: int):
+                             hashes: List[bytes], n_matched: int,
+                             ns: str = ""):
         """After a request's prefill is dispatched, publish its full
         prompt blocks to the store. Paged: refcount the slot's pages
         (zero copies — the chunk programs already queued the writes on
@@ -1719,7 +1887,7 @@ class ContinuousBatchingEngine:
         if self.cfg.paged:
             for i, digest in enumerate(hashes):
                 store.insert(digest, int(self.pool.block_tables[slot, i]),
-                             self.pool)
+                             self.pool, ns=ns)
         else:
             for i in range(n_matched, len(hashes)):
                 if hashes[i] in store:
@@ -1727,7 +1895,9 @@ class ContinuousBatchingEngine:
                 with self._ctx():
                     k, v = self._read_block_contig()(
                         self.caches, slot, i * B)
-                store.insert(hashes[i], k, v)
+                # protect the chain being inserted: same-ns eviction
+                # must not eat this prompt's own earlier blocks
+                store.insert(hashes[i], k, v, ns=ns, protect=hashes)
             evicted = store.evictions - self.prefix_stats["evictions"]
             if evicted > 0:
                 self.prefix_stats["evictions"] = store.evictions
@@ -1746,7 +1916,10 @@ class ContinuousBatchingEngine:
         (req, slot, first_token_future) list for
         ``_admit_integrate``."""
         # fresh verdict each attempt: the flag self-heals the moment an
-        # admission pass no longer blocks on the pool
+        # admission pass no longer blocks on the pool (the previous
+        # verdict survives in _pool_blocked_prev for the policy's
+        # preemption window, which runs before this pass can re-judge)
+        self._pool_blocked_prev = self._pool_blocked
         self._pool_blocked = False
         if not self._queue:
             return []
@@ -1791,23 +1964,34 @@ class ContinuousBatchingEngine:
         jobs = []  # [req, slot, prefix_len, hashes, n_matched, cursor,
         #            ids] — ids: the prefill token sequence (prompt, or
         #            prompt+history for a crash-recovery replay)
-        deferred: List[Request] = []  # shed batch-class requests
+        # rids deferred this wave (shed batch / draining-fresh /
+        # just-preempted): they stay IN the queue at their position —
+        # deferral is a skip, never a reorder. fifo_cursor: the FIFO
+        # path's wave-local [snapshot, index] (see _pick_admission)
+        skip = set()
+        fifo_cursor = [None, 0]
+        if self._sched is not None:
+            # the policy's preemption window: it may release slots
+            # (engine.preempt → requeued at the front) for this very
+            # wave; preempted rids must not re-admit in the same wave
+            # (their freed slots are what the wave is FOR)
+            skip.update(self._sched.before_admission(self) or ())
         try:
-            while self._queue and self._free_heap:
+            while self._free_heap:
                 if throttle and jobs:
                     break  # degraded: at most one admission per wave
-                req = self._queue[0]
+                req = self._pick_admission(skip, fifo_cursor)
+                if req is None:
+                    break
                 if shed and req.slo == "batch":
                     # degradation L1+: defer (never drop) batch-class
-                    # admissions; restored to the queue front below
-                    self._queue.popleft()
-                    deferred.append(req)
+                    # admissions; they keep their queue position
+                    skip.add(req.rid)
                     continue
                 if self._draining and not (req._retries or req.output):
                     # draining: only in-flight-once replays admit;
-                    # fresh requests defer (restored below)
-                    self._queue.popleft()
-                    deferred.append(req)
+                    # fresh requests defer in place
+                    skip.add(req.rid)
                     continue
                 slot = self._free_heap[0]  # peek; claimed below
                 ids = self._prefill_ids(req)
@@ -1841,11 +2025,24 @@ class ContinuousBatchingEngine:
                         for i, (kb, vb) in enumerate(matched):
                             self.caches = self._insert_prefix_contig()(
                                 self.caches, kb, vb, slot, i * B)
-                self._queue.popleft()
+                # commit: head popleft when possible (the FIFO fast
+                # path's O(1) twin), else remove by IDENTITY (the
+                # policy may have picked mid-queue; deque.remove
+                # matches `is` first)
+                if self._queue and self._queue[0] is req:
+                    self._queue.popleft()
+                else:
+                    self._queue.remove(req)
+                if skip:
+                    # cursor mode: the wave snapshot may still hold
+                    # this (now-claimed) request — mark it consumed
+                    skip.add(req.rid)
                 heapq.heappop(self._free_heap)
                 self.active[slot] = True
                 req.slot = slot
                 self._slot_req[slot] = req
+                if self._sched is not None:
+                    self._sched.note_admit(self, req)
                 # 6th element: the prefill cursor (starts at the
                 # prefix boundary; _drive_prefill_chunks advances it —
                 # prefix_len itself stays pristine for the stats
@@ -1877,12 +2074,6 @@ class ContinuousBatchingEngine:
                 self._after_admission_fault(e, [j[0] for j in jobs])
                 return []
             raise
-        finally:
-            if deferred:
-                # deferred batch requests return to the queue FRONT in
-                # their original relative order, ahead of the rest —
-                # shed is a deferral, never a reorder within the class
-                self._queue.extendleft(reversed(deferred))
 
     def _drive_prefill_chunks(self, jobs):
         """Host loop over suffix chunks for a wave of claimed requests.
@@ -1993,9 +2184,10 @@ class ContinuousBatchingEngine:
         # request's own published blocks.
         for req, slot, prefix_len, hashes, n_matched, _cursor, ids_arr \
                 in jobs:
-            self._prefix_store_insert(slot, ids_arr, hashes, n_matched)
+            self._prefix_store_insert(slot, ids_arr, hashes, n_matched,
+                                      ns=request_namespace(req))
             if self._prefix is not None and not self._prefix_disabled():
-                self._note_prefix(prefix_len, ids_arr.size, req.rid)
+                self._note_prefix(prefix_len, ids_arr.size, req)
         return pending
 
     def _admit_dispatch_bucketed(self):
@@ -2175,6 +2367,20 @@ class ContinuousBatchingEngine:
             st = self.slo_stats[slo] = new_slo_bucket()
         return st
 
+    def _tenant_bucket(self, tenant: Optional[str]) -> Dict[str, float]:
+        """Cumulative per-tenant host counters (``"-"`` = untagged) —
+        written at finish/preempt on the scheduler thread, read via
+        ``tenant_snapshot()``."""
+        key = tenant or "-"
+        st = self.tenant_stats.get(key)
+        if st is None:
+            st = self.tenant_stats[key] = {
+                "finished": 0, "cancelled": 0, "timeouts": 0,
+                "failed": 0, "tokens": 0, "device_ms": 0.0,
+                "slo_met": 0, "slo_violated": 0, "preemptions": 0,
+            }
+        return st
+
     def _finish_accounting(self, req: Request, reason: str):
         """Shared finish/cancel bookkeeping: per-request TPOT, SLO
         attainment (host ``slo_stats`` + telemetry counters + goodput
@@ -2185,6 +2391,13 @@ class ContinuousBatchingEngine:
         n_decode = len(req.output) - 1  # first token priced into TTFT
         if req._admit_t and n_decode > 0:
             req.tpot_ms = (now - req._admit_t) * 1e3 / n_decode
+        tst = self._tenant_bucket(req.tenant)
+        tst["tokens"] += len(req.output)
+        if reason in ("cancel", "timeout", "failed"):
+            tst[{"cancel": "cancelled", "timeout": "timeouts",
+                 "failed": "failed"}[reason]] += 1
+        else:
+            tst["finished"] += 1
         if req.slo is not None and reason == "cancel":
             self._slo_bucket(req.slo)["cancelled"] += 1
         elif req.slo is not None and reason in ("timeout", "failed"):
@@ -2194,12 +2407,13 @@ class ContinuousBatchingEngine:
             st = self._slo_bucket(req.slo)
             req.slo_met = False
             st["violated"] += 1
+            tst["slo_violated"] += 1
             if reason == "timeout":
                 st["timeouts"] += 1
             st["total_tokens"] += len(req.output)
             if self._tel is not None:
-                tracked = st["met"] + st["violated"]
-                self._tel.on_slo(req.slo, False, st["met"] / tracked)
+                self._tel.on_slo(req.slo, False,
+                                 tenant=req.tenant or "-")
         elif req.slo is not None:
             st = self._slo_bucket(req.slo)
             ttft_ok = (req.ttft_target_ms is None
@@ -2209,6 +2423,7 @@ class ContinuousBatchingEngine:
                        or req.tpot_ms <= req.tpot_target_ms)
             req.slo_met = ttft_ok and tpot_ok
             st["met" if req.slo_met else "violated"] += 1
+            tst["slo_met" if req.slo_met else "slo_violated"] += 1
             if not ttft_ok:
                 st["ttft_violations"] += 1
             if not tpot_ok:
@@ -2217,9 +2432,8 @@ class ContinuousBatchingEngine:
             if req.slo_met:
                 st["met_tokens"] += len(req.output)
             if self._tel is not None:
-                tracked = st["met"] + st["violated"]
                 self._tel.on_slo(req.slo, req.slo_met,
-                                 st["met"] / tracked)
+                                 tenant=req.tenant or "-")
         tr = self._tracer
         if tr is not None and tr.want_request(req.rid):
             t0 = req._admit_t or now
@@ -2898,6 +3112,11 @@ class ContinuousBatchingEngine:
         spec_by_rid = {} if adv is not None else None
         occ = float(self.active.sum()) / cfg.max_slots
         chunk_slots = self.active.copy()
+        # dispatch-time occupants: the overlapped admission below may
+        # preempt + re-claim a slot — the verify pass's tokens must
+        # never credit the new occupant (identity-checked at sync)
+        chunk_reqs = {s: self._slot_req[s]
+                      for s in range(cfg.max_slots) if chunk_slots[s]}
         p_dec = None
         try:
             self._fault_point("verify")
@@ -2959,9 +3178,9 @@ class ContinuousBatchingEngine:
         proposed_tot = accepted_tot = 0
         cost_shares = [] if self._cost_enabled else None
         for slot in range(cfg.max_slots):
-            if not chunk_slots[slot] or not self.active[slot]:
-                continue
-            req = self._slot_req[slot]
+            req = chunk_reqs.get(slot)
+            if req is None or self._slot_req.get(slot) is not req:
+                continue  # finished at sync, or preempted + re-claimed
             n = int(n_draft[slot])
             a = min(int(acc_np[slot]), n)
             toks = [int(ids[slot, 1 + j]) for j in range(a)]
@@ -3034,7 +3253,15 @@ class ContinuousBatchingEngine:
 
     def _slot_budgets(self) -> np.ndarray:
         """Per-slot remaining token budget (max_new_tokens and max_len
-        caps) — frozen slots stop advancing inside the fixed-K chunk."""
+        caps) — frozen slots stop advancing inside the fixed-K chunk.
+
+        The scheduler policy's CHUNK-SPLIT seam: ``slot_caps`` may
+        shrink individual slots' budgets within the fixed-shape chunk
+        (the program still computes every slot's rows — the cap
+        bounds which tokens COMMIT, i.e. a tenant's emission and
+        paged page-growth per chunk, not the chunk's device time).
+        A cap set that would freeze EVERY active slot is ignored: a
+        chunk that can emit nothing would spin the scheduler."""
         budget = np.zeros((self.cfg.max_slots,), np.int32)
         for slot in range(self.cfg.max_slots):
             if not self.active[slot]:
@@ -3043,6 +3270,14 @@ class ContinuousBatchingEngine:
             budget[slot] = max(0, min(
                 req.max_new_tokens - len(req.output),
                 self.cfg.max_len - 1 - int(self.seq_lens[slot])))
+        if self._sched is not None:
+            caps = self._sched.slot_caps(self)
+            if caps is not None:
+                capped = np.minimum(
+                    budget, np.asarray(caps, np.int32))
+                if capped.max(initial=0) > 0 \
+                        or budget.max(initial=0) == 0:
+                    budget = capped
         return budget
 
     def step_chunk(self, max_chunk: int = 8) -> bool:
@@ -3175,8 +3410,14 @@ class ContinuousBatchingEngine:
         K = max_chunk
         # capture the chunk's view BEFORE admission: newly admitted
         # slots must not decode mid-chunk (their lengths land at
-        # integrate)
+        # integrate). The OCCUPANTS are captured too: the overlapped
+        # admission may PREEMPT a slot and re-claim it in the same
+        # tick, and the chunk's tokens must never credit the new
+        # occupant (identity-checked in the sync loop below)
         chunk_slots = self.active.copy()
+        chunk_reqs = {s: self._slot_req[s]
+                      for s in range(self.cfg.max_slots)
+                      if chunk_slots[s]}
         p_dec = None
         try:
             self._fault_point("decode_chunk")
@@ -3239,13 +3480,17 @@ class ContinuousBatchingEngine:
             else None
         for k in range(K):
             for slot in range(self.cfg.max_slots):
-                # chunk_slots: was in this chunk; active: not finished
-                # (EOS) at an earlier k of this same chunk
-                if (not chunk_slots[slot] or not self.active[slot]
-                        or k >= budget[slot]):
+                # the slot advances only while its DISPATCH-TIME
+                # occupant still owns it: gone = finished (EOS) at an
+                # earlier k of this same chunk; replaced = preempted
+                # mid-chunk and re-claimed by this tick's admission —
+                # either way the chunk's remaining tokens are
+                # discarded, exactly like cancel's
+                req = chunk_reqs.get(slot)
+                if (req is None or k >= budget[slot]
+                        or self._slot_req.get(slot) is not req):
                     continue
                 tok = int(toks_np[k, slot])
-                req = self._slot_req[slot]
                 req.output.append(tok)
                 self.seq_lens[slot] += 1
                 self.last_tok[slot] = tok
@@ -3393,6 +3638,9 @@ class ContinuousBatchingEngine:
         snap["prefix_cache"] = self.prefix_snapshot()
         snap["spec_decode"] = self.spec_snapshot()
         snap["slo"] = self.slo_snapshot()
+        # multi-tenant accounting + the admission scheduler's policy
+        # name and preemption count ride the one unified document
+        snap["tenants"] = self.tenant_snapshot()
         snap["resilience"] = self.resilience_snapshot()
         # program-time attribution (PR 12): measured per-program
         # device ms, watchdog state and HBM residency ride the one
@@ -3472,6 +3720,43 @@ class ContinuousBatchingEngine:
             "met": met,
             "violated": violated,
             "goodput": met / tracked if tracked else None,
+        }
+
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant serving state: cumulative host counters
+        (finished/cancelled/timeouts/failed, tokens, attributed
+        device-ms, SLO met/violated, preemptions) joined with LIVE
+        usage — active slots, held KV pages, queued requests — the
+        isolation numbers the multi-tenant scheduler's quotas act on.
+        Plain host counters, available with telemetry off; copy-on-
+        read like every scrape surface (tenant ``"-"`` is untagged
+        traffic)."""
+        if self._san is not None:
+            self._san.check_read("tenant_snapshot")
+        tenants: Dict[str, dict] = {}
+
+        def bucket(key):
+            d = tenants.get(key)
+            if d is None:
+                d = tenants[key] = {
+                    "active_slots": 0, "pages": 0, "queued": 0}
+            return d
+
+        for key, st in list(self.tenant_stats.items()):
+            bucket(key).update({k: v for k, v in list(st.items())})
+        for slot, req in list(self._slot_req.items()):
+            d = bucket(req.tenant or "-")
+            d["active_slots"] += 1
+            if self.cfg.paged:
+                # pages_of values are replaced whole on free — the
+                # same staleness contract as _tel_state's gauge read
+                d["pages"] += len(self.pool.pages_of[slot])
+        for req in list(self._queue):
+            bucket(req.tenant or "-")["queued"] += 1
+        return {
+            "tenants": tenants,
+            "scheduler": {k: v
+                          for k, v in list(self.sched_stats.items())},
         }
 
     def slo_window_reset(self):
@@ -3559,9 +3844,13 @@ class ContinuousBatchingEngine:
                                       "device_ms_total": 0.0}
         by["requests"] += 1
         by["device_ms_total"] += req.device_ms
+        # per-tenant attributed cost rides the same finish record
+        # (cost-gated like cost_stats: off = requests carry 0 anyway)
+        self._tenant_bucket(req.tenant)["device_ms"] += req.device_ms
         self._cost_window.append(req.device_ms)
         if self._tel is not None:
-            self._tel.on_request_cost(key, req.device_ms)
+            self._tel.on_request_cost(key, req.device_ms,
+                                      tenant=req.tenant or "-")
 
     def _flush_cost(self):
         """Record finish-time costs deferred past the step's
@@ -3845,6 +4134,86 @@ class MetricsServer:
         return False
 
 
+def metrics_http_get(engine, path: str):
+    """Route one GET against the serving observability surface —
+    ``/metrics`` (Prometheus text), ``/healthz`` (JSON readiness, 503
+    while saturated/draining), ``/trace`` (Chrome trace JSON,
+    ``?fleet=1`` merges a router's fleet), ``/timeline`` (retained
+    time-series windows). Returns ``(status, body_bytes, content_type)``
+    or ``None`` for an unknown path.
+
+    Factored out of :func:`start_metrics_server` so the streaming API
+    front door (``paddle_tpu.serving_api``) serves the SAME
+    observability endpoints beside ``/v1/*`` instead of duplicating
+    them. ``engine`` may be an engine, an ``EngineRouter``, or None."""
+    import json
+
+    bare = path.split("?")[0]
+    if bare == "/metrics":
+        text = observability.global_registry().prometheus_text()
+        return (200, text.encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+    if bare == "/healthz":
+        payload = {"status": "ok",
+                   "telemetry": observability.enabled()}
+        code = 200
+        if engine is not None:
+            bp = engine.backpressure()
+            payload["backpressure"] = bp
+            payload["engine"] = engine.metrics_snapshot()
+            # degraded is NOT a readiness failure: the replica still
+            # serves (shed/throttled) — a router reads the bit to
+            # deprioritize it, and the numeric RUNG to rank replicas
+            # (a shed_batch replica beats a min_service one)
+            payload["degraded"] = bool(bp.get("degraded"))
+            payload["degradation_level"] = int(
+                bp.get("degradation_level", 0))
+            if bp.get("draining"):
+                # drain() in progress: in-flight requests still
+                # complete, but a router must stop sending —
+                # readiness fails first
+                payload["status"] = "draining"
+                code = 503
+            elif bp["saturated"]:
+                # honest readiness: requests are waiting and no slot
+                # can take them — tell the router to drain, don't
+                # smile through it
+                payload["status"] = "saturated"
+                code = 503
+        return (code, json.dumps(payload, default=str).encode(),
+                "application/json")
+    if bare == "/timeline":
+        tl = getattr(engine, "timeline_snapshot", None)
+        snap = tl() if tl is not None else None
+        if snap is None or not snap.get("enabled"):
+            return (404, b"timeline disabled (PT_FLAGS_timeseries "
+                    b"off)", "text/plain")
+        return (200, json.dumps(snap, default=str).encode(),
+                "application/json")
+    if bare == "/trace":
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(path).query)
+        want_fleet = q.get("fleet", ["0"])[0] in ("1", "true")
+        tracer = getattr(engine, "_tracer", None)
+        if want_fleet and hasattr(engine, "_replicas"):
+            # /trace?fleet=1 on a router: ONE merged Perfetto
+            # document — router + every replica tracer, failed-over
+            # rids joined by flow events (tracing.fleet_chrome_trace)
+            body = json.dumps(
+                observability.tracing.fleet_chrome_trace(engine),
+                default=str).encode()
+            return (200, body, "application/json")
+        if tracer is None:
+            return (404, b"tracing disabled (telemetry off or "
+                    b"trace_sample=0)", "text/plain")
+        body = json.dumps(
+            observability.tracing.chrome_trace([tracer]),
+            default=str).encode()
+        return (200, body, "application/json")
+    return None
+
+
 def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
                          host: str = "127.0.0.1", port: int = 0):
     """Serve ``/metrics`` (Prometheus text exposition of the process
@@ -3866,7 +4235,6 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
     ``handle.server_address`` for the bound port (``port=0`` picks a
     free one), call ``handle.shutdown()`` for a clean stop (thread
     joined, socket closed)."""
-    import json
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -3882,81 +4250,11 @@ def start_metrics_server(engine: Optional[ContinuousBatchingEngine] = None,
             # a scrape must never die on a transient error: the
             # liveness endpoint failing under load defeats its purpose
             try:
-                path = self.path.split("?")[0]
-                if path == "/metrics":
-                    text = observability.global_registry() \
-                        .prometheus_text()
-                    self._send(200, text.encode(),
-                               "text/plain; version=0.0.4; charset=utf-8")
-                elif path == "/healthz":
-                    payload = {"status": "ok",
-                               "telemetry": observability.enabled()}
-                    code = 200
-                    if engine is not None:
-                        bp = engine.backpressure()
-                        payload["backpressure"] = bp
-                        payload["engine"] = engine.metrics_snapshot()
-                        # degraded is NOT a readiness failure: the
-                        # replica still serves (shed/throttled) — a
-                        # router reads the bit to deprioritize it,
-                        # and the numeric RUNG to rank replicas (a
-                        # shed_batch replica beats a min_service one)
-                        payload["degraded"] = bool(bp.get("degraded"))
-                        payload["degradation_level"] = int(
-                            bp.get("degradation_level", 0))
-                        if bp.get("draining"):
-                            # drain() in progress: in-flight requests
-                            # still complete, but a router must stop
-                            # sending — readiness fails first
-                            payload["status"] = "draining"
-                            code = 503
-                        elif bp["saturated"]:
-                            # honest readiness: requests are waiting
-                            # and no slot can take them — tell the
-                            # router to drain, don't smile through it
-                            payload["status"] = "saturated"
-                            code = 503
-                    self._send(
-                        code, json.dumps(payload, default=str).encode(),
-                        "application/json")
-                elif path == "/timeline":
-                    tl = getattr(engine, "timeline_snapshot", None)
-                    snap = tl() if tl is not None else None
-                    if snap is None or not snap.get("enabled"):
-                        self._send(
-                            404, b"timeline disabled "
-                            b"(PT_FLAGS_timeseries off)", "text/plain")
-                    else:
-                        self._send(
-                            200,
-                            json.dumps(snap, default=str).encode(),
-                            "application/json")
-                elif path == "/trace":
-                    from urllib.parse import parse_qs, urlparse
-
-                    q = parse_qs(urlparse(self.path).query)
-                    want_fleet = q.get("fleet", ["0"])[0] in ("1", "true")
-                    tracer = getattr(engine, "_tracer", None)
-                    if want_fleet and hasattr(engine, "_replicas"):
-                        # /trace?fleet=1 on a router: ONE merged
-                        # Perfetto document — router + every replica
-                        # tracer, failed-over rids joined by flow
-                        # events (tracing.fleet_chrome_trace)
-                        body = json.dumps(
-                            observability.tracing.fleet_chrome_trace(
-                                engine), default=str).encode()
-                        self._send(200, body, "application/json")
-                    elif tracer is None:
-                        self._send(404, b"tracing disabled (telemetry "
-                                   b"off or trace_sample=0)",
-                                   "text/plain")
-                    else:
-                        body = json.dumps(
-                            observability.tracing.chrome_trace([tracer]),
-                            default=str).encode()
-                        self._send(200, body, "application/json")
-                else:
+                routed = metrics_http_get(engine, self.path)
+                if routed is None:
                     self._send(404, b"not found", "text/plain")
+                else:
+                    self._send(*routed)
             except BrokenPipeError:
                 pass
             except Exception as e:  # noqa: BLE001

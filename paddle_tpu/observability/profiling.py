@@ -417,7 +417,10 @@ def hbm_accounting(engine) -> Dict[str, int]:
             kv_leaf(v)
         store = engine._prefix
         if store is not None:
-            for kb, vb in list(getattr(store, "_blocks", {}).values()):
+            # entries are (k, v, namespace) — the tenant namespace is
+            # bookkeeping, not HBM
+            for kb, vb, *_ns in list(
+                    getattr(store, "_blocks", {}).values()):
                 kv = 0
                 for blk in (kb, vb):
                     if isinstance(blk, QuantizedKV):

@@ -67,18 +67,23 @@ class ServingTelemetry:
         self._tokens = reg.counter(
             "pt_serve_tokens_generated_total", "output tokens produced",
             L)
+        # tenant-labeled (tenant "-" = untagged traffic): per-tenant
+        # hit rates are the isolation evidence — one tenant's eviction
+        # storm showing up as ANOTHER tenant's hit-rate collapse is
+        # exactly what the namespace quotas exist to prevent
+        LT = ("engine", "tenant")
         self._pfx_hits = reg.counter(
             "pt_serve_prefix_cache_hits_total",
-            "admissions that reused a cached prompt prefix", L)
+            "admissions that reused a cached prompt prefix", LT)
         self._pfx_misses = reg.counter(
             "pt_serve_prefix_cache_misses_total",
-            "admissions with no cached prefix", L)
+            "admissions with no cached prefix", LT)
         self._pfx_hit_tokens = reg.counter(
             "pt_serve_prefix_cache_hit_tokens_total",
-            "prompt tokens served from the prefix cache", L)
+            "prompt tokens served from the prefix cache", LT)
         self._pfx_prompt_tokens = reg.counter(
             "pt_serve_prefix_cache_prompt_tokens_total",
-            "prompt tokens submitted through prefix lookup", L)
+            "prompt tokens submitted through prefix lookup", LT)
         self._pfx_evict = reg.counter(
             "pt_serve_prefix_cache_evictions_total",
             "prefix blocks/pages evicted (LRU)", L)
@@ -167,7 +172,13 @@ class ServingTelemetry:
         # peak series this engine created (labels aren't enumerable
         # from the gauge side)
         self._hbm_components: set = set()
-        LS = ("engine", "slo")
+        self._preempted = reg.counter(
+            "pt_serve_preemptions_total",
+            "active requests preempted by the scheduler policy "
+            "(slot/pages released, request re-queued at the front for "
+            "deterministic prompt+history replay — the SLO-fair "
+            "scheduler's anti-starvation lever)", L)
+        LS = ("engine", "slo", "tenant")
         self._req_device = reg.histogram(
             "pt_serve_request_device_ms",
             "per-request ATTRIBUTED device time (ms), recorded at "
@@ -176,11 +187,13 @@ class ServingTelemetry:
             "across the requests the step advanced, proportional to "
             "tokens advanced — the measured per-token cost the "
             "Tensix-style bytes-per-token models are laid against. "
-            "slo='untracked' for SLO-less requests",
+            "slo='untracked' for SLO-less requests; tenant='-' for "
+            "untagged traffic",
             labels=LS, buckets=exp_buckets(0.05, 2.0, 22))
-        # slo labels this engine recorded costs under — window_reset
-        # must clear each series' percentile window (labels aren't
-        # enumerable from the histogram side; the hbm pattern)
+        # (slo, tenant) label pairs this engine recorded costs under —
+        # window_reset must clear each series' percentile window
+        # (labels aren't enumerable from the histogram side; the hbm
+        # pattern)
         self._cost_slos: set = set()
         self._slo_met = reg.counter(
             "pt_serve_slo_met_total",
@@ -197,6 +210,15 @@ class ServingTelemetry:
 
     def _lab(self) -> dict:
         return {"engine": self.engine_id}
+
+    def _sum_engine(self, metric) -> float:
+        """Total over this engine's series of a tenant-labeled metric
+        (``series()`` copies under the registry lock — safe from any
+        thread); the snapshot keeps its engine-level aggregate while
+        the per-tenant series stay scrapeable."""
+        i = metric.label_names.index("engine")
+        return sum(v for k, v in metric.series().items()
+                   if k[i] == self.engine_id)
 
     # ---------------- hooks ----------------
     def on_submit(self, queue_depth: int):
@@ -248,20 +270,31 @@ class ServingTelemetry:
     def on_drain(self, active: bool):
         self._draining.set(1 if active else 0, **self._lab())
 
-    def on_slo(self, slo: str, met: bool, goodput: float):
-        """One SLO-tracked request finished: ``met`` is its attainment,
-        ``goodput`` the class's running met fraction."""
-        lab = dict(self._lab(), slo=slo)
+    def on_slo(self, slo: str, met: bool, tenant: str = "-"):
+        """One SLO-tracked request finished: ``met`` is its
+        attainment. The goodput gauge is derived from THIS series' own
+        met/violated counters, so every (class, tenant) pair reports
+        its own fraction — per-tenant attainment is the starvation
+        evidence the SLO-fair scheduler is ranked on, and a starved
+        tenant must never read the healthy tenant's blended number."""
+        lab = dict(self._lab(), slo=slo, tenant=tenant)
         (self._slo_met if met else self._slo_violated).inc(**lab)
-        self._slo_goodput.set(goodput, **lab)
+        m = self._slo_met.value(**lab)
+        v = self._slo_violated.value(**lab)
+        self._slo_goodput.set(m / (m + v), **lab)
+
+    def on_preempt(self):
+        self._preempted.inc(**self._lab())
 
     def on_prefix(self, hit_tokens: int, prompt_tokens: int,
-                  cached_blocks: int):
+                  cached_blocks: int, tenant: str = "-"):
         lab = self._lab()
-        (self._pfx_hits if hit_tokens > 0 else self._pfx_misses).inc(**lab)
+        labt = dict(lab, tenant=tenant)
+        (self._pfx_hits if hit_tokens > 0
+         else self._pfx_misses).inc(**labt)
         if hit_tokens > 0:
-            self._pfx_hit_tokens.inc(hit_tokens, **lab)
-        self._pfx_prompt_tokens.inc(prompt_tokens, **lab)
+            self._pfx_hit_tokens.inc(hit_tokens, **labt)
+        self._pfx_prompt_tokens.inc(prompt_tokens, **labt)
         self._pfx_cached.set(cached_blocks, **lab)
 
     def on_prefix_evict(self, n: int = 1,
@@ -282,10 +315,12 @@ class ServingTelemetry:
             self._hbm_peak.set_max(nbytes, **lab)
             self._hbm_components.add(comp)
 
-    def on_request_cost(self, slo: str, device_ms: float):
+    def on_request_cost(self, slo: str, device_ms: float,
+                        tenant: str = "-"):
         """One finished request's attributed device cost (ms)."""
-        self._req_device.observe(device_ms, slo=slo, **self._lab())
-        self._cost_slos.add(slo)
+        self._req_device.observe(device_ms, slo=slo, tenant=tenant,
+                                 **self._lab())
+        self._cost_slos.add((slo, tenant))
 
     def on_spec_slot(self, proposed: int, accepted: int):
         """One slot's outcome in one verify pass — feeds the
@@ -388,10 +423,11 @@ class ServingTelemetry:
             },
             "tokens_generated": self._tokens.value(**lab),
             "prefix_cache": {
-                "hits": self._pfx_hits.value(**lab),
-                "misses": self._pfx_misses.value(**lab),
-                "hit_tokens": self._pfx_hit_tokens.value(**lab),
-                "prompt_tokens": self._pfx_prompt_tokens.value(**lab),
+                "hits": self._sum_engine(self._pfx_hits),
+                "misses": self._sum_engine(self._pfx_misses),
+                "hit_tokens": self._sum_engine(self._pfx_hit_tokens),
+                "prompt_tokens": self._sum_engine(
+                    self._pfx_prompt_tokens),
                 "evictions": self._pfx_evict.value(**lab),
                 "cached_blocks": self._pfx_cached.value(**lab),
             },
@@ -415,8 +451,9 @@ class ServingTelemetry:
         self._tpot.reset_window(**lab)
         self._req_tpot.reset_window(**lab)
         self._spec_accept_hist.reset_window(**lab)
-        for slo in list(self._cost_slos):
-            self._req_device.reset_window(slo=slo, **lab)
+        for slo, tenant in list(self._cost_slos):
+            self._req_device.reset_window(slo=slo, tenant=tenant,
+                                          **lab)
         self._queue_peak.set(0, **lab)
         self._occ_peak.set(0.0, **lab)
         self._kv_peak.set(0.0, **lab)

@@ -1,0 +1,41 @@
+"""Streaming serving front door: OpenAI-compatible SSE HTTP API +
+SLO-aware multi-tenant admission scheduling over the
+continuous-batching engine (single engine or ``EngineRouter`` fleet).
+
+Quickstart::
+
+    from paddle_tpu.inference.serving import (
+        ContinuousBatchingEngine, EngineConfig)
+    from paddle_tpu.serving_api import (
+        SLOFairScheduler, TenantQuota, start_api_server)
+
+    eng = ContinuousBatchingEngine(model, EngineConfig(paged=True))
+    srv = start_api_server(
+        eng, scheduler=SLOFairScheduler(
+            tenants={"acme": TenantQuota(weight=2.0, max_slots=3)}))
+    # POST {srv.url}/v1/completions  {"prompt": [3,7,11], "stream": true}
+    srv.shutdown()
+
+See README "Serving front door" for the endpoint table, request
+schema and scheduler/quota flags.
+"""
+
+from .protocol import (
+    CompletionRequest,
+    ProtocolError,
+    parse_completion_request,
+)
+from .scheduler import SLOFairScheduler, TenantQuota, default_scheduler
+from .server import ServingAPIServer, ServingFrontDoor, start_api_server
+
+__all__ = [
+    "CompletionRequest",
+    "ProtocolError",
+    "parse_completion_request",
+    "SLOFairScheduler",
+    "TenantQuota",
+    "default_scheduler",
+    "ServingAPIServer",
+    "ServingFrontDoor",
+    "start_api_server",
+]

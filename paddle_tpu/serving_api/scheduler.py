@@ -1,0 +1,364 @@
+"""SLO-aware multi-tenant admission scheduler for the serving engine.
+
+Replaces FIFO admission with a three-tier policy the goodput-under-SLO
+sweep ranks directly (the metric PR 6 built for exactly this):
+
+1. **Deadline urgency** — a queued SLO-tracked request whose remaining
+   TTFT budget has shrunk below the margin jumps the queue (most
+   urgent first). FIFO's failure mode is interactive requests timing
+   out behind a wall of batch prefills; this tier is the fix.
+2. **Weighted fair share** — otherwise, tenants are served in order of
+   accumulated virtual service (admitted tokens / weight), the classic
+   WFQ discipline: a tenant flooding the queue only raises its own
+   virtual time, so a light tenant's next request always ranks ahead.
+   New tenants join at the current minimum (no banked credit).
+3. **Target tightness, then FIFO** — within a tenant, tighter TTFT
+   targets first; final tie-break is submission order.
+
+Per-tenant **quotas** (max slots / max KV pages) bound what any tenant
+can occupy regardless of queue pressure, and **preemption**
+(``PT_FLAGS_sched_preempt``) lets an about-to-miss interactive request
+evict a batch-class slot: the victim re-queues WITH its generated
+history and replays through the existing ``[slots, C]`` chunked
+prefill program — the crash-recovery machinery, so greedy outputs stay
+bit-identical and ZERO new programs compile.
+
+The chunk-split levers: ``chunk_len`` shrinks the decode chunk to the
+probe length while urgent admissions wait (the step's token budget is
+spent reaching the next admission point sooner instead of on
+incumbents — the PR-5 load-curve knob, now SLO-driven), and
+``slot_caps`` bounds batch-class slots' per-chunk COMMIT budget while
+urgent work queues (their emission and paged page-growth, not the
+chunk's device time — the fixed-shape program computes every slot's
+rows regardless).
+
+Everything here is host-side policy consulted on the scheduler thread
+(``engine.set_scheduler`` documents the seam): no compiled program is
+touched, and per-request greedy outputs are bit-identical under any
+admission order — only TTFT/goodput move, which is the point.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import flags
+
+# most-recent preempted rids remembered (see SLOFairScheduler._preempts)
+_PREEMPT_LEDGER_CAP = 4096
+
+
+@dataclass
+class TenantQuota:
+    """Per-tenant scheduling config: ``weight`` is the fair-share
+    ratio (2.0 = twice the service of a weight-1 tenant); ``max_slots``
+    / ``max_pages`` cap what the tenant may OCCUPY at once (None =
+    uncapped). Quotas gate admission only — in-flight requests always
+    run to completion (or preemption)."""
+
+    weight: float = 1.0
+    max_slots: Optional[int] = None
+    max_pages: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.weight > 0:
+            raise ValueError(
+                f"TenantQuota.weight must be > 0; got {self.weight}")
+        for name in ("max_slots", "max_pages"):
+            v = getattr(self, name)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, int) or v < 1):
+                raise ValueError(
+                    f"TenantQuota.{name} must be a positive int or "
+                    f"None; got {v!r}")
+
+
+class SLOFairScheduler:
+    """The shipped scheduler policy (see module docstring). Install
+    with ``engine.set_scheduler(SLOFairScheduler(...))`` — or let the
+    front door build one via ``PT_FLAGS_sched_policy=slo_fair``.
+
+    One instance may front several engines (an ``EngineRouter``
+    fleet): the fair-share ledger is then fleet-global, which is the
+    honest reading of "tenant share" when tenants span replicas.
+    """
+
+    name = "slo_fair"
+
+    def __init__(self, tenants: Optional[Dict[str, TenantQuota]] = None,
+                 default_weight: float = 1.0,
+                 ttft_margin_ms: float = 50.0,
+                 probe_chunk: int = 2,
+                 preempt: Optional[bool] = None,
+                 max_preemptions_per_request: int = 1):
+        if not default_weight > 0:
+            raise ValueError(
+                f"default_weight must be > 0; got {default_weight}")
+        if ttft_margin_ms < 0:
+            raise ValueError(
+                f"ttft_margin_ms must be >= 0; got {ttft_margin_ms}")
+        if probe_chunk < 1:
+            raise ValueError(
+                f"probe_chunk must be >= 1; got {probe_chunk}")
+        self.tenants: Dict[str, TenantQuota] = dict(tenants or {})
+        self.default_weight = float(default_weight)
+        self.ttft_margin_ms = float(ttft_margin_ms)
+        self.probe_chunk = int(probe_chunk)
+        self.max_preemptions_per_request = int(
+            max_preemptions_per_request)
+        self.preempt_enabled = (bool(flags.flag("sched_preempt"))
+                                if preempt is None else bool(preempt))
+        # tenant -> accumulated virtual service (admitted tokens /
+        # weight); relative order is all that matters, so the ledger
+        # only ever grows — newcomers join at the current minimum
+        self._service: Dict[str, float] = {}
+        # rid -> preemptions consumed (progress bound: past the cap a
+        # request can never be evicted again). Bounded FIFO: rids are
+        # minted monotonically and never reused, so on a long-lived
+        # server old entries are dead weight — the ledger keeps the
+        # most recent _PREEMPT_LEDGER_CAP rids (a dropped entry could
+        # at worst let an ancient still-running request be preempted
+        # one extra time — bounded harm, not a leak)
+        self._preempts: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()
+
+    # ---------------- fair-share ledger ----------------
+    def _weight(self, tenant: Optional[str]) -> float:
+        q = self.tenants.get(tenant or "-")
+        return q.weight if q is not None else self.default_weight
+
+    def _service_of(self, tenant: Optional[str]) -> float:
+        key = tenant or "-"
+        svc = self._service.get(key)
+        if svc is None:
+            # join at the current minimum: a tenant that sat out an
+            # hour must not bank an hour of credit against the rest
+            svc = self._service[key] = min(
+                self._service.values(), default=0.0)
+        return svc
+
+    def note_admit(self, engine, req):
+        """A pick's claim committed: charge the tenant's virtual
+        service with the request's token cost (prompt + budget — the
+        admission-time estimate of what the slot will spend). A
+        RE-admission (preemption/crash-replay re-queue: the request
+        carries output or retries) is not charged again — the tenant
+        already paid for this request's service once, and billing the
+        preemption VICTIM twice would compound its penalty."""
+        del engine
+        if req.output or req._retries:
+            return
+        key = req.tenant or "-"
+        cost = (int(req.prompt.size) + int(req.max_new_tokens)) \
+            / self._weight(req.tenant)
+        self._service[key] = self._service_of(req.tenant) + cost
+
+    # ---------------- urgency ----------------
+    @staticmethod
+    def _ttft_slack_ms(req, now: float) -> Optional[float]:
+        """Remaining TTFT budget (ms); None for target-less requests.
+        Already-admitted requests (replay/preempted, ttft stamped)
+        keep their original clock — the slack is vs FIRST submission,
+        the same honesty rule the SLO accounting follows."""
+        if req.ttft_target_ms is None or req.ttft_ms is not None:
+            return None
+        return req.ttft_target_ms - (now - req._submit_t) * 1e3
+
+    def _at_risk(self, req, now: float) -> bool:
+        slack = self._ttft_slack_ms(req, now)
+        return slack is not None and slack <= self.ttft_margin_ms
+
+    def _queued_at_risk(self, engine, now: float) -> bool:
+        """An ADMISSIBLE at-risk request is queued: quota-blocked
+        urgency must not trigger the chunk-split levers — the levers
+        would tax every other tenant while the request they serve can
+        never be placed."""
+        usage = self._usage_map(engine)
+        return any(self._at_risk(r, now)
+                   and self.quota_ok(engine, r, usage)
+                   for r in list(engine._queue))
+
+    # ---------------- quotas ----------------
+    def _usage_map(self, engine) -> Dict[str, list]:
+        """tenant -> [active slots, held pages], computed ONCE per
+        hook call (a per-candidate recount would make a deep queue's
+        pick O(queue x slots)) — read on the scheduler thread, where
+        the slot map is stable."""
+        usage: Dict[str, list] = {}
+        for slot, req in list(engine._slot_req.items()):
+            u = usage.setdefault(req.tenant or "-", [0, 0])
+            u[0] += 1
+            if engine.pool is not None:
+                u[1] += len(engine.pool.pages_of[slot])
+        return usage
+
+    def quota_ok(self, engine, req, usage=None) -> bool:
+        q = self.tenants.get(req.tenant or "-")
+        if q is None or (q.max_slots is None and q.max_pages is None):
+            return True
+        if usage is None:
+            usage = self._usage_map(engine)
+        slots, pages = usage.get(req.tenant or "-", (0, 0))
+        if q.max_slots is not None and slots >= q.max_slots:
+            return False
+        if q.max_pages is not None and engine.pool is not None \
+                and pages >= q.max_pages:
+            return False
+        return True
+
+    # ---------------- the engine's policy hooks ----------------
+    def pick(self, engine, candidates):
+        """Admission order (``engine._pick_admission``): the best
+        admissible queued request, or None when every candidate is
+        quota-blocked."""
+        now = time.perf_counter()
+        usage = self._usage_map(engine)
+        best = None
+        best_key = None
+        for i, req in enumerate(candidates):
+            if not self.quota_ok(engine, req, usage):
+                continue
+            slack = self._ttft_slack_ms(req, now)
+            if slack is not None and slack <= self.ttft_margin_ms:
+                key = (0, slack, i)
+            else:
+                key = (1, self._service_of(req.tenant),
+                       req.ttft_target_ms
+                       if req.ttft_target_ms is not None
+                       else float("inf"), i)
+            if best_key is None or key < best_key:
+                best, best_key = req, key
+        return best
+
+    def before_admission(self, engine):
+        """The preemption window: when no slot is free and an
+        at-risk, quota-clean request waits, evict the cheapest
+        batch-class victim (fewest generated tokens = least replay
+        recompute). Returns the preempted rids — the engine excludes
+        them from this wave, so the freed slot goes to the urgent
+        request, not back to the victim."""
+        if not self.preempt_enabled:
+            return ()
+        if engine._free_heap and not engine._pool_blocked_prev:
+            # slots available AND the last admission pass didn't
+            # block on KV-pool pages — nothing to evict for. (The
+            # pool-blocked case is the PAGED engine's dominant
+            # saturation mode: slots free, pages exhausted —
+            # preempting a page-holding batch victim frees exactly
+            # what the urgent request needs.)
+            return ()
+        if engine._draining:
+            # the admission loop refuses FRESH requests while
+            # draining — preempting a victim for one would discard
+            # its in-flight chunk and pay full replay for a slot
+            # nothing can claim
+            return ()
+        now = time.perf_counter()
+        usage = self._usage_map(engine)
+        urgent = next(
+            (r for r in list(engine._queue)
+             if self._at_risk(r, now)
+             and self.quota_ok(engine, r, usage)),
+            None)
+        if urgent is None:
+            return ()
+        victim_slot = None
+        victim_key = None
+        for slot, req in list(engine._slot_req.items()):
+            if req.slo != "batch":
+                continue  # only batch-class slots are evictable
+            if self._preempts.get(req.rid, 0) \
+                    >= self.max_preemptions_per_request:
+                continue
+            key = (len(req.output), slot)
+            if victim_key is None or key < victim_key:
+                victim_slot, victim_key = slot, key
+        if victim_slot is None:
+            return ()
+        victim = engine._slot_req[victim_slot]
+        if not engine.preempt(victim_slot):
+            return ()
+        self._preempts[victim.rid] = \
+            self._preempts.get(victim.rid, 0) + 1
+        self._preempts.move_to_end(victim.rid)
+        while len(self._preempts) > _PREEMPT_LEDGER_CAP:
+            self._preempts.popitem(last=False)
+        return (victim.rid,)
+
+    def slot_caps(self, engine) -> Optional[np.ndarray]:
+        """Per-slot chunk-budget caps (``engine._slot_budgets``):
+        while an at-risk request waits in the queue, batch-class
+        slots commit at most ``probe_chunk`` tokens per chunk —
+        bounding their emission and paged page-growth while the
+        scheduler works to place urgent traffic. None = uncapped
+        (the common case: no urgent work queued)."""
+        if not engine._queue:
+            return None
+        now = time.perf_counter()
+        if not self._queued_at_risk(engine, now):
+            return None
+        caps = np.full((engine.cfg.max_slots,),
+                       np.iinfo(np.int32).max, np.int32)
+        for slot, req in list(engine._slot_req.items()):
+            if req.slo == "batch":
+                caps[slot] = self.probe_chunk
+        return caps
+
+    def chunk_len(self, engine, max_chunk: int) -> int:
+        """Decode-chunk length for the next tick: drop to the probe
+        chunk only while admission work is queued AND admission can
+        happen SOON — a free slot now, or an active slot whose
+        remaining budget ends inside this chunk (``step_adaptive``'s
+        measured discipline: a full chunk spends K tokens per
+        incumbent before the next admission point, but when every
+        slot is busy with long budgets a short chunk buys nothing and
+        costs a host sync per boundary). Only two distinct K values
+        ever dispatch, so at most two decode programs compile for the
+        engine's lifetime."""
+        if not engine._queue:
+            return max_chunk
+        if not engine.active.all():
+            return min(self.probe_chunk, max_chunk)
+        # raw remaining budgets (not _slot_budgets: our own slot_caps
+        # would masquerade capped slots as about-to-finish)
+        soonest = min(
+            (min(req.max_new_tokens - len(req.output),
+                 engine.cfg.max_len - 1 - int(engine.seq_lens[slot]))
+             for slot, req in list(engine._slot_req.items())),
+            default=max_chunk + 1)
+        if soonest <= max_chunk:
+            return min(self.probe_chunk, max_chunk)
+        return max_chunk
+
+    def snapshot(self) -> dict:
+        """Host-side policy state (copy-on-read): the fair-share
+        ledger and preemption ledger sizes."""
+        return {
+            "policy": self.name,
+            "preempt_enabled": self.preempt_enabled,
+            "service": {k: v for k, v in list(self._service.items())},
+            "preempted_requests": len(self._preempts),
+            "tenants": {
+                k: {"weight": q.weight, "max_slots": q.max_slots,
+                    "max_pages": q.max_pages}
+                for k, q in list(self.tenants.items())},
+        }
+
+
+def default_scheduler():
+    """The front door's default policy, from ``PT_FLAGS_sched_policy``:
+    ``"fifo"`` → None (the engine's native submission-order
+    admission), ``"slo_fair"`` → a default-config
+    :class:`SLOFairScheduler`."""
+    policy = str(flags.flag("sched_policy")).lower()
+    if policy == "fifo":
+        return None
+    if policy == "slo_fair":
+        return SLOFairScheduler()
+    raise ValueError(
+        f"PT_FLAGS_sched_policy must be fifo|slo_fair; got {policy!r}")

@@ -204,7 +204,7 @@ def test_cow_write_never_mutates_cached_prefix():
     eng = ContinuousBatchingEngine(model, _ecfg(True))
     ref = eng.run([prompt], max_new_tokens=8)[0].output
     store = eng._prefix
-    pages = list(store._blocks.values())
+    pages = [p for p, _ns in store._blocks.values()]
     assert len(pages) == 2
     before = [[np.asarray(c.k_pages[:, p]).copy() for p in pages]
               for c in eng.layer_caches]

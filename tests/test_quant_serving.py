@@ -338,7 +338,7 @@ def test_prefix_pages_carry_scale_rows_through_adopt_cow(serving_flags):
     drain(eng)
     ref = eng._finished[r1].output
     assert eng.spec_stats["accepted"] > 0  # verify wrote K+1 windows
-    pages = list(eng._prefix._blocks.values())
+    pages = [p for p, _ns in eng._prefix._blocks.values()]
     assert len(pages) == 2
     before = [(np.asarray(c.k_pages[:, p]).copy(),
                np.asarray(c.k_scale[:, p]).copy(),

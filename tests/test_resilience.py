@@ -573,7 +573,7 @@ def test_drain_ledger_payload_shape():
     eng.resume()
     assert set(led) == {
         "rid", "prompt", "output", "max_new_tokens", "eos_token_id",
-        "temperature", "top_k", "top_p", "greedy", "slo",
+        "temperature", "top_k", "top_p", "greedy", "tenant", "slo",
         "ttft_target_ms", "tpot_target_ms", "deadline_t",
         "max_retries", "retries", "ttft_ms", "submit_t", "admit_t",
         "device_ms", "device_ms_profiled",

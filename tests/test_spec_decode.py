@@ -283,7 +283,7 @@ def test_cow_under_verify_never_dirties_shared_page(serving_flags):
     ref = eng._finished[r1].output
     assert eng.spec_stats["accepted"] > 0  # verify actually wrote
     store = eng._prefix
-    pages = list(store._blocks.values())
+    pages = [p for p, _ns in store._blocks.values()]
     assert len(pages) == 2
     before = [[np.asarray(c.k_pages[:, p]).copy() for p in pages]
               for c in eng.layer_caches]

@@ -304,7 +304,8 @@ def test_slo_met_and_violated(obs_flags):
     assert eng._finished[r_good].tpot_ms > 0
     # registry counters + goodput gauge carry the slo label
     reg = obs.global_registry()
-    lab = {"engine": eng._tel.engine_id, "slo": "interactive"}
+    lab = {"engine": eng._tel.engine_id, "slo": "interactive",
+           "tenant": "-"}
     assert reg.get("pt_serve_slo_met_total").value(**lab) == 1
     assert reg.get("pt_serve_slo_violated_total").value(**lab) == 1
     assert reg.get("pt_serve_slo_goodput").value(**lab) == 0.5
